@@ -1,0 +1,55 @@
+"""Test config: force an 8-device virtual CPU mesh before JAX initialises.
+
+This is the multi-device test strategy from SURVEY.md §4(d): mesh/pjit logic
+is exercised on 8 virtual CPU devices so sharding is testable without real
+TPU hardware; the driver separately dry-runs the multichip path.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+from tse1m_tpu.config import Config  # noqa: E402
+from tse1m_tpu.db.connection import DB  # noqa: E402
+from tse1m_tpu.data.synth import SynthSpec, generate_study  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def synth_study():
+    return generate_study(SynthSpec(n_projects=16, days=420, seed=7))
+
+
+@pytest.fixture(scope="session")
+def study_db(synth_study, tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("db") / "study.sqlite")
+    cfg = Config(engine="sqlite", sqlite_path=path)
+    db = DB(config=cfg).connect()
+    synth_study.to_db(db)
+    yield db
+    db.closeConnection()
+
+
+@pytest.fixture(scope="session")
+def study_cfg(study_db):
+    cfg = Config(engine="sqlite", sqlite_path=study_db.config.sqlite_path)
+    # Fixture projects have 420 coverage days; keep the reference's 365-day
+    # eligibility threshold meaningful.
+    return cfg
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,97 @@
+"""DB layer: schema, ingest, parameterized queries, columnar extraction."""
+
+import numpy as np
+
+from tse1m_tpu.config import Config
+from tse1m_tpu.data.columnar import StudyArrays, ns_to_device_s
+from tse1m_tpu.db import queries
+from tse1m_tpu.db.connection import DB
+from tse1m_tpu.db.ingest import canon_result, parse_array, pg_array_literal, ingest_csv_dir
+
+
+def test_parse_array_forms():
+    assert parse_array("{a,b}") == ["a", "b"]
+    assert parse_array('["a","b"]') == ["a", "b"]
+    assert parse_array("") == []
+    assert parse_array(None) == []
+    assert parse_array("{}") == []
+    assert pg_array_literal(["x", "y"]) == "{x,y}"
+
+
+def test_canon_result():
+    assert canon_result("Success") == "Finish"
+    assert canon_result("Finish") == "Finish"
+    assert canon_result("Halfway") == "Halfway"
+    assert canon_result(None) == "Unknown"
+
+
+def test_synth_to_db_roundtrip(study_db, synth_study):
+    (n_builds,) = study_db.query("SELECT COUNT(*) FROM buildlog_data")[0]
+    assert n_builds == len(synth_study.buildlog_data)
+    (n_issues,) = study_db.query("SELECT COUNT(*) FROM issues")[0]
+    assert n_issues == len(synth_study.issues)
+
+
+def test_eligible_projects_threshold(study_db, synth_study):
+    sql, params = queries.eligible_projects(365, "2026-01-01")
+    eligible = {r[0] for r in study_db.query(sql, params)}
+    cov = synth_study.total_coverage
+    expected = {
+        p for p, grp in cov.groupby("project")
+        if (grp["coverage"] > 0).sum() >= 365
+    }
+    assert eligible == expected
+    assert 0 < len(eligible) < synth_study.project_info.shape[0] + 1
+
+
+def test_same_date_build_issue_window_join(study_db):
+    sql, params = queries.eligible_projects(365, "2026-01-01")
+    targets = [r[0] for r in study_db.query(sql, params)]
+    sql, params = queries.same_date_build_issue(targets, "2026-01-01")
+    rows = study_db.query(sql, params)
+    assert rows, "window-function join returned no linked issues"
+    # rn=1 guarantees one row per (project, number).
+    keys = [(r[1], r[0]) for r in rows]
+    assert len(keys) == len(set(keys))
+    # Linked build strictly precedes the issue report time.
+    for r in rows[:200]:
+        assert r[3] < r[2]
+
+
+def test_columnar_extraction(study_db, study_cfg, synth_study):
+    arrays = StudyArrays.from_db(study_db, study_cfg)
+    assert arrays.n_projects > 0
+    # Segments are time-sorted.
+    for p in range(arrays.n_projects):
+        t = arrays.fuzz.segment(p)["time_ns"]
+        assert np.all(np.diff(t) >= 0)
+    # Totals line up with the DB.
+    (total_fuzz,) = study_db.query(
+        "SELECT COUNT(*) FROM buildlog_data WHERE build_type='Fuzzing' AND project IN ("
+        + ",".join("?" * arrays.n_projects) + ")",
+        arrays.projects,
+    )[0]
+    assert len(arrays.fuzz) == total_fuzz
+    # Device views are int32 seconds and order-preserving.
+    dev = arrays.device_times()
+    assert dev["fuzz_times_s"].dtype == np.int32
+    assert np.all(np.diff(dev["fuzz_times_s"][: dev["fuzz_offsets"][1]]) >= 0)
+
+
+def test_ingest_csv_dir(tmp_path, synth_study):
+    csv_dir = tmp_path / "csv"
+    synth_study.to_csv_dir(str(csv_dir))
+    cfg = Config(engine="sqlite", sqlite_path=str(tmp_path / "ing.sqlite"))
+    db = DB(config=cfg).connect()
+    counts = ingest_csv_dir(db, str(csv_dir))
+    assert counts["buildlog_data"] == len(synth_study.buildlog_data)
+    assert counts["issues"] == len(synth_study.issues)
+    assert counts["total_coverage"] == len(synth_study.total_coverage)
+    db.closeConnection()
+
+
+def test_device_seconds_strictness():
+    # issue > build comparisons survive the ns->s quantisation in fixtures.
+    ns = np.array([1_700_000_000_000_000_000, 1_700_000_003_000_000_000])
+    s = ns_to_device_s(ns)
+    assert s[1] > s[0]

@@ -1,0 +1,33 @@
+"""tse1m_tpu — TPU-native framework with the capabilities of the TSE
+"1 Million Fuzzing Sessions" replication package.
+
+The reference (``/root/reference``, see SURVEY.md) is a pandas/Postgres data
+pipeline answering four research questions over ~1.19M OSS-Fuzz build
+sessions.  This package keeps its *contract* — the same entry points
+(``run_all_analysis.sh``, ``program/research_questions/rq*.py``), config file
+(``program/envFile.ini``) and artifact formats — but replaces the engine:
+
+- ``db``        canonical schema, parameterized queries, sqlite/postgres
+                drivers, and the CSV->DB ingestion the reference lacks
+                (reference: ``program/__module/dbFile.py``, ``queries1.py``)
+- ``data``      bulk columnar extraction into CSR struct-of-arrays + the
+                synthetic fixture generator (the real dump is gitignored
+                in the reference)
+- ``ops``       device kernels: segment searchsorted/reductions, masked
+                percentiles, rank stats, MinHash (pallas), banded LSH,
+                connected components
+- ``parallel``  mesh construction, shardings, collectives (ICI/DCN seat
+                that NCCL holds in the reference's GPU analogues: none —
+                see SURVEY.md §2.4)
+- ``backend``   the {pandas, jax_tpu} dispatcher behind envFile.ini
+- ``models``    session-dedup (MinHash+LSH), crash clustering, and the
+                trainable detection-decay model
+- ``analysis``  RQ1..RQ4b re-implemented over backend primitives
+                (reference: ``program/research_questions/*.py``)
+- ``collect``   the six offline ETL collectors
+                (reference: ``program/preparation/*.py``)
+- ``native``    C++ fast paths (CSV/timestamp decode) via ctypes
+- ``utils``     structured logging, phase timing, run manifests
+"""
+
+__version__ = "0.1.0"

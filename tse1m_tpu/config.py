@@ -1,0 +1,105 @@
+"""Configuration.
+
+Reads the reference-compatible ``program/envFile.ini`` (reference:
+``program/envFile.ini:1-6`` — a single ``[POSTGRES]`` section) and extends it
+with a ``[FRAMEWORK]`` section carrying the ``backend = {pandas, jax_tpu}``
+switch required by the north star (BASELINE.json) plus engine selection.
+
+Study-wide constants mirror ``program/__module/queries1.py:3-4`` in the
+reference (``LIMIT_DATE``, ``RESULT_TYPE``); they live here as typed config
+rather than module globals so every layer shares one source of truth.
+"""
+
+from __future__ import annotations
+
+import os
+from configparser import ConfigParser
+from dataclasses import dataclass, field
+
+# Canonical result enum.  The reference is internally inconsistent: its
+# analyzer emits {Success, Error, Unknown} while every query filters
+# ('Finish','Halfway') (SURVEY.md §2.2).  We standardise on the DB-side
+# vocabulary and map legacy analyzer values at ingest (db/ingest.py).
+RESULT_OK = ("Finish", "Halfway")
+BUILD_TYPES = ("Fuzzing", "Coverage", "Introspector", "Error")
+FIXED_STATUSES = ("Fixed", "Fixed (Verified)")
+
+DEFAULT_LIMIT_DATE = "2025-01-08"
+DEFAULT_INI = "program/envFile.ini"
+
+
+@dataclass
+class PostgresConfig:
+    database: str = "replication_db"
+    user: str = "replication_user"
+    password: str = "replication_pass"
+    host: str = "db"
+    port: int = 5432
+
+
+@dataclass
+class Config:
+    # Analysis backend: "pandas" (host) or "jax_tpu" (device arrays + mesh).
+    backend: str = "pandas"
+    # Storage engine: "sqlite" (embedded, default in this environment) or
+    # "postgres" (requires psycopg2; reference's engine).
+    engine: str = "sqlite"
+    sqlite_path: str = "data/database/tse1m.sqlite"
+    postgres: PostgresConfig = field(default_factory=PostgresConfig)
+    # Study-wide constants (queries1.py:3-4).
+    limit_date: str = DEFAULT_LIMIT_DATE
+    # Eligibility predicate threshold (rq1_detection_rate.py:144-151).
+    min_coverage_days: int = 365
+    # Statistical-significance filter (rq1_detection_rate.py:233).
+    min_projects_per_iteration: int = 100
+    # Artifact root (reference writes under data/result_data/).
+    result_dir: str = "data/result_data"
+    data_dir: str = "data"
+    # Test-mode subset switch (rq1_detection_rate.py:20,155-158,233).
+    test_mode: bool = False
+
+    @property
+    def result_ok(self) -> tuple[str, ...]:
+        return RESULT_OK
+
+
+def load_config(ini_path: str | None = None) -> Config:
+    """Load config from envFile.ini, tolerating the reference's bare-minimum
+    ini (POSTGRES only) and environment overrides.
+
+    Env overrides: TSE1M_BACKEND, TSE1M_ENGINE, TSE1M_SQLITE_PATH,
+    TSE1M_TEST_MODE.
+    """
+    cfg = Config()
+    path = ini_path or os.environ.get("TSE1M_ENVFILE", DEFAULT_INI)
+    parser = ConfigParser()
+    if path and os.path.exists(path):
+        parser.read(path)
+        if parser.has_section("POSTGRES"):
+            pg = parser["POSTGRES"]
+            cfg.postgres = PostgresConfig(
+                database=pg.get("POSTGRES_DB", cfg.postgres.database),
+                user=pg.get("POSTGRES_USER", cfg.postgres.user),
+                password=pg.get("POSTGRES_PASSWORD", cfg.postgres.password),
+                host=pg.get("POSTGRES_IP", cfg.postgres.host),
+                port=pg.getint("POSTGRES_PORT", cfg.postgres.port),
+            )
+        if parser.has_section("FRAMEWORK"):
+            fw = parser["FRAMEWORK"]
+            cfg.backend = fw.get("backend", cfg.backend)
+            cfg.engine = fw.get("engine", cfg.engine)
+            cfg.sqlite_path = fw.get("sqlite_path", cfg.sqlite_path)
+            cfg.limit_date = fw.get("limit_date", cfg.limit_date)
+            cfg.result_dir = fw.get("result_dir", cfg.result_dir)
+            cfg.test_mode = fw.getboolean("test_mode", cfg.test_mode)
+
+    cfg.backend = os.environ.get("TSE1M_BACKEND", cfg.backend)
+    cfg.engine = os.environ.get("TSE1M_ENGINE", cfg.engine)
+    cfg.sqlite_path = os.environ.get("TSE1M_SQLITE_PATH", cfg.sqlite_path)
+    if "TSE1M_TEST_MODE" in os.environ:
+        cfg.test_mode = os.environ["TSE1M_TEST_MODE"].lower() in ("1", "true", "yes")
+    if cfg.backend not in ("pandas", "jax_tpu"):
+        raise ValueError(f"unknown backend {cfg.backend!r}; expected 'pandas' or 'jax_tpu'")
+    if cfg.engine not in ("sqlite", "postgres"):
+        raise ValueError(f"unknown engine {cfg.engine!r}; expected 'sqlite' or 'postgres'")
+    return cfg

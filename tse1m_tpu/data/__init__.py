@@ -1,0 +1,4 @@
+from .columnar import StudyArrays, STUDY_EPOCH
+from .synth import SynthSpec, generate_study, synth_session_sets
+
+__all__ = ["StudyArrays", "STUDY_EPOCH", "SynthSpec", "generate_study", "synth_session_sets"]

@@ -1,0 +1,184 @@
+"""Bulk columnar extraction: DB -> CSR struct-of-arrays.
+
+This layer kills the reference's N+1 pattern (one query per project inside
+Python loops — ``rq1_detection_rate.py:192-201``, ``rq4b_coverage.py:315-326``;
+SURVEY.md §2.3): each table is fetched once, ordered by (project, time), and
+cut into per-project segments with offset arrays, ready for device-side
+segment ops.
+
+Timestamps are kept as int64 nanoseconds on the host (exact pandas parity)
+and exposed as int32 *seconds since STUDY_EPOCH* for the device path —
+second resolution is far below inter-build spacing (CI builds are hours
+apart, reference transcript rq1_detection_rate.py:361 shows ~1.4k
+builds/project over ~6 years) and int32 avoids x64-mode penalties on TPU.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+import numpy as np
+import pandas as pd
+
+from ..config import Config
+from ..db import queries
+from ..db.connection import DB
+from ..db.ingest import parse_array
+from ..utils.logging import get_logger
+
+log = get_logger("columnar")
+
+STUDY_EPOCH = np.datetime64("2015-01-01T00:00:00", "ns")
+
+
+def to_epoch_ns(values) -> np.ndarray:
+    return pd.to_datetime(list(values), format="mixed").values.astype("datetime64[ns]").astype(np.int64)
+
+
+def ns_to_device_s(ns: np.ndarray) -> np.ndarray:
+    return ((ns - STUDY_EPOCH.astype(np.int64)) // 1_000_000_000).astype(np.int32)
+
+
+def rev_hash(revisions: list[str]) -> np.int64:
+    """Deterministic 63-bit hash of a revision list — set equality in RQ3
+    (reference compares sets, rq3_diff_coverage_at_detection.py:280) becomes
+    an integer comparison precomputed at extraction."""
+    digest = hashlib.blake2b(
+        ("\x1f".join(sorted(revisions))).encode(), digest_size=8
+    ).digest()
+    return np.int64(int.from_bytes(digest, "little") >> 1)
+
+
+def _offsets_from_sorted_codes(codes: np.ndarray, n_segments: int) -> np.ndarray:
+    """CSR offsets from a sorted integer code column."""
+    return np.searchsorted(codes, np.arange(n_segments + 1)).astype(np.int64)
+
+
+@dataclass
+class Segmented:
+    """One table's per-project CSR view."""
+
+    offsets: np.ndarray  # [P+1] int64
+    columns: dict[str, np.ndarray] = field(default_factory=dict)
+
+    def segment(self, p: int) -> dict[str, np.ndarray]:
+        lo, hi = self.offsets[p], self.offsets[p + 1]
+        return {k: v[lo:hi] for k, v in self.columns.items()}
+
+    def counts(self) -> np.ndarray:
+        return np.diff(self.offsets)
+
+    def __len__(self) -> int:
+        return int(self.offsets[-1])
+
+
+@dataclass
+class StudyArrays:
+    projects: list[str]
+    fuzz: Segmented       # columns: time_ns, name
+    covb: Segmented       # columns: time_ns, revhash, name, modules, revisions
+    issues: Segmented     # columns: time_ns, number, crash_type, status
+    cov: Segmented        # columns: date_ns, coverage, covered, total
+
+    @property
+    def n_projects(self) -> int:
+        return len(self.projects)
+
+    def project_index(self) -> dict[str, int]:
+        return {p: i for i, p in enumerate(self.projects)}
+
+    # -- construction ------------------------------------------------------
+
+    @classmethod
+    def from_db(cls, db: DB, cfg: Config, projects: list[str] | None = None) -> "StudyArrays":
+        if projects is None:
+            sql, params = queries.eligible_projects(cfg.min_coverage_days, cfg.limit_date)
+            projects = [r[0] for r in db.query(sql, params)]
+        projects = sorted(projects)
+        log.info("extracting %d eligible projects", len(projects))
+        pidx = {p: i for i, p in enumerate(projects)}
+
+        def order_rows(rows):
+            """SQL ORDER BY project uses the engine's collation, which may
+            disagree with Python's code-point sort (e.g. glibc locale
+            collations ignore '-' at primary weight) — re-sort stably by our
+            project codes so CSR offsets are always correct; within-project
+            time order from SQL is preserved by the stable sort."""
+            if not rows:
+                return rows, np.empty(0, dtype=np.int64)
+            codes = np.array([pidx[r[0]] for r in rows], dtype=np.int64)
+            order = np.argsort(codes, kind="stable")
+            return [rows[i] for i in order], codes[order]
+
+        # Fuzzing builds (bulk; replaces ALL_FUZZING_BUILD per-project loop).
+        sql, params = queries.all_fuzzing_builds_bulk(projects)
+        rows, fcodes = order_rows(db.query(sql, params))
+        fuzz = Segmented(
+            offsets=_offsets_from_sorted_codes(fcodes, len(projects)),
+            columns={
+                "time_ns": to_epoch_ns([r[2] for r in rows]) if rows else np.empty(0, np.int64),
+                "name": np.array([r[1] for r in rows], dtype=object),
+            },
+        )
+
+        # Coverage builds with precomputed revision-set hashes.
+        sql, params = queries.coverage_builds_bulk(projects)
+        rows, ccodes = order_rows(db.query(sql, params))
+        revs = [parse_array(r[4]) for r in rows]
+        covb = Segmented(
+            offsets=_offsets_from_sorted_codes(ccodes, len(projects)),
+            columns={
+                "time_ns": to_epoch_ns([r[2] for r in rows]) if rows else np.empty(0, np.int64),
+                "name": np.array([r[1] for r in rows], dtype=object),
+                "modules": np.array([parse_array(r[3]) for r in rows], dtype=object),
+                "revisions": np.array(revs, dtype=object),
+                "revhash": np.array([rev_hash(r) for r in revs], dtype=np.int64)
+                if rows else np.empty(0, np.int64),
+            },
+        )
+
+        # Fixed issues before the cutoff.
+        sql, params = queries.issues_bulk(projects, cfg.limit_date, fixed_only=True)
+        rows, icodes = order_rows(db.query(sql, params))
+        issues = Segmented(
+            offsets=_offsets_from_sorted_codes(icodes, len(projects)),
+            columns={
+                "time_ns": to_epoch_ns([r[2] for r in rows]) if rows else np.empty(0, np.int64),
+                "number": np.array([r[1] for r in rows], dtype=object),
+                "status": np.array([r[3] for r in rows], dtype=object),
+                "crash_type": np.array([r[4] for r in rows], dtype=object),
+            },
+        )
+
+        # Daily coverage rows (non-zero, pre-cutoff).
+        sql, params = queries.total_coverage_bulk(projects, cfg.limit_date)
+        rows, vcodes = order_rows(db.query(sql, params))
+        cov = Segmented(
+            offsets=_offsets_from_sorted_codes(vcodes, len(projects)),
+            columns={
+                "date_ns": to_epoch_ns([r[1] for r in rows]) if rows else np.empty(0, np.int64),
+                "coverage": np.array([r[2] for r in rows], dtype=np.float64),
+                "covered": np.array([r[3] if r[3] is not None else np.nan for r in rows],
+                                    dtype=np.float64),
+                "total": np.array([r[4] if r[4] is not None else np.nan for r in rows],
+                                  dtype=np.float64),
+            },
+        )
+
+        log.info("columnar: %d fuzz builds, %d coverage builds, %d issues, %d coverage days",
+                 len(fuzz), len(covb), len(issues), len(cov))
+        return cls(projects=projects, fuzz=fuzz, covb=covb, issues=issues, cov=cov)
+
+    # -- device views ------------------------------------------------------
+
+    def device_times(self) -> dict[str, np.ndarray]:
+        """int32-seconds views for the jax backend."""
+        return {
+            "fuzz_times_s": ns_to_device_s(self.fuzz.columns["time_ns"]),
+            "fuzz_offsets": self.fuzz.offsets,
+            "issue_times_s": ns_to_device_s(self.issues.columns["time_ns"]),
+            "issue_offsets": self.issues.offsets,
+            "covb_times_s": ns_to_device_s(self.covb.columns["time_ns"]),
+            "covb_offsets": self.covb.offsets,
+        }

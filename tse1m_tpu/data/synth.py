@@ -1,0 +1,281 @@
+"""Synthetic study-data generator.
+
+The reference's real dataset (~1.19M builds, 72k issues) ships as a
+gitignored SQL dump absent from the snapshot (reference ``.gitignore:6-7``),
+so both tests and benchmarks need statistically similar synthetic data
+(SURVEY.md §7.3).  Two generators:
+
+- :func:`generate_study` — a full relational fixture (five tables + corpus
+  analysis CSV) whose shapes follow the paper: detection rate decaying from
+  ~35% at session 1 toward a ~2% late-stage floor
+  (rq1_detection_rate.py:373-407), saturating coverage trends, revision
+  change-points every few days, corpus groups G1..G4
+  (rq4a_bug.py:82-121).
+- :func:`synth_session_sets` — per-session coverage feature *sets* with
+  planted near-duplicate cluster structure for the MinHash/LSH north star
+  (BASELINE.json configs), scalable to 1M+ sessions.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+import pandas as pd
+
+_CRASH_TYPES = [
+    "Heap-buffer-overflow READ", "Heap-buffer-overflow WRITE", "Use-after-free READ",
+    "Stack-buffer-overflow READ", "Null-dereference READ", "UNKNOWN READ",
+    "Timeout", "Out-of-memory", "Abrt", "Integer-overflow",
+]
+_SEVERITIES = ["High", "Medium", "Low"]
+_LANGUAGES = ["c++", "c", "python", "rust", "go", "jvm", "swift"]
+_STATUS_OTHER = ["New", "Duplicate", "WontFix", "Invalid"]
+
+
+@dataclass
+class SynthSpec:
+    n_projects: int = 24
+    days: int = 450
+    start: str = "2023-06-01"
+    seed: int = 0
+    # Mean fuzzing builds per project per day (Poisson).
+    fuzz_rate: float = 1.4
+    # Fraction of projects given < 365 coverage days (ineligible).
+    ineligible_fraction: float = 0.15
+    # Detection-rate decay: p(session) = a * session^-k, floored.
+    detect_a: float = 0.35
+    detect_k: float = 0.75
+    detect_floor: float = 0.02
+    # Revision change cadence (days) for coverage builds.
+    revision_period: int = 3
+    # Corpus group fractions (G1 none, G2 initial, G3 1-7d, G4 >=7d).
+    corpus_fractions: tuple = (0.40, 0.30, 0.15, 0.15)
+
+
+@dataclass
+class SynthStudy:
+    project_info: pd.DataFrame
+    buildlog_data: pd.DataFrame
+    total_coverage: pd.DataFrame
+    issues: pd.DataFrame
+    corpus_analysis: pd.DataFrame
+    spec: SynthSpec = field(repr=False, default=None)
+
+    def to_csv_dir(self, path: str) -> None:
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        self.project_info.to_csv(f"{path}/project_info.csv", index=False)
+        self.buildlog_data.to_csv(f"{path}/buildlog_data.csv", index=False)
+        self.total_coverage.to_csv(f"{path}/total_coverage.csv", index=False)
+        self.issues.to_csv(f"{path}/issues.csv", index=False)
+        self.corpus_analysis.to_csv(f"{path}/project_corpus_analysis.csv", index=False)
+
+    def to_db(self, db) -> None:
+        from ..db.ingest import (derive_projects, load_buildlog_data, load_issues,
+                                 load_project_info, load_total_coverage)
+        from ..db.schema import create_schema
+
+        create_schema(db)
+        load_project_info(db, self.project_info.to_dict("records"))
+        load_buildlog_data(db, self.buildlog_data.to_dict("records"))
+        load_total_coverage(db, self.total_coverage.to_dict("records"))
+        load_issues(db, self.issues.to_dict("records"))
+        derive_projects(db)
+
+
+def _fmt_ts(ts: np.ndarray) -> np.ndarray:
+    return np.datetime_as_string(ts.astype("datetime64[s]"), unit="s")
+
+
+def generate_study(spec: SynthSpec | None = None) -> SynthStudy:
+    spec = spec or SynthSpec()
+    rng = np.random.default_rng(spec.seed)
+    start = np.datetime64(spec.start)
+
+    proj_rows, build_rows, cov_rows, issue_rows, corpus_rows = [], [], [], [], []
+    issue_counter = 10000
+    group_labels = rng.choice(4, size=spec.n_projects, p=list(spec.corpus_fractions))
+
+    for p in range(spec.n_projects):
+        name = f"proj{p:03d}"
+        ineligible = rng.random() < spec.ineligible_fraction
+        n_days = int(rng.integers(60, 300)) if ineligible else spec.days
+        day0 = start + np.timedelta64(int(rng.integers(0, 30)), "D")
+        first_commit = day0 - np.timedelta64(int(rng.integers(200, 2000)), "D")
+        proj_rows.append({
+            "project": name,
+            "first_commit_datetime": str(first_commit) + " 00:00:00",
+            "language": rng.choice(_LANGUAGES),
+            "homepage": f"https://example.org/{name}",
+            "main_repo": f"https://github.com/example/{name}",
+            "primary_contact": f"{name}@example.org",
+        })
+
+        # Coverage trend: saturating curve with noise; a few projects decline.
+        c0 = rng.uniform(0.15, 0.45)
+        c1 = rng.uniform(0.5, 0.9)
+        tau = rng.uniform(60, 200)
+        declining = rng.random() < 0.1
+        total_lines0 = rng.integers(5_000, 80_000)
+
+        session_idx = 0
+        build_serial = 0
+        rev_sha = None
+        # G4 corpus introduced at a build index >= ~10; G3 within 1-7 days.
+        group = int(group_labels[p])
+        corpus_build_idx = None
+        if group == 3:
+            corpus_build_idx = int(rng.integers(10, 120))
+        introduced_day = None
+
+        for d in range(n_days):
+            day = day0 + np.timedelta64(d, "D")
+            if d % spec.revision_period == 0 or rev_sha is None:
+                rev_sha = "".join(rng.choice(list("0123456789abcdef"), 40))
+            base_serial = 350000 + d * 100
+
+            # Fuzzing builds.
+            k = rng.poisson(spec.fuzz_rate)
+            if d == 0:
+                k = max(k, 1)
+            hours = np.sort(rng.uniform(0, 23, size=k))
+            for h in hours:
+                session_idx += 1
+                build_serial += 1
+                ts = day + np.timedelta64(int(h * 3600), "s")
+                r = rng.random()
+                result = "Finish" if r < 0.90 else ("Halfway" if r < 0.95 else "Error")
+                build_rows.append({
+                    "name": f"log-{name}-{build_serial:07d}.txt",
+                    "project": name,
+                    "timecreated": str(ts.astype("datetime64[s]")).replace("T", " "),
+                    "build_type": "Fuzzing",
+                    "result": result,
+                    "modules": "{" + name + ",libfuzzer}",
+                    "revisions": "{" + rev_sha + "," + str(base_serial + int(h)) + "}",
+                })
+                if corpus_build_idx is not None and session_idx == corpus_build_idx:
+                    introduced_day = d
+                # Issue detection decaying with session index.
+                p_detect = max(spec.detect_a * session_idx ** -spec.detect_k,
+                               spec.detect_floor)
+                if rng.random() < p_detect:
+                    issue_counter += 1
+                    rts = ts + np.timedelta64(int(rng.uniform(1, 20) * 3600), "s")
+                    fixed = rng.random() < 0.82
+                    status = ("Fixed" if rng.random() < 0.5 else "Fixed (Verified)") \
+                        if fixed else rng.choice(_STATUS_OTHER)
+                    regressed = "{" + f"{name}-regress-{build_serial}" + "}" \
+                        if rng.random() < 0.6 else ""
+                    issue_rows.append({
+                        "project": name,
+                        "number": str(issue_counter),
+                        "rts": str(rts.astype("datetime64[s]")).replace("T", " "),
+                        "status": status,
+                        "crash_type": rng.choice(_CRASH_TYPES),
+                        "severity": rng.choice(_SEVERITIES),
+                        "type": "Vulnerability" if rng.random() < 0.5 else "Bug",
+                        "regressed_build": regressed,
+                        "new_id": str(42000000 + issue_counter),
+                    })
+
+            # Daily coverage build (same revision set as that day's fuzz builds).
+            build_serial += 1
+            cov_ts = day + np.timedelta64(13 * 3600 + 11 * 60 + int(rng.integers(0, 60)), "s")
+            build_rows.append({
+                "name": f"log-{name}-{build_serial:07d}.txt",
+                "project": name,
+                "timecreated": str(cov_ts.astype("datetime64[s]")).replace("T", " "),
+                "build_type": "Coverage",
+                "result": "Finish" if rng.random() < 0.97 else "Error",
+                "modules": "{" + name + ",libfuzzer}",
+                "revisions": "{" + rev_sha + "," + str(base_serial + 13) + "}",
+            })
+
+            # Daily coverage report row.
+            t = d / tau
+            frac = c0 + (c1 - c0) * (1 - np.exp(-t))
+            if declining:
+                frac = c1 - (c1 - c0) * (1 - np.exp(-t))
+            frac = float(np.clip(frac + rng.normal(0, 0.01), 0.01, 0.99))
+            total_line = float(total_lines0 + d * rng.integers(0, 12))
+            cov_rows.append({
+                "project": name,
+                "date": str(day),
+                "coverage": round(frac * 100, 4),
+                "covered_line": float(round(frac * total_line)),
+                "total_line": total_line,
+            })
+
+        # Corpus-analysis record (C8's project_corpus_analysis.csv shape,
+        # user_corpus.py:219-240: timing of seed-corpus introduction).
+        if group == 0:
+            corpus_delay_days, category = None, "No Corpus"
+        elif group == 1:
+            corpus_delay_days, category = 0.0, "Under 1 Day"
+        elif group == 2:
+            corpus_delay_days, category = float(rng.uniform(1, 7)), "1-7 Days"
+        else:
+            corpus_delay_days = float(introduced_day if introduced_day is not None
+                                      else rng.uniform(7, 60))
+            category = "7+ Days"
+        corpus_rows.append({
+            "project": name,
+            "first_commit_time": str(day0) + " 00:00:00",
+            "corpus_introduction_time":
+                (str(day0 + np.timedelta64(int(corpus_delay_days), "D")) + " 00:00:00")
+                if corpus_delay_days is not None else "",
+            "delay_days": corpus_delay_days if corpus_delay_days is not None else "",
+            "category": category,
+        })
+
+    return SynthStudy(
+        project_info=pd.DataFrame(proj_rows),
+        buildlog_data=pd.DataFrame(build_rows),
+        total_coverage=pd.DataFrame(cov_rows),
+        issues=pd.DataFrame(issue_rows),
+        corpus_analysis=pd.DataFrame(corpus_rows),
+        spec=spec,
+    )
+
+
+def synth_session_sets(
+    n_sessions: int,
+    set_size: int = 64,
+    universe: int = 1 << 24,
+    dup_fraction: float = 0.6,
+    mean_cluster_size: float = 8.0,
+    mutate_prob: float = 0.05,
+    seed: int = 0,
+    dtype=np.uint32,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Planted near-duplicate session coverage sets.
+
+    Returns (items [N, set_size] uint32, labels [N] int64).  ``dup_fraction``
+    of sessions belong to multi-member clusters whose members share a base
+    set with ~``mutate_prob`` of items replaced (expected Jaccard ~0.9);
+    the rest are singletons.  Fully vectorised — generates 1M x 64 in ~1 s.
+    """
+    rng = np.random.default_rng(seed)
+    n_dup = int(n_sessions * dup_fraction)
+    n_clusters = max(1, int(n_dup / mean_cluster_size))
+
+    labels = np.empty(n_sessions, dtype=np.int64)
+    labels[:n_dup] = rng.integers(0, n_clusters, size=n_dup)
+    labels[n_dup:] = np.arange(n_clusters, n_clusters + (n_sessions - n_dup))
+
+    base = rng.integers(0, universe, size=(n_clusters, set_size), dtype=dtype)
+    items = np.empty((n_sessions, set_size), dtype=dtype)
+    items[:n_dup] = base[labels[:n_dup]]
+    items[n_dup:] = rng.integers(0, universe, size=(n_sessions - n_dup, set_size),
+                                 dtype=dtype)
+
+    # Mutate a small fraction of the duplicated rows' items.
+    mutate_mask = rng.random((n_dup, set_size)) < mutate_prob
+    n_mut = int(mutate_mask.sum())
+    items[:n_dup][mutate_mask] = rng.integers(0, universe, size=n_mut, dtype=dtype)
+
+    perm = rng.permutation(n_sessions)
+    return items[perm], labels[perm]

@@ -1,0 +1,4 @@
+from .connection import DB
+from .schema import create_schema, SCHEMA_TABLES
+
+__all__ = ["DB", "create_schema", "SCHEMA_TABLES"]

@@ -1,0 +1,162 @@
+"""Database connection layer.
+
+API-compatible superset of the reference's psycopg2 wrapper
+(``program/__module/dbFile.py:16-38`` — ``connect``, ``executeQuery``,
+``executeMany``, ``executeValues``, ``closeConnection``) with two upgrades:
+
+1. Dual engine: embedded sqlite (default in this environment, where
+   psycopg2/Postgres are unavailable) and Postgres when psycopg2 is present.
+2. Parameterized queries throughout.  The reference interpolates values with
+   f-strings (``queries1.py:43``, ``rq4a_bug.py:131``) — injection-prone and
+   unplannable; here every query takes a params tuple.  Queries are written
+   with the ``?`` qmark style and rewritten to ``%s`` for Postgres.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sqlite3
+from typing import Any, Iterable, Sequence
+
+from ..config import Config, load_config
+from ..utils.logging import get_logger
+
+log = get_logger("db")
+
+_QMARK_RE = re.compile(r"\?")
+
+
+class DB:
+    """Connection wrapper.
+
+    ``DB(config=...)`` picks the engine from config; the legacy keyword form
+    ``DB(database=, user=, password=, host=, port=)`` (dbFile.py's signature)
+    is accepted and implies Postgres when psycopg2 is importable, otherwise
+    falls back to sqlite at the configured path.
+    """
+
+    def __init__(
+        self,
+        database: str | None = None,
+        user: str | None = None,
+        password: str | None = None,
+        host: str | None = None,
+        port: int | str | None = None,
+        config: Config | None = None,
+        sqlite_path: str | None = None,
+    ) -> None:
+        self.config = config or load_config()
+        self._legacy_pg = database is not None
+        if self._legacy_pg:
+            self.config.postgres.database = database
+            if user:
+                self.config.postgres.user = user
+            if password:
+                self.config.postgres.password = password
+            if host:
+                self.config.postgres.host = host
+            if port:
+                self.config.postgres.port = int(port)
+        if sqlite_path:
+            self.config.sqlite_path = sqlite_path
+        self.dialect = self._resolve_dialect()
+        self.connection = None
+        self.cursor = None
+
+    def _resolve_dialect(self) -> str:
+        if self.config.engine == "postgres" or self._legacy_pg:
+            try:
+                import psycopg2  # noqa: F401
+
+                return "postgres"
+            except ImportError:
+                log.warning("psycopg2 unavailable; falling back to sqlite at %s",
+                            self.config.sqlite_path)
+        return "sqlite"
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def connect(self):
+        if self.dialect == "postgres":
+            import psycopg2
+
+            pg = self.config.postgres
+            self.connection = psycopg2.connect(
+                database=pg.database, user=pg.user, password=pg.password,
+                host=pg.host, port=pg.port,
+            )
+        else:
+            path = self.config.sqlite_path
+            if path != ":memory:":
+                os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            self.connection = sqlite3.connect(path)
+            self.connection.execute("PRAGMA journal_mode=WAL")
+            self.connection.execute("PRAGMA synchronous=NORMAL")
+        self.cursor = self.connection.cursor()
+        return self
+
+    def closeConnection(self) -> None:
+        if self.cursor is not None:
+            self.cursor.close()
+        if self.connection is not None:
+            self.connection.close()
+        self.cursor = self.connection = None
+
+    close = closeConnection
+
+    def __enter__(self):
+        return self.connect()
+
+    def __exit__(self, *exc) -> None:
+        self.closeConnection()
+
+    # -- query helpers -----------------------------------------------------
+
+    def _adapt(self, sql: str) -> str:
+        if self.dialect == "postgres":
+            return _QMARK_RE.sub("%s", sql)
+        return sql
+
+    def execute(self, sql: str, params: Sequence[Any] = ()) -> None:
+        self.cursor.execute(self._adapt(sql), tuple(params))
+
+    def query(self, sql: str, params: Sequence[Any] = ()) -> list[tuple]:
+        self.cursor.execute(self._adapt(sql), tuple(params))
+        return self.cursor.fetchall()
+
+    def commit(self) -> None:
+        self.connection.commit()
+
+    # -- reference-compatible surface (dbFile.py:16-38) --------------------
+
+    def executeQuery(self, type: str, sql: str, params: Sequence[Any] = ()):
+        """``type`` is 'select' (returns rows) or anything else (DML+commit),
+        mirroring dbFile.py's select/insert/update switch."""
+        self.cursor.execute(self._adapt(sql), tuple(params))
+        if type == "select":
+            return self.cursor.fetchall()
+        self.connection.commit()
+        return None
+
+    def executeMany(self, sql: str, rows: Iterable[Sequence[Any]]) -> None:
+        self.cursor.executemany(self._adapt(sql), [tuple(r) for r in rows])
+        self.connection.commit()
+
+    def executeValues(self, sql: str, rows: Iterable[Sequence[Any]], page_size: int = 1000) -> None:
+        """Bulk insert.  Postgres uses psycopg2.extras.execute_values
+        (dbFile.py:37's mechanism); sqlite uses executemany, which is the
+        equivalent fast path there.  ``sql`` must be of the form
+        ``INSERT INTO t (cols) VALUES ?`` with a single placeholder."""
+        rows = [tuple(r) for r in rows]
+        if not rows:
+            return
+        if self.dialect == "postgres":
+            from psycopg2.extras import execute_values
+
+            execute_values(self.cursor, self._adapt(sql), rows, page_size=page_size)
+        else:
+            width = len(rows[0])
+            placeholders = "(" + ",".join("?" * width) + ")"
+            self.cursor.executemany(sql.replace("VALUES ?", f"VALUES {placeholders}"), rows)
+        self.connection.commit()

@@ -1,0 +1,229 @@
+"""CSV -> DB ingestion — the reference's missing link.
+
+The reference's collectors emit CSVs (``1_get_projects_infos.py:76``,
+``2_get_buildlog_metadata.py:95``, ``3_get_coverage_data.py:43``,
+``4_get_buildlog_analysis.py:11``, ``5_get_issue_reports.py:296-309``) but no
+script loads them into Postgres; the DB ships pre-built as
+``backup_clean.sql`` (SURVEY.md §1, "gap in the reference").  This module is
+that loader, plus enum canonicalisation and array-literal handling.
+
+Array columns accept either Postgres literal form (``{a,b}``) or JSON
+(``["a","b"]``) on input; storage is engine-native (TEXT[] on Postgres, JSON
+text on sqlite).  ``pg_array_literal`` re-emits the Postgres form for
+artifact writers so output CSVs match the reference's golden files
+(e.g. ``data/result_data/rq3/change_analysis/zstd.csv``).
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import os
+from typing import Iterable, Sequence
+
+from .connection import DB
+from .schema import create_schema
+from ..utils.logging import get_logger
+
+log = get_logger("ingest")
+
+# The reference's analyzer emits {Success, Error, Unknown}
+# (4_get_buildlog_analysis.py:230-237) while the shipped DB and all queries
+# use {Finish, Halfway, Error} (queries1.py:4) — canonicalise at the door.
+_RESULT_CANON = {"Success": "Finish", "success": "Finish"}
+
+
+def canon_result(value: str | None) -> str:
+    if value is None:
+        return "Unknown"
+    return _RESULT_CANON.get(value, value)
+
+
+def _split_pg_array(body: str) -> list[str]:
+    """Tokenise the body of a Postgres array literal, honouring double-quoted
+    items containing commas/braces and backslash escapes."""
+    items: list[str] = []
+    buf: list[str] = []
+    in_quotes = False
+    i = 0
+    while i < len(body):
+        c = body[i]
+        if in_quotes:
+            if c == "\\" and i + 1 < len(body):
+                buf.append(body[i + 1])
+                i += 2
+                continue
+            if c == '"':
+                in_quotes = False
+            else:
+                buf.append(c)
+        elif c == '"':
+            in_quotes = True
+        elif c == ",":
+            items.append("".join(buf).strip())
+            buf = []
+        else:
+            buf.append(c)
+        i += 1
+    if buf or items:
+        items.append("".join(buf).strip())
+    return [it for it in items if it]
+
+
+def parse_array(value) -> list[str]:
+    """Accept '{a,b}' (with optional quoted items), '["a","b"]', a Python
+    list, '' or None."""
+    if value is None or (isinstance(value, float) and value != value):
+        return []
+    if isinstance(value, (list, tuple)):
+        return [str(v) for v in value]
+    s = str(value).strip()
+    if not s or s in ("{}", "[]"):
+        return []
+    if s.startswith("{") and s.endswith("}"):
+        return _split_pg_array(s[1:-1])
+    if s.startswith("["):
+        return [str(v) for v in json.loads(s)]
+    return [s]
+
+
+def pg_array_literal(items: Sequence[str]) -> str:
+    """Emit the Postgres literal form, quoting items that contain
+    delimiters so parse_array/Postgres round-trip losslessly."""
+    out = []
+    for item in items:
+        s = str(item)
+        if s == "" or any(c in s for c in ',{}" \\'):
+            s = '"' + s.replace("\\", "\\\\").replace('"', '\\"') + '"'
+        out.append(s)
+    return "{" + ",".join(out) + "}"
+
+
+def store_array(db: DB, items: Sequence[str]):
+    if db.dialect == "postgres":
+        return list(items)
+    return json.dumps(list(items))
+
+
+def _read_csv(path: str) -> Iterable[dict]:
+    with open(path, newline="", encoding="utf-8") as f:
+        yield from csv.DictReader(f)
+
+
+def _upsert_sql(db: DB, table: str, cols: Sequence[str], conflict: Sequence[str]) -> str:
+    """Dialect-consistent upsert: re-ingesting a corrected CSV updates the
+    row on both engines (last-write-wins)."""
+    collist = ", ".join(cols)
+    qs = ",".join("?" * len(cols))
+    if db.dialect == "sqlite":
+        return f"INSERT OR REPLACE INTO {table} ({collist}) VALUES ({qs})"
+    updates = ", ".join(f"{c} = EXCLUDED.{c}" for c in cols if c not in conflict)
+    return (f"INSERT INTO {table} ({collist}) VALUES ({qs}) "
+            f"ON CONFLICT ({', '.join(conflict)}) DO UPDATE SET {updates}")
+
+
+def load_project_info(db: DB, rows: Iterable[dict]) -> int:
+    n = 0
+    batch = []
+    for r in rows:
+        yaml_keys = {k: v for k, v in r.items()
+                     if k not in ("project", "first_commit_datetime", "language",
+                                  "homepage", "main_repo", "primary_contact")}
+        batch.append((r["project"], r.get("first_commit_datetime"), r.get("language"),
+                      r.get("homepage"), r.get("main_repo"), r.get("primary_contact"),
+                      json.dumps(yaml_keys) if yaml_keys else None))
+        n += 1
+    db.executeMany(
+        _upsert_sql(db, "project_info",
+                    ("project", "first_commit_datetime", "language", "homepage",
+                     "main_repo", "primary_contact", "yaml_json"),
+                    ("project",)),
+        batch,
+    )
+    return n
+
+
+def load_buildlog_data(db: DB, rows: Iterable[dict]) -> int:
+    batch = []
+    for r in rows:
+        batch.append((
+            r["name"], r["project"], r["timecreated"], r["build_type"],
+            canon_result(r.get("result")),
+            store_array(db, parse_array(r.get("modules"))),
+            store_array(db, parse_array(r.get("revisions"))),
+        ))
+    db.executeMany(
+        _upsert_sql(db, "buildlog_data",
+                    ("name", "project", "timecreated", "build_type", "result",
+                     "modules", "revisions"),
+                    ("name",)),
+        batch,
+    )
+    return len(batch)
+
+
+def load_total_coverage(db: DB, rows: Iterable[dict]) -> int:
+    batch = []
+    for r in rows:
+        def _f(key):
+            v = r.get(key)
+            return float(v) if v not in (None, "") else None
+        batch.append((r["project"], r["date"], _f("coverage"),
+                      _f("covered_line"), _f("total_line")))
+    db.executeMany(
+        _upsert_sql(db, "total_coverage",
+                    ("project", "date", "coverage", "covered_line", "total_line"),
+                    ("project", "date")),
+        batch,
+    )
+    return len(batch)
+
+
+def load_issues(db: DB, rows: Iterable[dict]) -> int:
+    batch = []
+    for r in rows:
+        batch.append((
+            r["project"], str(r["number"]), r["rts"], r.get("status"),
+            r.get("crash_type"), r.get("severity"), r.get("type"),
+            store_array(db, parse_array(r.get("regressed_build"))),
+            r.get("new_id"),
+        ))
+    db.executeMany(
+        _upsert_sql(db, "issues",
+                    ("project", "number", "rts", "status", "crash_type", "severity",
+                     "type", "regressed_build", "new_id"),
+                    ("project", "number")),
+        batch,
+    )
+    return len(batch)
+
+
+_LOADERS = {
+    "project_info": load_project_info,
+    "buildlog_data": load_buildlog_data,
+    "total_coverage": load_total_coverage,
+    "issues": load_issues,
+}
+
+
+def ingest_csv_dir(db: DB, csv_dir: str) -> dict[str, int]:
+    """Load every recognised CSV in ``csv_dir`` (named <table>.csv) into an
+    initialised schema.  Returns per-table row counts."""
+    create_schema(db)
+    counts: dict[str, int] = {}
+    for table, loader in _LOADERS.items():
+        path = os.path.join(csv_dir, f"{table}.csv")
+        if os.path.exists(path):
+            counts[table] = loader(db, _read_csv(path))
+            log.info("loaded %-16s %8d rows from %s", table, counts[table], path)
+    derive_projects(db)
+    return counts
+
+
+def derive_projects(db: DB) -> None:
+    """Rebuild the count-only ``projects`` table (queries1.py:6-11) from
+    buildlog rows.  There is no projects.csv in the collection pipeline; the
+    table is always derived."""
+    db.execute("DELETE FROM projects")
+    db.execute("INSERT INTO projects (project_name) SELECT project FROM buildlog_data")
+    db.commit()

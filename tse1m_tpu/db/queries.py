@@ -1,0 +1,231 @@
+"""Parameterized query builders.
+
+Functional superset of the reference's SQL-string module
+(``program/__module/queries1.py``), with three deliberate changes:
+
+1. Every builder returns ``(sql, params)`` — no f-string value interpolation
+   (the reference quotes values ad hoc, ``queries1.py:43,65`` — SURVEY.md
+   §2.3 flags this as injection-prone).
+2. ``DATE(col) < :limit`` comparisons are expressed as plain
+   ``col < :limit`` (equivalent for date literals, works identically on
+   sqlite and Postgres, and keeps the column indexable).
+3. One *bulk* variant per hot loop: the reference issues one query per
+   project inside Python loops (the N+1 pattern, e.g.
+   ``rq1_detection_rate.py:192-201``); the bulk builders fetch the whole
+   study ordered by (project, time) so the columnar layer can build CSR
+   segments in one pass.
+
+The reference's ``GET_VALID_ISSUES`` filters ``status IN
+('Finish','Halfway')`` (``queries1.py:76``) — a build-result enum applied to
+an issue-status column, i.e. a latent bug that always matches zero rows.  We
+do not replicate it; issue selection uses the fixed statuses used everywhere
+else (``queries1.py:40``).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from ..config import DEFAULT_LIMIT_DATE, FIXED_STATUSES, RESULT_OK
+
+Query = tuple[str, tuple]
+
+# Column whitelist for the export_type knob of total-coverage extraction
+# (reference interpolates the column name raw, queries1.py:125-126).
+_COVERAGE_COLUMNS = frozenset({"coverage", "covered_line", "total_line"})
+
+
+def _in(values: Sequence[str]) -> str:
+    # `IN ()` is a Postgres syntax error (sqlite tolerates it); emit a
+    # never-matching one-element list so empty target sets are portable.
+    if not values:
+        return "(NULL)"
+    return "(" + ",".join("?" * len(values)) + ")"
+
+
+def count_projects() -> Query:
+    # queries1.py:6-11
+    return (
+        "SELECT project_name, COUNT(*) AS frequency FROM projects "
+        "GROUP BY project_name ORDER BY frequency DESC",
+        (),
+    )
+
+
+def eligible_projects(min_days: int = 365, limit_date: str = DEFAULT_LIMIT_DATE) -> Query:
+    """Projects with >= min_days non-zero coverage days before limit_date —
+    the study-wide eligibility predicate (rq1_detection_rate.py:144-151,
+    duplicated across six reference scripts; SURVEY.md §2.3)."""
+    return (
+        "SELECT project FROM total_coverage "
+        "WHERE coverage IS NOT NULL AND coverage > 0 AND date < ? "
+        "GROUP BY project HAVING COUNT(*) >= ? "
+        "ORDER BY project",
+        (limit_date, min_days),
+    )
+
+
+def all_fuzzing_build(project: str) -> Query:
+    # queries1.py:267-278 (ALL_FUZZING_BUILD — result unfiltered)
+    return (
+        "SELECT name, timecreated FROM buildlog_data "
+        "WHERE project = ? AND build_type = 'Fuzzing' ORDER BY timecreated",
+        (project,),
+    )
+
+
+def successful_fuzzing_build(project: str) -> Query:
+    # queries1.py:61-69
+    return (
+        "SELECT name, timecreated FROM buildlog_data "
+        f"WHERE project = ? AND build_type = 'Fuzzing' AND result IN {_in(RESULT_OK)} "
+        "ORDER BY timecreated",
+        (project, *RESULT_OK),
+    )
+
+
+def all_fuzzing_builds_bulk(targets: Sequence[str]) -> Query:
+    """Bulk replacement for the Phase-1/Phase-2 per-project loops
+    (rq1_detection_rate.py:192-201,219-223)."""
+    return (
+        "SELECT project, name, timecreated FROM buildlog_data "
+        f"WHERE build_type = 'Fuzzing' AND project IN {_in(targets)} "
+        "ORDER BY project, timecreated",
+        tuple(targets),
+    )
+
+
+def coverage_builds(project: str) -> Query:
+    # queries1.py:94-102 (the live, non-shadowed GET_COVERAGE_BUILDS)
+    return (
+        "SELECT name, project, timecreated, build_type, result, modules, revisions "
+        "FROM buildlog_data "
+        "WHERE project = ? AND build_type = 'Coverage' AND result = 'Finish' "
+        "ORDER BY timecreated",
+        (project,),
+    )
+
+
+def coverage_builds_bulk(targets: Sequence[str]) -> Query:
+    return (
+        "SELECT project, name, timecreated, modules, revisions FROM buildlog_data "
+        f"WHERE build_type = 'Coverage' AND result = 'Finish' AND project IN {_in(targets)} "
+        "ORDER BY project, timecreated",
+        tuple(targets),
+    )
+
+
+def fixed_issues(targets: Sequence[str], limit_date: str = DEFAULT_LIMIT_DATE) -> Query:
+    """Fixed issues for target projects before the study cutoff
+    (rq1_detection_rate.py:172-183)."""
+    return (
+        "SELECT project, number, rts, crash_type FROM issues "
+        f"WHERE status IN {_in(FIXED_STATUSES)} AND project IN {_in(targets)} "
+        "AND rts < ? ORDER BY project, rts, number",
+        (*FIXED_STATUSES, *targets, limit_date),
+    )
+
+
+def same_date_build_issue(targets: Sequence[str], limit_date: str = DEFAULT_LIMIT_DATE) -> Query:
+    """For each fixed issue, the latest successful Fuzzing build strictly
+    before its report time (window-function join, queries1.py:15-58)."""
+    sql = (
+        "WITH matched_buildlogs AS (\n"
+        "  SELECT i.number, i.project, i.rts,\n"
+        "         bd.timecreated AS buildlog_timecreated, bd.build_type, bd.result,\n"
+        "         bd.name AS buildlog_name, bd.modules, bd.revisions,\n"
+        "         ROW_NUMBER() OVER (PARTITION BY i.project, i.number\n"
+        "                            ORDER BY bd.timecreated DESC) AS rn\n"
+        "  FROM issues i\n"
+        "  JOIN buildlog_data bd\n"
+        "    ON i.project = bd.project AND i.rts > bd.timecreated\n"
+        "   AND bd.build_type = 'Fuzzing'\n"
+        f"   AND bd.result IN {_in(RESULT_OK)}\n"
+        "   AND bd.timecreated < ?\n"
+        f"  WHERE i.status IN {_in(FIXED_STATUSES)}\n"
+        f"    AND i.project IN {_in(targets)}\n"
+        ")\n"
+        "SELECT number, project, rts, buildlog_timecreated, build_type, result,\n"
+        "       buildlog_name, modules, revisions\n"
+        "FROM matched_buildlogs WHERE rn = 1\n"
+        "ORDER BY project ASC, rts ASC"
+    )
+    return sql, (*RESULT_OK, limit_date, *FIXED_STATUSES, *targets)
+
+
+def issues_without_matching_build(targets: Sequence[str],
+                                  limit_date: str = DEFAULT_LIMIT_DATE) -> Query:
+    # queries1.py:280-314
+    sql = (
+        "SELECT i.project, i.number, i.rts, p.first_commit_datetime, i.new_id\n"
+        "FROM issues i JOIN project_info p ON i.project = p.project\n"
+        f"WHERE i.status IN {_in(FIXED_STATUSES)}\n"
+        f"  AND i.project IN {_in(targets)}\n"
+        "  AND NOT EXISTS (\n"
+        "    SELECT 1 FROM buildlog_data bd\n"
+        "    WHERE bd.project = i.project AND i.rts > bd.timecreated\n"
+        "      AND bd.build_type = 'Fuzzing'\n"
+        f"      AND bd.result IN {_in(RESULT_OK)}\n"
+        "      AND bd.timecreated < ?\n"
+        "  )\n"
+        "ORDER BY i.project ASC, i.rts ASC"
+    )
+    return sql, (*FIXED_STATUSES, *targets, *RESULT_OK, limit_date)
+
+
+def severity_issues(severity: str, targets: Sequence[str], dialect: str,
+                    limit_date: str = DEFAULT_LIMIT_DATE) -> Query:
+    """Issues of a severity that have at least one non-null regressed build
+    (queries1.py:104-118; uses unnest on Postgres, json_each on sqlite)."""
+    if dialect == "postgres":
+        exists = ("EXISTS (SELECT 1 FROM unnest(regressed_build) AS b "
+                  "WHERE b IS NOT NULL)")
+    else:
+        exists = ("regressed_build IS NOT NULL AND EXISTS ("
+                  "SELECT 1 FROM json_each(regressed_build) "
+                  "WHERE json_each.value IS NOT NULL)")
+    return (
+        "SELECT project, rts, regressed_build, severity FROM issues "
+        f"WHERE project IN {_in(targets)} AND rts < ? AND severity = ? AND {exists} "
+        "ORDER BY project, rts, number",
+        (*targets, limit_date, severity),
+    )
+
+
+def total_coverage_each_project(project: str, export_type: str,
+                                limit_date: str = DEFAULT_LIMIT_DATE) -> Query:
+    # queries1.py:120-129; export_type is a column name -> whitelisted.
+    if export_type not in _COVERAGE_COLUMNS:
+        raise ValueError(f"export_type must be one of {sorted(_COVERAGE_COLUMNS)}")
+    return (
+        "SELECT covered_line, total_line FROM total_coverage "
+        f"WHERE project = ? AND {export_type} IS NOT NULL AND {export_type} != 0 "
+        "AND date < ? ORDER BY date",
+        (project, limit_date),
+    )
+
+
+def total_coverage_bulk(targets: Sequence[str],
+                        limit_date: str = DEFAULT_LIMIT_DATE) -> Query:
+    return (
+        "SELECT project, date, coverage, covered_line, total_line FROM total_coverage "
+        f"WHERE project IN {_in(targets)} AND date < ? "
+        "AND coverage IS NOT NULL AND coverage > 0 "
+        "ORDER BY project, date",
+        (*targets, limit_date),
+    )
+
+
+def issues_bulk(targets: Sequence[str], limit_date: str = DEFAULT_LIMIT_DATE,
+                fixed_only: bool = True) -> Query:
+    statuses = FIXED_STATUSES
+    sql = (
+        "SELECT project, number, rts, status, crash_type, severity FROM issues "
+        f"WHERE project IN {_in(targets)} AND rts < ? "
+    )
+    params: tuple = (*targets, limit_date)
+    if fixed_only:
+        sql += f"AND status IN {_in(statuses)} "
+        params += statuses
+    sql += "ORDER BY project, rts, number"
+    return sql, params

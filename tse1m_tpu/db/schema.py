@@ -1,0 +1,141 @@
+"""Canonical schema for the five study tables.
+
+The reference ships no DDL — its DB arrives pre-built from a gitignored
+``backup_clean.sql`` (reference ``README.md:50-56``, ``.gitignore:6-7``); the
+schema below is the one inferred from every query call site (SURVEY.md §2.2):
+
+- ``issues``          reference producers ``5_get_issue_reports.py``; consumed
+                      by ``queries1.py:71-80,104-118,280-314``
+- ``buildlog_data``   producer ``4_get_buildlog_analysis.py:29-42``; consumed
+                      by ``queries1.py:15-69,82-102,267-278``
+- ``total_coverage``  producer ``3_get_coverage_data.py:132``; consumed by
+                      ``queries1.py:120-129``
+- ``project_info``    producer ``1_get_projects_infos.py:108-117``
+- ``projects``        count-only usage ``queries1.py:6-11``
+
+Array-valued columns (``modules``, ``revisions``, ``regressed_build``) are
+Postgres arrays in the reference; the sqlite dialect stores them as JSON text
+and the artifact writers re-emit the Postgres literal form (``{a,b}``) so
+output CSVs stay byte-compatible (see golden
+``data/result_data/rq3/change_analysis/*.csv``).
+
+The ``result`` enum is canonicalised to {Finish, Halfway, Error, Unknown}:
+the reference's analyzer emits {Success, Error, Unknown}
+(``4_get_buildlog_analysis.py:230-237``) while its queries filter
+('Finish','Halfway') (``queries1.py:4``) — ingest maps Success->Finish.
+"""
+
+from __future__ import annotations
+
+SCHEMA_TABLES = ("projects", "project_info", "buildlog_data", "total_coverage", "issues")
+
+_SQLITE_DDL = """
+CREATE TABLE IF NOT EXISTS projects (
+    project_name TEXT NOT NULL
+);
+CREATE TABLE IF NOT EXISTS project_info (
+    project TEXT PRIMARY KEY,
+    first_commit_datetime TEXT,
+    language TEXT,
+    homepage TEXT,
+    main_repo TEXT,
+    primary_contact TEXT,
+    yaml_json TEXT
+);
+CREATE TABLE IF NOT EXISTS buildlog_data (
+    name TEXT PRIMARY KEY,
+    project TEXT NOT NULL,
+    timecreated TEXT NOT NULL,
+    build_type TEXT NOT NULL,
+    result TEXT NOT NULL,
+    modules TEXT,
+    revisions TEXT
+);
+CREATE INDEX IF NOT EXISTS idx_buildlog_project_time
+    ON buildlog_data(project, build_type, timecreated);
+CREATE TABLE IF NOT EXISTS total_coverage (
+    project TEXT NOT NULL,
+    date TEXT NOT NULL,
+    coverage REAL,
+    covered_line REAL,
+    total_line REAL,
+    PRIMARY KEY (project, date)
+);
+CREATE TABLE IF NOT EXISTS issues (
+    project TEXT NOT NULL,
+    number TEXT NOT NULL,
+    rts TEXT NOT NULL,
+    status TEXT,
+    crash_type TEXT,
+    severity TEXT,
+    type TEXT,
+    regressed_build TEXT,
+    new_id TEXT,
+    PRIMARY KEY (project, number)
+);
+CREATE INDEX IF NOT EXISTS idx_issues_project_rts ON issues(project, rts);
+"""
+
+_POSTGRES_DDL = """
+CREATE TABLE IF NOT EXISTS projects (
+    project_name TEXT NOT NULL
+);
+CREATE TABLE IF NOT EXISTS project_info (
+    project TEXT PRIMARY KEY,
+    first_commit_datetime TIMESTAMPTZ,
+    language TEXT,
+    homepage TEXT,
+    main_repo TEXT,
+    primary_contact TEXT,
+    yaml_json TEXT
+);
+CREATE TABLE IF NOT EXISTS buildlog_data (
+    name TEXT PRIMARY KEY,
+    project TEXT NOT NULL,
+    timecreated TIMESTAMPTZ NOT NULL,
+    build_type TEXT NOT NULL,
+    result TEXT NOT NULL,
+    modules TEXT[],
+    revisions TEXT[]
+);
+CREATE INDEX IF NOT EXISTS idx_buildlog_project_time
+    ON buildlog_data(project, build_type, timecreated);
+CREATE TABLE IF NOT EXISTS total_coverage (
+    project TEXT NOT NULL,
+    date DATE NOT NULL,
+    coverage DOUBLE PRECISION,
+    covered_line DOUBLE PRECISION,
+    total_line DOUBLE PRECISION,
+    PRIMARY KEY (project, date)
+);
+CREATE TABLE IF NOT EXISTS issues (
+    project TEXT NOT NULL,
+    number TEXT NOT NULL,
+    rts TIMESTAMPTZ NOT NULL,
+    status TEXT,
+    crash_type TEXT,
+    severity TEXT,
+    type TEXT,
+    regressed_build TEXT[],
+    new_id TEXT,
+    PRIMARY KEY (project, number)
+);
+CREATE INDEX IF NOT EXISTS idx_issues_project_rts ON issues(project, rts);
+"""
+
+
+def ddl(dialect: str) -> str:
+    if dialect == "sqlite":
+        return _SQLITE_DDL
+    if dialect == "postgres":
+        return _POSTGRES_DDL
+    raise ValueError(f"unknown dialect {dialect!r}")
+
+
+def create_schema(db) -> None:
+    """Create all study tables on an open tse1m_tpu.db.DB connection."""
+    for statement in ddl(db.dialect).split(";"):
+        stmt = statement.strip()
+        if stmt:
+            db.execute(stmt)
+    db.commit()

@@ -1,0 +1,5 @@
+from .logging import get_logger
+from .timing import PhaseTimer
+from .manifest import RunManifest
+
+__all__ = ["get_logger", "PhaseTimer", "RunManifest"]

@@ -1,0 +1,37 @@
+"""Structured logging.
+
+The reference mixes bare ``print`` with three ad-hoc ``logging.basicConfig``
+calls (SURVEY.md §5).  Here every module gets a namespaced logger with one
+consistent format, configurable via TSE1M_LOG_LEVEL.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_CONFIGURED = False
+
+
+def _configure_root() -> None:
+    global _CONFIGURED
+    if _CONFIGURED:
+        return
+    level = os.environ.get("TSE1M_LOG_LEVEL", "INFO").upper()
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(
+        logging.Formatter("%(asctime)s %(levelname)-7s %(name)s: %(message)s", "%H:%M:%S")
+    )
+    root = logging.getLogger("tse1m")
+    root.setLevel(level)
+    root.addHandler(handler)
+    root.propagate = False
+    _CONFIGURED = True
+
+
+def get_logger(name: str) -> logging.Logger:
+    _configure_root()
+    if not name.startswith("tse1m"):
+        name = f"tse1m.{name}"
+    return logging.getLogger(name)

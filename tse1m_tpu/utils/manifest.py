@@ -1,0 +1,59 @@
+"""Run manifests: a JSON record of every analysis run (config, backend,
+device topology, phase timings, artifact paths, row counts) saved alongside
+the artifacts.  The reference has no equivalent; its only record of a run is
+a pasted console transcript (rq1_detection_rate.py:354-412)."""
+
+from __future__ import annotations
+
+import json
+import os
+import platform
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass
+class RunManifest:
+    name: str
+    backend: str
+    extra: dict[str, Any] = field(default_factory=dict)
+    artifacts: list[str] = field(default_factory=list)
+    started_at: float = field(default_factory=time.time)
+
+    def add_artifact(self, path: str) -> None:
+        self.artifacts.append(path)
+
+    def record(self, **kwargs: Any) -> None:
+        self.extra.update(kwargs)
+
+    def _device_info(self) -> dict[str, Any]:
+        try:
+            import jax
+
+            return {
+                "platform": jax.default_backend(),
+                "device_count": jax.device_count(),
+                "devices": [str(d) for d in jax.devices()],
+            }
+        except Exception:  # jax absent or uninitialised — manifest still valid
+            return {}
+
+    def save(self, out_dir: str, timings: dict[str, float] | None = None) -> str:
+        os.makedirs(out_dir, exist_ok=True)
+        payload = {
+            "name": self.name,
+            "backend": self.backend,
+            "started_at": self.started_at,
+            "wall_seconds": time.time() - self.started_at,
+            "host": platform.node(),
+            "python": platform.python_version(),
+            "jax": self._device_info(),
+            "timings": timings or {},
+            "artifacts": self.artifacts,
+            **self.extra,
+        }
+        path = os.path.join(out_dir, f"{self.name}_manifest.json")
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=2, default=str)
+        return path

@@ -1,0 +1,43 @@
+"""Phase timing + optional JAX profiler hooks.
+
+Replaces the reference's tqdm-wall-clock-only observability
+(rq1_detection_rate.py:361,367 transcripts) with structured per-phase
+timings that are also written into the run manifest.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from dataclasses import dataclass, field
+
+from .logging import get_logger
+
+log = get_logger("timing")
+
+
+@dataclass
+class PhaseTimer:
+    """Collects named phase durations; optionally wraps phases in a
+    jax.profiler trace when TSE1M_PROFILE_DIR is set."""
+
+    phases: dict[str, float] = field(default_factory=dict)
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        profile_dir = os.environ.get("TSE1M_PROFILE_DIR")
+        trace_ctx = contextlib.nullcontext()
+        if profile_dir:
+            import jax
+
+            trace_ctx = jax.profiler.trace(os.path.join(profile_dir, name))
+        start = time.perf_counter()
+        with trace_ctx:
+            yield
+        elapsed = time.perf_counter() - start
+        self.phases[name] = self.phases.get(name, 0.0) + elapsed
+        log.info("phase %-32s %8.3fs", name, elapsed)
+
+    def as_dict(self) -> dict[str, float]:
+        return dict(self.phases)
