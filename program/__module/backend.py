@@ -1,0 +1,14 @@
+"""Thin dispatcher module (north star, BASELINE.json): research-question
+scripts import this to get the configured backend without knowing whether
+pandas or jax_tpu answers.  Mirrors the reference's ``program/__module``
+import pattern (rq1_detection_rate.py:12-17)."""
+
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+from tse1m_tpu.backend import get_backend  # noqa: E402,F401
+from tse1m_tpu.config import load_config  # noqa: E402,F401

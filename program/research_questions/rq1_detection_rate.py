@@ -1,0 +1,24 @@
+"""RQ1 entry point — drop-in replacement for the reference's
+``program/research_questions/rq1_detection_rate.py``; the engine lives in
+``tse1m_tpu.analysis.rq1`` and is selected by envFile.ini's backend key."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+from tse1m_tpu.analysis.rq1 import run_rq1  # noqa: E402
+from tse1m_tpu.config import load_config  # noqa: E402
+
+# Reference TEST_MODE switch (rq1_detection_rate.py:20), overridable via env.
+TEST_MODE = os.environ.get("TSE1M_TEST_MODE", "").lower() in ("1", "true", "yes")
+
+
+def main():
+    cfg = load_config()
+    cfg.test_mode = cfg.test_mode or TEST_MODE
+    run_rq1(cfg)
+
+
+if __name__ == "__main__":
+    main()

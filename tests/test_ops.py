@@ -1,0 +1,74 @@
+"""Device segment ops vs numpy oracles."""
+
+import numpy as np
+import pytest
+
+from tse1m_tpu.ops.segment import (counts_to_survival, masked_percentile,
+                                   segment_searchsorted,
+                                   unique_pairs_count_per_iteration)
+
+
+@pytest.mark.parametrize("side", ["left", "right"])
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_segment_searchsorted_matches_numpy(side, seed):
+    r = np.random.default_rng(seed)
+    P = 9
+    counts = r.integers(0, 40, size=P)  # include empty segments
+    offsets = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+    values = np.concatenate([np.sort(r.integers(0, 1000, size=c)) for c in counts]) \
+        if counts.sum() else np.empty(0, np.int64)
+    Q = 200
+    qseg = r.integers(0, P, size=Q)
+    queries = r.integers(-10, 1010, size=Q)
+
+    got = np.asarray(segment_searchsorted(values.astype(np.int32), offsets,
+                                          queries.astype(np.int32), qseg, side=side))
+    want = np.array([
+        np.searchsorted(values[offsets[s]:offsets[s + 1]], q, side=side)
+        for s, q in zip(qseg, queries)
+    ])
+    np.testing.assert_array_equal(got, want)
+
+
+def test_segment_searchsorted_empty_values():
+    out = segment_searchsorted(np.empty(0, np.int32), np.zeros(4, np.int64),
+                               np.array([5, 7], np.int32), np.array([0, 2]))
+    np.testing.assert_array_equal(np.asarray(out), [0, 0])
+
+
+def test_counts_to_survival():
+    counts = np.array([3, 1, 5, 0, 3])
+    got = np.asarray(counts_to_survival(counts, 5))
+    # k=1: 4 segments with >=1; k=2: 3; k=3: 3; k=4: 1; k=5: 1
+    np.testing.assert_array_equal(got, [4, 3, 3, 1, 1])
+
+
+def test_unique_pairs_count():
+    segs = np.array([0, 0, 1, 2, 2, 2, 1])
+    iters = np.array([1, 1, 1, 2, 2, 9, 0])  # 9 > max_k ignored; 0 ignored
+    got = np.asarray(unique_pairs_count_per_iteration(segs, iters, 3, 4))
+    # iter1: segments {0,1} -> 2; iter2: {2} -> 1
+    np.testing.assert_array_equal(got, [2, 1, 0, 0])
+
+
+@pytest.mark.parametrize("q", [25.0, 50.0, 75.0, 90.0])
+def test_masked_percentile_matches_numpy(q, rng):
+    R, C = 12, 50
+    x = rng.normal(size=(R, C)).astype(np.float32)
+    mask = rng.random((R, C)) < 0.7
+    mask[3] = False  # fully-masked row
+    got = np.asarray(masked_percentile(x, mask, q))
+    for i in range(R):
+        if mask[i].sum() == 0:
+            assert np.isnan(got[i])
+        else:
+            np.testing.assert_allclose(got[i], np.percentile(x[i][mask[i]], q),
+                                       rtol=1e-5)
+
+
+def test_masked_percentile_vector_q(rng):
+    x = rng.normal(size=(4, 20)).astype(np.float32)
+    mask = np.ones_like(x, dtype=bool)
+    got = np.asarray(masked_percentile(x, mask, np.array([25.0, 75.0])))
+    assert got.shape == (2, 4)
+    np.testing.assert_allclose(got[0], np.percentile(x, 25, axis=1), rtol=1e-5)

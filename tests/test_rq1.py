@@ -1,0 +1,172 @@
+"""RQ1: backend parity (pandas vs jax), oracle correctness, artifacts."""
+
+import os
+
+import numpy as np
+import pytest
+
+from tse1m_tpu.analysis.common import StudyContext, limit_date_ns
+from tse1m_tpu.analysis.rq1 import run_rq1
+from tse1m_tpu.backend.jax_backend import JaxBackend
+from tse1m_tpu.backend.pandas_backend import PandasBackend
+from tse1m_tpu.config import Config, RESULT_OK
+from tse1m_tpu.data.columnar import StudyArrays
+
+
+LIMIT = "2026-01-01"
+
+
+@pytest.fixture(scope="module")
+def arrays(study_db):
+    cfg = Config(engine="sqlite", sqlite_path=study_db.config.sqlite_path,
+                 limit_date=LIMIT)
+    return StudyArrays.from_db(study_db, cfg)
+
+
+@pytest.fixture(scope="module")
+def limit_ns():
+    return int(np.datetime64(LIMIT, "ns").astype(np.int64))
+
+
+def test_backend_parity(arrays, limit_ns):
+    res_pd = PandasBackend().rq1_detection(arrays, limit_ns, min_projects=2)
+    res_jx = JaxBackend().rq1_detection(arrays, limit_ns, min_projects=2)
+    np.testing.assert_array_equal(res_pd.iterations, res_jx.iterations)
+    np.testing.assert_array_equal(res_pd.total_projects, res_jx.total_projects)
+    np.testing.assert_array_equal(res_pd.detected_counts, res_jx.detected_counts)
+    np.testing.assert_array_equal(res_pd.iteration_of_issue, res_jx.iteration_of_issue)
+    np.testing.assert_array_equal(res_pd.link_idx, res_jx.link_idx)
+
+
+def test_oracle_reference_semantics(arrays, limit_ns, study_db):
+    """Brute-force re-derivation of the reference's rules straight from DB
+    rows (independent of the columnar layer)."""
+    res = PandasBackend().rq1_detection(arrays, limit_ns, min_projects=1)
+    pidx = arrays.project_index()
+
+    rows = study_db.query(
+        "SELECT project, timecreated, result FROM buildlog_data "
+        "WHERE build_type='Fuzzing' ORDER BY project, timecreated")
+    import pandas as pd
+
+    builds_by_proj = {}
+    for proj, tc, result in rows:
+        builds_by_proj.setdefault(proj, []).append(
+            (pd.Timestamp(tc).value, result))
+
+    # Phase-1 totals: iteration k slot per project with >= k builds
+    # (only eligible projects).
+    totals = {}
+    for proj in arrays.projects:
+        for k in range(1, len(builds_by_proj.get(proj, [])) + 1):
+            totals[k] = totals.get(k, 0) + 1
+
+    # Issue mapping with reference rules.
+    detected = {}
+    irows = study_db.query(
+        "SELECT project, rts FROM issues WHERE status IN ('Fixed','Fixed (Verified)') "
+        "AND rts < ? ORDER BY project, rts, number", (LIMIT,))
+    checked = 0
+    for proj, rts in irows:
+        if proj not in pidx:
+            continue
+        t = pd.Timestamp(rts).value
+        blds = builds_by_proj.get(proj, [])
+        iteration = sum(1 for bt, _ in blds if t > bt)
+        linked = any(bt < t and r in RESULT_OK and bt < limit_ns for bt, r in blds)
+        if linked and iteration > 0:
+            detected.setdefault(iteration, set()).add(proj)
+        checked += 1
+    assert checked == len(arrays.issues)
+
+    got_totals = dict(zip(res.iterations.tolist(), res.total_projects.tolist()))
+    assert got_totals == {k: v for k, v in totals.items()}
+    got_detected = dict(zip(res.iterations.tolist(), res.detected_counts.tolist()))
+    for k in got_totals:
+        assert got_detected[k] == len(detected.get(k, set())), f"iteration {k}"
+
+
+@pytest.mark.parametrize("backend", ["pandas", "jax_tpu"])
+def test_run_rq1_end_to_end(backend, study_db, tmp_path):
+    cfg = Config(engine="sqlite", sqlite_path=study_db.config.sqlite_path,
+                 limit_date=LIMIT, backend=backend,
+                 result_dir=str(tmp_path / backend))
+    cfg.min_projects_per_iteration = 2
+    out = run_rq1(cfg, db=study_db)
+    stats = out["stats_csv"]
+    assert os.path.exists(stats)
+    with open(stats) as f:
+        header = f.readline().strip()
+    assert header == "Iteration,Total_Projects,Detected_Projects_Count"
+    assert os.path.exists(os.path.join(os.path.dirname(stats), "rq1_detection_rate.pdf"))
+    assert os.path.exists(os.path.join(os.path.dirname(stats), "rq1_manifest.json"))
+
+
+def test_backend_parity_zero_issues(arrays, limit_ns):
+    """Phase-1 totals must be computed even with no issues (the reference
+    computes them independently of issues, rq1:189-201)."""
+    import copy
+
+    a = copy.copy(arrays)
+    from tse1m_tpu.data.columnar import Segmented
+
+    a.issues = Segmented(
+        offsets=np.zeros(arrays.n_projects + 1, dtype=np.int64),
+        columns={"time_ns": np.empty(0, np.int64),
+                 "number": np.empty(0, object),
+                 "status": np.empty(0, object),
+                 "crash_type": np.empty(0, object)})
+    res_pd = PandasBackend().rq1_detection(a, limit_ns, min_projects=2)
+    res_jx = JaxBackend().rq1_detection(a, limit_ns, min_projects=2)
+    assert len(res_pd.iterations) > 0
+    np.testing.assert_array_equal(res_pd.iterations, res_jx.iterations)
+    np.testing.assert_array_equal(res_pd.total_projects, res_jx.total_projects)
+    assert res_pd.detected_counts.sum() == res_jx.detected_counts.sum() == 0
+
+
+def test_backend_parity_subsecond_ordering():
+    """Builds and issues within the same second must order by nanoseconds on
+    both backends (two-lane int32 comparison on device)."""
+    from tse1m_tpu.data.columnar import Segmented, StudyArrays
+
+    base = int(np.datetime64("2024-03-01T12:00:00", "ns").astype(np.int64))
+    ms = 1_000_000
+    build_ns = np.array([base + 100 * ms, base + 500 * ms, base + 900 * ms])
+    # issue at +600ms: pandas sees 2 builds strictly before.
+    issue_ns = np.array([base + 600 * ms])
+    arrays = StudyArrays(
+        projects=["p0"],
+        fuzz=Segmented(np.array([0, 3]), {
+            "time_ns": build_ns,
+            "name": np.array(["a", "b", "c"], object),
+            "result": np.array(["Finish"] * 3, object),
+            "ok": np.ones(3, bool),
+            "modules_raw": np.array([""] * 3, object),
+            "revisions_raw": np.array([""] * 3, object)}),
+        covb=Segmented(np.array([0, 0]), {}),
+        issues=Segmented(np.array([0, 1]), {
+            "time_ns": issue_ns,
+            "number": np.array(["1"], object),
+            "status": np.array(["Fixed"], object),
+            "crash_type": np.array([""], object)}),
+        cov=Segmented(np.array([0, 0]), {}),
+    )
+    limit = int(np.datetime64("2025-01-08", "ns").astype(np.int64))
+    res_pd = PandasBackend().rq1_detection(arrays, limit, min_projects=1)
+    res_jx = JaxBackend().rq1_detection(arrays, limit, min_projects=1)
+    assert res_pd.iteration_of_issue[0] == 2
+    np.testing.assert_array_equal(res_pd.iteration_of_issue, res_jx.iteration_of_issue)
+    np.testing.assert_array_equal(res_pd.link_idx, res_jx.link_idx)
+    assert res_pd.link_idx[0] == 1  # the +500ms build, not the +900ms one
+
+
+def test_run_rq1_backends_identical_artifacts(study_db, tmp_path):
+    outs = {}
+    for backend in ("pandas", "jax_tpu"):
+        cfg = Config(engine="sqlite", sqlite_path=study_db.config.sqlite_path,
+                     limit_date=LIMIT, backend=backend,
+                     result_dir=str(tmp_path / ("r_" + backend)))
+        cfg.min_projects_per_iteration = 2
+        outs[backend] = run_rq1(cfg, db=study_db)["stats_csv"]
+    with open(outs["pandas"]) as a, open(outs["jax_tpu"]) as b:
+        assert a.read() == b.read()

@@ -1,0 +1,100 @@
+"""Shared analysis plumbing: study context (config + DB + columnar arrays),
+artifact helpers, and the study-design printout mirrored from the reference
+transcript (rq1_detection_rate.py:121-153)."""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+import numpy as np
+import pandas as pd
+
+from ..backend import get_backend
+from ..backend.base import Backend
+from ..config import Config, FIXED_STATUSES, load_config
+from ..data.columnar import StudyArrays
+from ..db import queries
+from ..db.connection import DB
+from ..utils.logging import get_logger
+
+log = get_logger("analysis")
+
+
+def limit_date_ns(cfg: Config) -> int:
+    return int(np.datetime64(cfg.limit_date, "ns").astype(np.int64))
+
+
+def fmt_ts_ns(ns: int) -> str:
+    """Format an epoch-ns timestamp like psycopg2's str(datetime): seconds,
+    with fractional part only when non-zero (golden CSVs show both forms)."""
+    t = pd.Timestamp(ns)
+    base = t.strftime("%Y-%m-%d %H:%M:%S")
+    if t.microsecond:
+        return f"{base}.{t.microsecond:06d}"
+    return base
+
+
+@dataclass
+class StudyContext:
+    cfg: Config
+    db: DB
+    backend: Backend
+    projects: list[str]
+    arrays: StudyArrays
+
+    @classmethod
+    def open(cls, cfg: Config | None = None, db: DB | None = None,
+             announce: bool = True) -> "StudyContext":
+        cfg = cfg or load_config()
+        own_db = db is None
+        if own_db:
+            db = DB(config=cfg).connect()
+        try:
+            db.query("SELECT 1 FROM issues LIMIT 1")
+        except Exception as e:
+            raise SystemExit(
+                f"study database not initialised ({e}). Populate it first: "
+                "`python -m tse1m_tpu.cli synth` for a synthetic study or "
+                "`python -m tse1m_tpu.cli ingest --csv-dir ...` for collector CSVs."
+            ) from e
+
+        if announce:
+            n_all, p_all = _issue_counts(db, cfg, fixed=False)
+            n_fix, p_fix = _issue_counts(db, cfg, fixed=True)
+            print(f"Found {n_all:,} issues from {p_all:,} projects before "
+                  f"{cfg.limit_date}. (in study design)")
+            print(f"Found {n_fix:,} fixed issues from {p_fix:,} projects before "
+                  f"{cfg.limit_date}. (in study design)")
+
+        sql, params = queries.eligible_projects(cfg.min_coverage_days, cfg.limit_date)
+        projects = sorted(r[0] for r in db.query(sql, params))
+        if announce:
+            print(f"Found {len(projects):,} projects with at least "
+                  f"{cfg.min_coverage_days} coverage reports.")
+        if cfg.test_mode:
+            projects = projects[:10]
+            print(f"[TEST MODE] Limiting to the first {len(projects)} projects.")
+
+        arrays = StudyArrays.from_db(db, cfg, projects=projects)
+        return cls(cfg=cfg, db=db, backend=get_backend(cfg), projects=projects,
+                   arrays=arrays)
+
+    @property
+    def min_projects(self) -> int:
+        return 1 if self.cfg.test_mode else self.cfg.min_projects_per_iteration
+
+    def out_dir(self, sub: str) -> str:
+        path = os.path.join(self.cfg.result_dir, sub)
+        os.makedirs(path, exist_ok=True)
+        return path
+
+
+def _issue_counts(db: DB, cfg: Config, fixed: bool) -> tuple[int, int]:
+    sql = "SELECT COUNT(*), COUNT(DISTINCT project) FROM issues WHERE rts < ?"
+    params: tuple = (cfg.limit_date,)
+    if fixed:
+        sql += f" AND status IN {queries._in(FIXED_STATUSES)}"
+        params += FIXED_STATUSES
+    (n, p), = db.query(sql, params)
+    return n, p

@@ -1,0 +1,178 @@
+"""RQ1 — vulnerability detection rate over fuzzing iterations.
+
+Re-implementation of ``program/research_questions/rq1_detection_rate.py``
+over backend primitives.  Artifact parity:
+
+- ``rq1_detection_rate_stats.csv`` — header
+  ``Iteration,Total_Projects,Detected_Projects_Count`` (rq1:330-335;
+  golden file first data row ``1,878,297``).
+- ``rq1_raw_issues_for_analysis.csv`` — generic ``issue_i`` header over the
+  SAME_DATE_BUILD_ISSUE row shape (rq1:23-43; queries1.py:45-57).
+- ``rq1_detection_rate.pdf`` — the Figure-6 dual-axis plot (rq1:46-98).
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+
+import numpy as np
+
+from .common import StudyContext, fmt_ts_ns, limit_date_ns
+from ..config import Config
+from ..db.ingest import parse_array, pg_array_literal
+from ..utils.logging import get_logger
+from ..utils.manifest import RunManifest
+from ..utils.timing import PhaseTimer
+
+log = get_logger("rq1")
+
+
+def save_raw_issues_csv(ctx: StudyContext, result, path: str) -> int:
+    """Linked issues with their matched build, ordered by (project, rts) —
+    the reference's raw-issues artifact (rq1:23-43)."""
+    issues = ctx.arrays.issues
+    fuzz = ctx.arrays.fuzz
+    rows = []
+    for p in range(ctx.arrays.n_projects):
+        lo, hi = issues.offsets[p], issues.offsets[p + 1]
+        for j in range(lo, hi):
+            bi = result.link_idx[j]
+            if bi < 0:
+                continue
+            rows.append([
+                issues.columns["number"][j],
+                ctx.projects[p],
+                fmt_ts_ns(int(issues.columns["time_ns"][j])),
+                fmt_ts_ns(int(fuzz.columns["time_ns"][bi])),
+                "Fuzzing",
+                fuzz.columns["result"][bi],
+                fuzz.columns["name"][bi],
+                pg_array_literal(parse_array(fuzz.columns["modules_raw"][bi])),
+                pg_array_literal(parse_array(fuzz.columns["revisions_raw"][bi])),
+            ])
+    if not rows:
+        log.warning("no linked issues; skipping %s", path)
+        return 0
+    header = [f"issue_{i}" for i in range(len(rows[0]))]
+    with open(path, "w", newline="", encoding="utf-8") as f:
+        w = csv.writer(f)
+        w.writerow(header)
+        w.writerows(rows)
+    return len(rows)
+
+
+def save_stats_csv(result, path: str) -> None:
+    with open(path, "w", newline="", encoding="utf-8") as f:
+        w = csv.writer(f)
+        w.writerow(["Iteration", "Total_Projects", "Detected_Projects_Count"])
+        for it, tot, det in zip(result.iterations, result.total_projects,
+                                result.detected_counts):
+            w.writerow([int(it), int(tot), int(det)])
+
+
+def create_detection_rate_graph(result, path: str, file_format: str = "pdf") -> None:
+    """Figure 6: detection-rate line on the primary axis over a
+    project-population bar chart on the secondary axis (rq1:46-98)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    rates = result.detection_rates
+    fig, ax1 = plt.subplots(figsize=(5, 3))
+    ax2 = ax1.twinx()
+    ax1.set_zorder(ax2.get_zorder() + 1)
+    ax1.patch.set_visible(False)
+    ax1.plot(range(len(rates)), rates, color="b", marker="o", markersize=1.0,
+             linewidth=1)
+    ax1.set_ylabel("Percentage of Projects Detecting Bugs", y=0.45)
+    ax1.set_xlabel("Fuzzing Session")
+    ax2.bar(range(len(result.total_projects)), result.total_projects,
+            color="#88c778", alpha=0.6)
+    ax2.set_ylabel("Number of Projects")
+    plt.tight_layout(pad=0.1)
+    plt.savefig(path, format=file_format)
+    plt.close(fig)
+
+
+def late_stage_stats(result, threshold_pct: float = 5.0) -> dict:
+    """Late-stage IQR/median/zero-rate block (rq1:241-268): stats over rates
+    from the first iteration whose rate drops below the threshold."""
+    rates = result.detection_rates
+    below = np.flatnonzero(rates < threshold_pct)
+    if len(below) == 0 or len(rates) == 0:
+        return {}
+    start = below[0]
+    late = rates[start:]
+    return {
+        "first_below_iteration": int(result.iterations[start]),
+        "min": float(late.min()),
+        "max": float(late.max()),
+        "p25": float(np.percentile(late, 25)),
+        "p75": float(np.percentile(late, 75)),
+        "median": float(np.median(late)),
+        "mean": float(late.mean()),
+        "zero_fraction": float((late == 0).mean()),
+    }
+
+
+def run_rq1(cfg: Config | None = None, db=None) -> dict:
+    timer = PhaseTimer()
+    with timer.phase("extract"):
+        ctx = StudyContext.open(cfg, db=db)
+    manifest = RunManifest("rq1", ctx.backend.name)
+
+    with timer.phase("detect_kernel"):
+        result = ctx.backend.rq1_detection(
+            ctx.arrays, limit_date_ns(ctx.cfg), ctx.min_projects)
+
+    n_issues = len(ctx.arrays.issues)
+    n_linked = int(result.linked.sum())
+    total_builds = int(len(ctx.arrays.fuzz))
+    print(f"{ctx.arrays.n_projects:,} projects have {total_builds:,} "
+          f"fuzzing builds. (in abstract)")
+    if n_issues:
+        print(f"linked {n_linked:,}({n_linked / n_issues * 100:.2f}%) issues "
+              f"to buildlog data. {n_linked}/{n_issues}")
+    print(f"Retained {len(result.iterations):,} iterations for the final analysis.")
+
+    out_dir = ctx.out_dir("rq1")
+    with timer.phase("artifacts"):
+        stats_path = os.path.join(out_dir, "rq1_detection_rate_stats.csv")
+        save_stats_csv(result, stats_path)
+        manifest.add_artifact(stats_path)
+
+        raw_path = os.path.join(out_dir, "rq1_raw_issues_for_analysis.csv")
+        n_raw = save_raw_issues_csv(ctx, result, raw_path)
+        if n_raw:
+            manifest.add_artifact(raw_path)
+
+        pdf_path = os.path.join(out_dir, "rq1_detection_rate.pdf")
+        create_detection_rate_graph(result, pdf_path)
+        manifest.add_artifact(pdf_path)
+
+    late = late_stage_stats(result)
+    if late:
+        print(f"Late-stage (from iteration {late['first_below_iteration']}): "
+              f"median {late['median']:.2f}%, IQR {late['p25']:.2f}-{late['p75']:.2f}%, "
+              f"mean {late['mean']:.2f}%, zero {late['zero_fraction'] * 100:.2f}%")
+
+    manifest.record(
+        n_projects=ctx.arrays.n_projects,
+        n_fuzz_builds=total_builds,
+        n_issues=n_issues,
+        n_linked=n_linked,
+        n_iterations=len(result.iterations),
+        late_stage=late,
+    )
+    manifest.save(out_dir, timer.as_dict())
+    return {"result": result, "late": late, "stats_csv": stats_path}
+
+
+def main() -> None:
+    run_rq1()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,20 @@
+"""The {pandas, jax_tpu} dispatcher (north star, BASELINE.json): analysis
+scripts call :func:`get_backend` and receive the primitive set; which engine
+answers is decided by ``program/envFile.ini`` / ``TSE1M_BACKEND``."""
+
+from __future__ import annotations
+
+from ..config import Config
+
+
+def get_backend(cfg: Config):
+    if cfg.backend == "jax_tpu":
+        from .jax_backend import JaxBackend
+
+        return JaxBackend()
+    from .pandas_backend import PandasBackend
+
+    return PandasBackend()
+
+
+__all__ = ["get_backend"]

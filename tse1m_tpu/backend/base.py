@@ -1,0 +1,53 @@
+"""Backend primitive interface + shared result types.
+
+Each primitive corresponds to a hot loop in the reference (SURVEY.md §3);
+both engines must agree exactly (parity-tested on fixtures).
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..data.columnar import StudyArrays
+
+
+@dataclass
+class RQ1Result:
+    """Per-iteration detection stats (rq1_detection_rate.py:189-268).
+
+    iterations: retained 1-based iteration numbers (>= min-projects filter),
+    ascending; total_projects / detected_counts align with it.
+    iteration_of_issue: for every fixed issue row in arrays.issues, the
+    number of fuzzing builds strictly before its report time.
+    link_idx: index into arrays.fuzz rows of the latest *successful* build
+    strictly before the report (and before the study cutoff), -1 if none —
+    the SAME_DATE_BUILD_ISSUE join (queries1.py:15-58).
+    """
+
+    iterations: np.ndarray
+    total_projects: np.ndarray
+    detected_counts: np.ndarray
+    iteration_of_issue: np.ndarray
+    link_idx: np.ndarray
+
+    @property
+    def detection_rates(self) -> np.ndarray:
+        with np.errstate(invalid="ignore", divide="ignore"):
+            return np.where(self.total_projects > 0,
+                            self.detected_counts / self.total_projects * 100.0, 0.0)
+
+    @property
+    def linked(self) -> np.ndarray:
+        return self.link_idx >= 0
+
+
+class Backend(abc.ABC):
+    name: str
+
+    @abc.abstractmethod
+    def rq1_detection(self, arrays: StudyArrays, limit_date_ns: int,
+                      min_projects: int) -> RQ1Result:
+        ...

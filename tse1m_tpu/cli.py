@@ -1,0 +1,133 @@
+"""Command-line interface.
+
+The reference has no CLI beyond ``python3 <script>`` (run_all_analysis.sh);
+this adds the operational commands the rebuild needs:
+
+  python -m tse1m_tpu.cli synth   --db data/database/tse1m.sqlite [--projects N --days D]
+  python -m tse1m_tpu.cli ingest  --db ... --csv-dir data/processed_data/csv
+  python -m tse1m_tpu.cli rq1 [rq2a rq2b rq3 rq4a rq4b all]
+  python -m tse1m_tpu.cli cluster --n 100000   (north-star session dedup)
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .config import load_config
+from .db.connection import DB
+from .utils.logging import get_logger
+
+log = get_logger("cli")
+
+
+def _cmd_synth(args) -> int:
+    from .data.synth import SynthSpec, generate_study
+
+    cfg = load_config()
+    if args.db:
+        cfg.sqlite_path = args.db
+    spec = SynthSpec(n_projects=args.projects, days=args.days, seed=args.seed)
+    log.info("generating synthetic study: %d projects x %d days", spec.n_projects, spec.days)
+    study = generate_study(spec)
+    db = DB(config=cfg).connect()
+    study.to_db(db)
+    db.closeConnection()
+    log.info("loaded into %s: %d builds, %d issues, %d coverage rows",
+             cfg.sqlite_path, len(study.buildlog_data), len(study.issues),
+             len(study.total_coverage))
+    if args.csv_dir:
+        study.to_csv_dir(args.csv_dir)
+        log.info("CSV copies in %s", args.csv_dir)
+    return 0
+
+
+def _cmd_ingest(args) -> int:
+    from .db.ingest import ingest_csv_dir
+
+    cfg = load_config()
+    if args.db:
+        cfg.sqlite_path = args.db
+    db = DB(config=cfg).connect()
+    counts = ingest_csv_dir(db, args.csv_dir)
+    db.closeConnection()
+    log.info("ingested: %s", counts)
+    return 0
+
+
+def _cmd_rq(args) -> int:
+    cfg = load_config()
+    if args.db:
+        cfg.sqlite_path = args.db
+    if args.backend:
+        cfg.backend = args.backend
+    import importlib
+
+    runners = {}
+    specs = {
+        "rq1": ("tse1m_tpu.analysis.rq1", "run_rq1"),
+        "rq2a": ("tse1m_tpu.analysis.rq2_changepoints", "run_rq2_changepoints"),
+        "rq2b": ("tse1m_tpu.analysis.rq2_trends", "run_rq2_trends"),
+        "rq3": ("tse1m_tpu.analysis.rq3", "run_rq3"),
+        "rq4a": ("tse1m_tpu.analysis.rq4a", "run_rq4a"),
+        "rq4b": ("tse1m_tpu.analysis.rq4b", "run_rq4b"),
+    }
+    wanted = list(specs) if args.cmd == "all" else [args.cmd]
+    for name in wanted:
+        mod_name, fn_name = specs[name]
+        try:
+            runners[name] = getattr(importlib.import_module(mod_name), fn_name)
+        except ModuleNotFoundError as e:
+            if e.name == mod_name:
+                log.error("%s is not implemented yet (%s missing)", name, mod_name)
+                return 1
+            raise  # a real dependency failure inside the module — surface it
+    for name, fn in runners.items():
+        log.info("=== %s (backend=%s) ===", name, cfg.backend)
+        fn(cfg)
+    return 0
+
+
+def _cmd_cluster(args) -> int:
+    try:
+        from .models.session_dedup import run_dedup_demo
+    except ModuleNotFoundError:
+        log.error("session dedup model not implemented yet")
+        return 1
+    return run_dedup_demo(n_sessions=args.n, seed=args.seed)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tse1m")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("synth", help="generate + load a synthetic study")
+    p.add_argument("--db", default=None)
+    p.add_argument("--projects", type=int, default=24)
+    p.add_argument("--days", type=int, default=450)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--csv-dir", default=None)
+    p.set_defaults(fn=_cmd_synth)
+
+    p = sub.add_parser("ingest", help="load collector CSVs into the DB")
+    p.add_argument("--db", default=None)
+    p.add_argument("--csv-dir", required=True)
+    p.set_defaults(fn=_cmd_ingest)
+
+    for name in ("rq1", "rq2a", "rq2b", "rq3", "rq4a", "rq4b", "all"):
+        p = sub.add_parser(name, help=f"run {name} analysis")
+        p.add_argument("--db", default=None)
+        p.add_argument("--backend", choices=("pandas", "jax_tpu"), default=None)
+        p.set_defaults(fn=_cmd_rq)
+
+    p = sub.add_parser("cluster", help="MinHash+LSH session dedup demo")
+    p.add_argument("--n", type=int, default=100_000)
+    p.add_argument("--seed", type=int, default=0)
+    p.set_defaults(fn=_cmd_cluster)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

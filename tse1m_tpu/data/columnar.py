@@ -40,6 +40,14 @@ def ns_to_device_s(ns: np.ndarray) -> np.ndarray:
     return ((ns - STUDY_EPOCH.astype(np.int64)) // 1_000_000_000).astype(np.int32)
 
 
+def ns_to_device_pair(ns: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Split epoch-ns into (seconds-since-STUDY_EPOCH, ns-remainder) int32
+    lanes for exact lexicographic time comparison on device without x64."""
+    rel = ns - STUDY_EPOCH.astype(np.int64)
+    return ((rel // 1_000_000_000).astype(np.int32),
+            (rel % 1_000_000_000).astype(np.int32))
+
+
 def rev_hash(revisions: list[str]) -> np.int64:
     """Deterministic 63-bit hash of a revision list — set equality in RQ3
     (reference compares sets, rq3_diff_coverage_at_detection.py:280) becomes
@@ -114,11 +122,19 @@ class StudyArrays:
         # Fuzzing builds (bulk; replaces ALL_FUZZING_BUILD per-project loop).
         sql, params = queries.all_fuzzing_builds_bulk(projects)
         rows, fcodes = order_rows(db.query(sql, params))
+        from ..config import RESULT_OK
+
         fuzz = Segmented(
             offsets=_offsets_from_sorted_codes(fcodes, len(projects)),
             columns={
                 "time_ns": to_epoch_ns([r[2] for r in rows]) if rows else np.empty(0, np.int64),
                 "name": np.array([r[1] for r in rows], dtype=object),
+                "result": np.array([r[3] for r in rows], dtype=object),
+                "ok": np.array([r[3] in RESULT_OK for r in rows], dtype=bool),
+                # Raw DB values; only the small linked subset is ever parsed
+                # (at artifact-write time) — avoid eagerly parsing ~1M rows.
+                "modules_raw": np.array([r[4] for r in rows], dtype=object),
+                "revisions_raw": np.array([r[5] for r in rows], dtype=object),
             },
         )
 

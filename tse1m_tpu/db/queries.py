@@ -88,7 +88,8 @@ def all_fuzzing_builds_bulk(targets: Sequence[str]) -> Query:
     """Bulk replacement for the Phase-1/Phase-2 per-project loops
     (rq1_detection_rate.py:192-201,219-223)."""
     return (
-        "SELECT project, name, timecreated FROM buildlog_data "
+        "SELECT project, name, timecreated, result, modules, revisions "
+        "FROM buildlog_data "
         f"WHERE build_type = 'Fuzzing' AND project IN {_in(targets)} "
         "ORDER BY project, timecreated",
         tuple(targets),

@@ -1,0 +1,13 @@
+from .segment import (
+    segment_searchsorted,
+    counts_to_survival,
+    unique_pairs_count_per_iteration,
+    masked_percentile,
+)
+
+__all__ = [
+    "segment_searchsorted",
+    "counts_to_survival",
+    "unique_pairs_count_per_iteration",
+    "masked_percentile",
+]

@@ -1,0 +1,146 @@
+"""Device-side segment primitives.
+
+These replace the reference's per-project Python loops with single fused
+device ops (SURVEY.md §2.3, §3.1):
+
+- :func:`segment_searchsorted` — session/iteration indexing: "iteration of an
+  event = number of builds strictly before its timestamp"
+  (rq1_detection_rate.py:226-227, rq4a_bug.py:344-346) as one vectorised
+  binary search over a CSR array.  O(Q log N) gathers, XLA-friendly fixed
+  trip count, no [P x maxB] padding materialised.
+- :func:`counts_to_survival` — per-iteration project population
+  (rq1_detection_rate.py:195-200): #projects with >= k builds, via bincount
+  + reversed cumsum.
+- :func:`unique_pairs_count_per_iteration` — "unique detected projects per
+  iteration" (rq1_detection_rate.py:249) as a boolean scatter + column sum.
+- :func:`masked_percentile` — percentiles over padded ragged rows (the
+  rebuild form of the per-session np.percentile over ragged lists,
+  rq2_coverage_count.py:149-152).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def segment_searchsorted(values, offsets, queries, query_segments, side: str = "left",
+                         values_lo=None, queries_lo=None):
+    """Vectorised per-segment searchsorted.
+
+    Args:
+      values: [N] array, sorted ascending *within* each segment.
+      offsets: [P+1] int array of segment boundaries (CSR).
+      queries: [Q] query values.
+      query_segments: [Q] int array mapping each query to its segment.
+      side: 'left' -> count of elements strictly < query (the reference's
+        ``issue_ts > build_ts`` rule); 'right' -> count of elements <= query.
+      values_lo/queries_lo: optional low-order components for lexicographic
+        comparison — lets int64-ns timestamps ride as two int32 lanes
+        (seconds, ns remainder) without enabling x64 on device, keeping
+        exact sub-second ordering semantics.
+
+    Returns:
+      [Q] int32 insertion positions relative to each query's segment start.
+    """
+    values = jnp.asarray(values)
+    offsets = jnp.asarray(offsets, dtype=jnp.int32)
+    queries = jnp.asarray(queries)
+    query_segments = jnp.asarray(query_segments, dtype=jnp.int32)
+    two_lane = values_lo is not None
+    if two_lane:
+        values_lo = jnp.asarray(values_lo)
+        queries_lo = jnp.asarray(queries_lo)
+    n = values.shape[0]
+    if n == 0:
+        return jnp.zeros(queries.shape, dtype=jnp.int32)
+
+    lo = offsets[query_segments]
+    hi = offsets[query_segments + 1]
+    start = lo
+    is_left = side == "left"
+    n_iters = max(1, int(math.ceil(math.log2(max(n, 2)))) + 1)
+
+    def body(carry, _):
+        lo, hi = carry
+        active = lo < hi
+        mid = jnp.clip((lo + hi) // 2, 0, n - 1)
+        v = values[mid]
+        if two_lane:
+            vl = values_lo[mid]
+            lt = (v < queries) | ((v == queries) & (vl < queries_lo))
+            le = (v < queries) | ((v == queries) & (vl <= queries_lo))
+            go_right = lt if is_left else le
+        else:
+            go_right = (v < queries) if is_left else (v <= queries)
+        lo = jnp.where(active & go_right, mid + 1, lo)
+        hi = jnp.where(active & ~go_right, mid, hi)
+        return (lo, hi), None
+
+    (lo, hi), _ = jax.lax.scan(body, (lo, hi), None, length=n_iters)
+    return (lo - start).astype(jnp.int32)
+
+
+def counts_to_survival(counts, max_k: int):
+    """#segments with count >= k, for k = 1..max_k.
+
+    counts: [P] int array of per-segment element counts.
+    Returns [max_k] int32 where out[k-1] = sum(counts >= k).
+    """
+    counts = jnp.asarray(counts)
+    hist = jnp.bincount(jnp.clip(counts, 0, max_k), length=max_k + 1)
+    # survival[k] = #projects with count >= k  (k in 1..max_k)
+    total = counts.shape[0]
+    below = jnp.cumsum(hist)  # below[k] = #projects with count <= k
+    return (total - below[:-1]).astype(jnp.int32)
+
+
+def unique_pairs_count_per_iteration(segments, iterations, n_segments: int, max_k: int):
+    """Count *unique* segments hitting each iteration.
+
+    segments: [Q] int segment id per event; iterations: [Q] 1-based iteration
+    per event (0 or > max_k are ignored).  Returns [max_k] int32 where
+    out[k-1] = #unique segments with at least one event at iteration k.
+    """
+    segments = jnp.asarray(segments, dtype=jnp.int32)
+    iterations = jnp.asarray(iterations, dtype=jnp.int32)
+    valid = (iterations >= 1) & (iterations <= max_k)
+    # Route invalid events to a scratch column (index 0 of a max_k+1 grid).
+    col = jnp.where(valid, iterations, 0)
+    grid = jnp.zeros((n_segments, max_k + 1), dtype=jnp.bool_)
+    grid = grid.at[segments, col].set(True, mode="drop")
+    return grid[:, 1:].sum(axis=0, dtype=jnp.int32)
+
+
+def masked_percentile(x, mask, q):
+    """Percentile per row of a padded matrix, ignoring masked-out entries.
+
+    x: [R, C] values; mask: [R, C] bool (True = valid); q: scalar or [K]
+    percentiles in [0, 100].  Linear interpolation, matching np.percentile.
+    Rows with no valid entries return NaN.
+    """
+    scalar_q = np.ndim(q) == 0
+    x = jnp.asarray(x, dtype=jnp.float32)
+    mask = jnp.asarray(mask)
+    big = jnp.float32(np.finfo(np.float32).max)
+    filled = jnp.where(mask, x, big)
+    s = jnp.sort(filled, axis=-1)  # valid entries first, pads at the end
+    n_valid = mask.sum(axis=-1)  # [R]
+    q = jnp.atleast_1d(jnp.asarray(q, dtype=jnp.float32))
+
+    def one_q(qi):
+        pos = (n_valid.astype(jnp.float32) - 1.0) * qi / 100.0
+        lo = jnp.clip(jnp.floor(pos).astype(jnp.int32), 0, s.shape[-1] - 1)
+        hi = jnp.clip(lo + 1, 0, s.shape[-1] - 1)
+        frac = pos - lo.astype(jnp.float32)
+        vlo = jnp.take_along_axis(s, lo[:, None], axis=-1)[:, 0]
+        vhi = jnp.take_along_axis(s, hi[:, None], axis=-1)[:, 0]
+        hi_valid = (lo + 1) <= (n_valid - 1)
+        out = vlo + jnp.where(hi_valid, frac * (vhi - vlo), 0.0)
+        return jnp.where(n_valid > 0, out, jnp.nan)
+
+    out = jax.vmap(one_q)(q)  # [K, R]
+    return out[0] if scalar_q else out
