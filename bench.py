@@ -1,0 +1,94 @@
+"""North-star benchmark: cluster ~1M session coverage vectors on TPU.
+
+Target (BASELINE.json / BASELINE.md): < 60 s wall on a TPU slice at
+ARI >= 0.98 vs the host baseline.  Prints ONE JSON line:
+  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+vs_baseline = 60 / wall_s, i.e. >1.0 beats the published target.
+
+Runs on whatever jax.devices() offers (the driver provides one real chip);
+first invocation pays the XLA compile, the timed run is steady-state.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--n", type=int, default=1_000_000)
+    p.add_argument("--set-size", type=int, default=64)
+    p.add_argument("--hashes", type=int, default=128)
+    p.add_argument("--bands", type=int, default=16)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--ari-sample", type=int, default=0,
+                   help="if >0, also ARI-check a host-clustered subsample")
+    args = p.parse_args()
+
+    import jax
+
+    from tse1m_tpu.cluster import (ClusterParams, adjusted_rand_index,
+                                   cluster_sessions)
+    from tse1m_tpu.data.synth import synth_session_sets
+
+    items, truth = synth_session_sets(args.n, set_size=args.set_size,
+                                      seed=args.seed)
+    dev = jax.devices()[0]
+    params = ClusterParams(n_hashes=args.hashes, n_bands=args.bands)
+
+    def run(prm):
+        labels = cluster_sessions(items, prm)
+        return labels
+
+    try:
+        run(params)  # compile + warm
+        t0 = time.perf_counter()
+        labels = run(params)
+        wall = time.perf_counter() - t0
+    except Exception as e:  # pallas path unavailable on this backend
+        print(f"# pallas path failed ({type(e).__name__}: {e}); "
+              "falling back to fused-jax", file=sys.stderr)
+        params = ClusterParams(n_hashes=args.hashes, n_bands=args.bands,
+                               use_pallas="never")
+        run(params)
+        t0 = time.perf_counter()
+        labels = run(params)
+        wall = time.perf_counter() - t0
+
+    ari = adjusted_rand_index(labels, truth)
+    ari_host = None
+    if args.ari_sample > 0:
+        # Acceptance gate vs the host baseline (BASELINE.json: ARI >= 0.98):
+        # cluster the same leading subsample independently on device and
+        # host and compare labelings apples-to-apples.
+        from tse1m_tpu.cluster import host_cluster
+
+        k = min(args.ari_sample, args.n)
+        dev_k = cluster_sessions(items[:k], params)
+        host_k = host_cluster(items[:k], n_hashes=args.hashes,
+                              n_bands=args.bands, seed=params.seed)
+        ari_host = round(adjusted_rand_index(dev_k, host_k), 5)
+
+    result = {
+        "metric": f"cluster_{args.n // 1000}k_sessions_wall_s",
+        "value": round(wall, 4),
+        "unit": "s",
+        "vs_baseline": round(60.0 / wall, 2),
+        "ari_vs_planted": round(ari, 5),
+        "n_sessions": args.n,
+        "n_hashes": args.hashes,
+        "n_bands": args.bands,
+        "device": str(dev),
+        "backend": jax.default_backend(),
+    }
+    if ari_host is not None:
+        result["ari_vs_host_sample"] = ari_host
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,6 +1,9 @@
 """RQ1 entry point — drop-in replacement for the reference's
 ``program/research_questions/rq1_detection_rate.py``; the engine lives in
-``tse1m_tpu.analysis.rq1`` and is selected by envFile.ini's backend key."""
+``tse1m_tpu.analysis.rq1`` and is selected by envFile.ini's backend key.
+The reference's TEST_MODE switch (rq1_detection_rate.py:20) is the
+``test_mode`` config key / ``TSE1M_TEST_MODE`` env var, both handled by
+``load_config``."""
 
 import os
 import sys
@@ -10,14 +13,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspa
 from tse1m_tpu.analysis.rq1 import run_rq1  # noqa: E402
 from tse1m_tpu.config import load_config  # noqa: E402
 
-# Reference TEST_MODE switch (rq1_detection_rate.py:20), overridable via env.
-TEST_MODE = os.environ.get("TSE1M_TEST_MODE", "").lower() in ("1", "true", "yes")
-
 
 def main():
-    cfg = load_config()
-    cfg.test_mode = cfg.test_mode or TEST_MODE
-    run_rq1(cfg)
+    run_rq1(load_config())
 
 
 if __name__ == "__main__":

@@ -8,12 +8,22 @@ TPU hardware; the driver separately dry-runs the multichip path.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when the environment pins a real accelerator platform
+# (e.g. JAX_PLATFORMS=axon exposing one TPU chip): tests exercise mesh logic
+# on 8 virtual CPU devices; benchmarks use the real chip via bench.py.
+# The env var alone is not enough here — the image's sitecustomize imports
+# jax and registers the axon PJRT plugin before pytest starts, so we must
+# also flip the already-imported config.
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO_ROOT not in sys.path:

@@ -73,15 +73,22 @@ def _cmd_rq(args) -> int:
         "rq4b": ("tse1m_tpu.analysis.rq4b", "run_rq4b"),
     }
     wanted = list(specs) if args.cmd == "all" else [args.cmd]
+    missing = []
     for name in wanted:
         mod_name, fn_name = specs[name]
         try:
             runners[name] = getattr(importlib.import_module(mod_name), fn_name)
         except ModuleNotFoundError as e:
             if e.name == mod_name:
-                log.error("%s is not implemented yet (%s missing)", name, mod_name)
-                return 1
-            raise  # a real dependency failure inside the module — surface it
+                missing.append(name)
+                log.warning("%s is not implemented yet (%s missing)", name, mod_name)
+            else:
+                raise  # a real dependency failure inside the module — surface it
+    if not runners:
+        log.error("nothing to run: %s not implemented", ", ".join(missing))
+        return 1
+    if missing and args.cmd != "all":
+        return 1
     for name, fn in runners.items():
         log.info("=== %s (backend=%s) ===", name, cfg.backend)
         fn(cfg)

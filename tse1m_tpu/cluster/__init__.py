@@ -1,0 +1,29 @@
+"""Million-session MinHash/LSH dedup + crash clustering (the north star).
+
+The reference has no clustering layer — `BASELINE.json`'s north star adds it:
+cluster ~1M session coverage vectors on a TPU mesh in < 60 s at ARI >= 0.98
+vs the host baseline.  Pipeline (SURVEY.md §7.2 step 5):
+
+  items [N, S] uint32 feature sets
+    -> MinHash signatures [N, H]          (pallas kernel / jax fallback)
+    -> banded LSH keys [N, B]             (mixing hash over H/B rows per band)
+    -> bucket representatives per band    (sort + segment-min)
+    -> signature-verified edges           (est. Jaccard >= threshold)
+    -> min-label propagation              (pointer jumping, fixed trip count)
+    -> cluster labels [N]
+"""
+
+from .metrics import adjusted_rand_index
+from .minhash import band_keys, make_hash_params, minhash_signatures
+from .host import host_cluster
+from .pipeline import ClusterParams, cluster_sessions
+
+__all__ = [
+    "adjusted_rand_index",
+    "band_keys",
+    "make_hash_params",
+    "minhash_signatures",
+    "host_cluster",
+    "ClusterParams",
+    "cluster_sessions",
+]

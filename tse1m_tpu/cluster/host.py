@@ -1,0 +1,92 @@
+"""Numpy host oracle for the clustering pipeline.
+
+Shares the exact hash parameters with the device path (minhash.py
+``make_hash_params``) so signatures are bit-identical, then resolves
+components with a classic union-find instead of device label propagation.
+This is the "CPU/pandas baseline" the north star measures ARI and speedup
+against (BASELINE.json); it is also the semantics oracle in tests.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .minhash import _FNV_OFFSET, _FNV_PRIME, make_hash_params
+
+
+def host_signatures(items: np.ndarray, a: np.ndarray, b: np.ndarray,
+                    chunk: int = 65536) -> np.ndarray:
+    """[N, S] uint32 -> [N, H] uint32, identical to the device kernel."""
+    items = np.ascontiguousarray(items, dtype=np.uint32)
+    n, s = items.shape
+    h = a.shape[0]
+    sig = np.empty((n, h), dtype=np.uint32)
+    with np.errstate(over="ignore"):
+        for lo in range(0, n, chunk):
+            blk = items[lo:lo + chunk]  # [bn, S]
+            hashed = blk[:, :, None] * a[None, None, :] + b[None, None, :]
+            sig[lo:lo + chunk] = hashed.min(axis=1)
+    return sig
+
+
+def host_band_keys(sig: np.ndarray, n_bands: int) -> np.ndarray:
+    n, h = sig.shape
+    r = h // n_bands
+    # Interleaved banding, matching minhash.band_keys: band k folds rows
+    # {k, k+B, k+2B, ...}.
+    chunks = sig.reshape(n, r, n_bands)
+    keys = np.broadcast_to(
+        _FNV_OFFSET + np.arange(n_bands, dtype=np.uint32)[None, :],
+        (n, n_bands)).copy()
+    with np.errstate(over="ignore"):
+        for j in range(r):
+            keys = (keys ^ chunks[:, j, :]) * _FNV_PRIME
+    return keys
+
+
+class _UnionFind:
+    def __init__(self, n: int):
+        self.parent = np.arange(n, dtype=np.int64)
+
+    def find(self, x: int) -> int:
+        p = self.parent
+        root = x
+        while p[root] != root:
+            root = p[root]
+        while p[x] != root:
+            p[x], x = root, p[x]
+        return root
+
+    def union(self, x: int, y: int) -> None:
+        rx, ry = self.find(x), self.find(y)
+        if rx != ry:
+            if rx < ry:
+                self.parent[ry] = rx
+            else:
+                self.parent[rx] = ry
+
+
+def host_cluster(items: np.ndarray, n_hashes: int = 128, n_bands: int = 16,
+                 threshold: float = 0.5, seed: int = 0) -> np.ndarray:
+    """End-to-end host clustering; returns [N] int64 min-index labels."""
+    a, b = make_hash_params(n_hashes, seed)
+    sig = host_signatures(items, a, b)
+    keys = host_band_keys(sig, n_bands)
+    n = items.shape[0]
+    uf = _UnionFind(n)
+    min_agree = threshold * n_hashes
+    for band in range(n_bands):
+        order = np.argsort(keys[:, band], kind="stable")
+        ks = keys[order, band]
+        boundaries = np.flatnonzero(np.concatenate(
+            [[True], ks[1:] != ks[:-1], [True]]))
+        for i in range(len(boundaries) - 1):
+            lo, hi = boundaries[i], boundaries[i + 1]
+            if hi - lo < 2:
+                continue
+            members = order[lo:hi]
+            rep = members.min()
+            for m in members:
+                if m != rep and (sig[m] == sig[rep]).sum() >= min_agree:
+                    uf.union(int(m), int(rep))
+    return np.array([uf.find(i) for i in range(n)], dtype=np.int64)

@@ -1,0 +1,90 @@
+"""MinHash signatures + banded LSH keys (jax reference path).
+
+Hash family: multiply-add over uint32 with natural wraparound —
+``h_i(x) = a_i * x + b_i (mod 2^32)`` with odd ``a_i``.  Multiply-shift
+universal hashing is integer-only, so everything rides the VPU; no
+float precision traps, bit-exact across CPU/TPU and vs the numpy host
+oracle (host.py), which shares the same parameters.
+
+The signature kernel is deliberately a `fori_loop` over the (small, static)
+set dimension accumulating an elementwise min of `[N, H]` blocks: peak
+memory stays O(N*H) instead of the O(N*S*H) a broadcast formulation would
+materialise, and XLA fuses the multiply-add-min chain into one pass.
+A fused pallas VMEM-blocked variant lives in minhash_pallas.py.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+UMAX = np.uint32(0xFFFFFFFF)
+# FNV-1a-style mixing constants for band keys.
+_FNV_PRIME = np.uint32(16777619)
+_FNV_OFFSET = np.uint32(2166136261)
+
+
+def make_hash_params(n_hashes: int, seed: int = 0) -> tuple[np.ndarray, np.ndarray]:
+    """Deterministic (a, b) uint32 hash parameters, a forced odd.
+
+    Generated host-side with numpy so the device path and the numpy oracle
+    share bit-identical signatures (determinism requirement, SURVEY.md §7.3).
+    """
+    rng = np.random.default_rng(seed)
+    a = rng.integers(1, 1 << 32, size=n_hashes, dtype=np.uint32) | np.uint32(1)
+    b = rng.integers(0, 1 << 32, size=n_hashes, dtype=np.uint32)
+    return a, b
+
+
+@partial(jax.jit, static_argnames=())
+def minhash_signatures(items: jax.Array, a: jax.Array, b: jax.Array) -> jax.Array:
+    """[N, S] uint32 feature sets -> [N, H] uint32 MinHash signatures.
+
+    sig[n, h] = min_s (a[h] * items[n, s] + b[h]) mod 2^32.
+    """
+    items = items.astype(jnp.uint32)
+    a = a.astype(jnp.uint32)
+    b = b.astype(jnp.uint32)
+    n, s = items.shape
+
+    def body(i, acc):
+        col = jax.lax.dynamic_slice_in_dim(items, i, 1, axis=1)  # [N, 1]
+        h = col * a[None, :] + b[None, :]  # [N, H], wraps mod 2^32
+        return jnp.minimum(acc, h)
+
+    init = jnp.full((n, a.shape[0]), UMAX, dtype=jnp.uint32)
+    return jax.lax.fori_loop(0, s, body, init)
+
+
+def band_keys(sig: jax.Array, n_bands: int) -> jax.Array:
+    """[N, H] signatures -> [N, B] uint32 LSH band keys.
+
+    Each band folds its H/B signature rows with an FNV-1a-style mix, salted
+    by the band index so identical row-chunks in different bands can't
+    collide by construction.  32-bit keys do admit birthday collisions
+    (~N^2/2^33 spurious bucket merges per band at N=1M) — downstream
+    signature verification (pipeline.py) rejects those edges, so we avoid
+    the cost of 64-bit lexicographic sorting on a 32-bit-native device.
+
+    Bands are *interleaved*: band k folds signature rows {k, k+B, k+2B, ...}.
+    Hash rows are iid so this is statistically identical to contiguous
+    banding, and it makes "row j of every band" a contiguous [N, B] slice —
+    the layout the fused pallas kernel can lower (Mosaic has no strided
+    vector extract).
+    """
+    sig = sig.astype(jnp.uint32)
+    n, h = sig.shape
+    assert h % n_bands == 0, f"n_hashes {h} not divisible by n_bands {n_bands}"
+    r = h // n_bands
+    chunks = sig.reshape(n, r, n_bands)  # [:, j, k] = sig[:, j*B + k]
+
+    def fold(carry, x):
+        return (carry ^ x) * _FNV_PRIME, None
+
+    salt = _FNV_OFFSET + jnp.arange(n_bands, dtype=jnp.uint32)[None, :]
+    keys, _ = jax.lax.scan(fold, jnp.broadcast_to(salt, (n, n_bands)),
+                           jnp.moveaxis(chunks, 1, 0))
+    return keys

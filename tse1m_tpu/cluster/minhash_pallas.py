@@ -1,0 +1,114 @@
+"""Fused MinHash + band-key pallas kernel for TPU.
+
+One HBM->VMEM pass per item block: load [BN, S] uint32 features, produce
+both the [BN, H] signature block and the [BN, B] band keys without ever
+re-reading the signatures from HBM — the band fold happens while the
+signature block is still resident in VMEM.  This is the memory-bound hot
+op of the north star (BASELINE.json): arithmetic intensity is low
+(S multiply-add-mins per signature element), so fusing the second pass
+roughly halves HBM traffic vs the two-step jax path.
+
+Falls back transparently to the jax implementation off-TPU; tests run the
+kernel in interpreter mode (minhash_pallas interpret=True) for semantics.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .minhash import _FNV_OFFSET, _FNV_PRIME, UMAX, band_keys, minhash_signatures
+
+
+def _kernel(items_ref, a_ref, b_ref, sig_ref, keys_ref, *, n_bands: int):
+    # Loops over the set and band-row dims are statically unrolled python
+    # loops (S, H/B are small compile-time constants): Mosaic has no
+    # dynamic_slice lowering, and unrolling lets it software-pipeline the
+    # multiply-add-min chain on the VPU.
+    items = items_ref[...]  # [BN, S] uint32
+    a = a_ref[...]          # [H]
+    b = b_ref[...]
+    bn, s = items.shape
+    h = a.shape[0]
+
+    # Mosaic has no unsigned vector min (arith.minui); bias by 2^31 and
+    # min in the signed domain — order-isomorphic, bit-exact after unbias.
+    bias = jnp.uint32(0x80000000)
+    acc = jnp.full((bn, h), 0x7FFFFFFF, dtype=jnp.int32)  # biased UMAX
+    for i in range(s):
+        col = items[:, i:i + 1]  # static slice
+        hashed = col * a[None, :] + b[None, :]
+        acc = jnp.minimum(acc, jax.lax.bitcast_convert_type(
+            hashed ^ bias, jnp.int32))
+    sig = jax.lax.bitcast_convert_type(acc, jnp.uint32) ^ bias
+    sig_ref[...] = sig
+
+    r = h // n_bands
+    salt = _FNV_OFFSET + jax.lax.broadcasted_iota(jnp.uint32, (bn, n_bands), 1)
+    keys = salt
+    for j in range(r):
+        # Interleaved banding (minhash.band_keys): row j of every band is
+        # the contiguous slice sig[:, j*B:(j+1)*B] — the one extract
+        # shape Mosaic lowers (no strided/3-D vector casts needed).
+        x = sig[:, j * n_bands:(j + 1) * n_bands]
+        keys = (keys ^ x) * _FNV_PRIME
+    keys_ref[...] = keys
+
+
+@functools.partial(jax.jit, static_argnames=("n_bands", "block_n", "interpret"))
+def minhash_and_keys_pallas(items, a, b, n_bands: int, block_n: int = 512,
+                            interpret: bool = False):
+    """[N, S] items -> ([N, H] signatures, [N, B] band keys), fused.
+
+    N must be a multiple of block_n (pipeline pads and strips).
+    """
+    from jax.experimental import pallas as pl
+
+    n, s = items.shape
+    h = a.shape[0]
+    assert n % block_n == 0, (n, block_n)
+    grid = (n // block_n,)
+    return pl.pallas_call(
+        functools.partial(_kernel, n_bands=n_bands),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_n, s), lambda i: (i, 0)),
+            pl.BlockSpec((h,), lambda i: (0,)),
+            pl.BlockSpec((h,), lambda i: (0,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_n, h), lambda i: (i, 0)),
+            pl.BlockSpec((block_n, n_bands), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, h), jnp.uint32),
+            jax.ShapeDtypeStruct((n, n_bands), jnp.uint32),
+        ],
+        interpret=interpret,
+    )(items.astype(jnp.uint32), a.astype(jnp.uint32), b.astype(jnp.uint32))
+
+
+def minhash_and_keys(items, a, b, n_bands: int, *, use_pallas: str = "auto",
+                     block_n: int = 512):
+    """Dispatch: pallas on TPU (or forced), fused-jax elsewhere.
+
+    use_pallas: 'auto' | 'never' | 'force' | 'interpret'.
+    """
+    if use_pallas == "auto":
+        use_pallas = "force" if jax.default_backend() == "tpu" else "never"
+    if use_pallas in ("force", "interpret"):
+        n = items.shape[0]
+        pad = (-n) % block_n
+        if pad:
+            items = jnp.concatenate(
+                [jnp.asarray(items),
+                 jnp.zeros((pad, items.shape[1]), dtype=jnp.uint32)], axis=0)
+        sig, keys = minhash_and_keys_pallas(
+            jnp.asarray(items), jnp.asarray(a), jnp.asarray(b), n_bands,
+            block_n=block_n, interpret=(use_pallas == "interpret"))
+        return sig[:n], keys[:n]
+    sig = minhash_signatures(jnp.asarray(items), jnp.asarray(a), jnp.asarray(b))
+    return sig, band_keys(sig, n_bands)

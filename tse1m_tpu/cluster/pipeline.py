@@ -1,0 +1,104 @@
+"""End-to-end device clustering pipeline with mesh sharding.
+
+Single-device: one jitted chain items -> signatures -> band keys -> bucket
+reps -> verified edges -> propagated labels.
+
+Multi-device: the FLOP/bandwidth-heavy stage (MinHash + band keys) is
+sharded over the item axis of a `jax.sharding.Mesh` via sharding
+constraints under jit — XLA's SPMD partitioner runs it collective-free
+(embarrassingly data-parallel) and inserts the all-gather where the
+clustering stage's global sort needs full visibility.  This mirrors the
+scaling-book recipe: annotate shardings, let XLA place collectives on ICI.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .lsh import bucket_representatives, estimated_jaccard, propagate_labels
+from .minhash import band_keys, make_hash_params, minhash_signatures
+from .minhash_pallas import minhash_and_keys
+
+
+@dataclass(frozen=True)
+class ClusterParams:
+    n_hashes: int = 128
+    n_bands: int = 16
+    threshold: float = 0.5       # min estimated Jaccard to accept an edge
+    n_iters: int = 12            # label-propagation jumps (2^12 chain cover)
+    seed: int = 0
+    use_pallas: str = "auto"     # auto | never | force | interpret
+    block_n: int = 512
+
+
+def _cluster_from_sig(sig, keys, threshold: float, n_iters: int):
+    reps = bucket_representatives(keys)
+    est = estimated_jaccard(sig, reps)
+    self_idx = jnp.arange(sig.shape[0], dtype=jnp.int32)[:, None]
+    valid = (est >= threshold) & (reps != self_idx)
+    return propagate_labels(reps, valid, n_iters=n_iters)
+
+
+@partial(jax.jit, static_argnames=("n_bands", "threshold", "n_iters"))
+def _cluster_jax(items, a, b, n_bands: int, threshold: float, n_iters: int):
+    sig = minhash_signatures(items, a, b)
+    keys = band_keys(sig, n_bands)
+    return _cluster_from_sig(sig, keys, threshold, n_iters)
+
+
+# Module-level jit wrappers: wrapping inside cluster_sessions would key the
+# compile cache to a fresh function object per call and retrace every time.
+_cluster_from_sig_jit = jax.jit(
+    _cluster_from_sig, static_argnames=("threshold", "n_iters"))
+
+
+@partial(jax.jit, static_argnames=("sharding", "n_bands", "threshold", "n_iters"))
+def _cluster_sharded(items_d, a, b, sharding, n_bands: int, threshold: float,
+                     n_iters: int):
+    items_d = jax.lax.with_sharding_constraint(items_d, sharding)
+    sig = minhash_signatures(items_d, a, b)
+    keys = band_keys(sig, n_bands)
+    return _cluster_from_sig(sig, keys, threshold, n_iters)
+
+
+def cluster_sessions(items, params: ClusterParams | None = None,
+                     mesh: jax.sharding.Mesh | None = None,
+                     axis: str = "data") -> np.ndarray:
+    """Cluster [N, S] uint32 session feature sets -> [N] int32 labels.
+
+    With a mesh, `items` is placed sharded along its first axis; the jitted
+    pipeline keeps the MinHash stage sharded and lets XLA gather for the
+    bucket-sort stage.
+    """
+    params = params or ClusterParams()
+    a, b = make_hash_params(params.n_hashes, params.seed)
+    a, b = jnp.asarray(a), jnp.asarray(b)
+    items = np.ascontiguousarray(items, dtype=np.uint32)
+
+    if mesh is not None:
+        from ..parallel.mesh import pad_to_devices
+
+        sharding = jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec(axis, None))
+        n = items.shape[0]
+        items, _ = pad_to_devices(items, mesh)
+        items_d = jax.device_put(items, sharding)
+        labels = _cluster_sharded(items_d, a, b, sharding, params.n_bands,
+                                  params.threshold, params.n_iters)
+        return np.asarray(labels)[:n]
+
+    if params.use_pallas != "never":
+        sig, keys = minhash_and_keys(jnp.asarray(items), a, b, params.n_bands,
+                                     use_pallas=params.use_pallas,
+                                     block_n=params.block_n)
+        labels = _cluster_from_sig_jit(sig, keys, params.threshold,
+                                       params.n_iters)
+        return np.asarray(labels)
+
+    return np.asarray(_cluster_jax(jnp.asarray(items), a, b, params.n_bands,
+                                   params.threshold, params.n_iters))

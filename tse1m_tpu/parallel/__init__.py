@@ -1,0 +1,3 @@
+from .mesh import detection_hist_sharded, make_mesh, shard_along
+
+__all__ = ["make_mesh", "shard_along", "detection_hist_sharded"]

@@ -1,0 +1,64 @@
+"""Device-mesh plumbing: mesh construction, sharded placement, and the
+collective reductions that take NCCL's architectural seat (SURVEY.md §2.4).
+
+The study's parallel axis is *data* (sessions/issues/projects) — there is
+no model to tensor/pipeline-shard — so the mesh is 1-D and collectives are
+`psum` over ICI: each device reduces its shard of events into a dense
+per-iteration histogram and one all-reduce merges them.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(n_devices: int | None = None, axis: str = "data") -> Mesh:
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    if n > len(devs):
+        raise ValueError(f"requested {n} devices, have {len(devs)}")
+    return Mesh(np.array(devs[:n]), (axis,))
+
+
+def shard_along(mesh: Mesh, axis: str = "data", rank: int = 1) -> NamedSharding:
+    """NamedSharding splitting dim 0 over `axis`, replicating the rest."""
+    spec = P(axis, *([None] * (rank - 1)))
+    return NamedSharding(mesh, spec)
+
+
+def pad_to_devices(x: np.ndarray, mesh: Mesh, fill=0) -> tuple[np.ndarray, int]:
+    n_dev = mesh.devices.size
+    pad = (-x.shape[0]) % n_dev
+    if pad:
+        fill_block = np.full((pad,) + x.shape[1:], fill, dtype=x.dtype)
+        x = np.concatenate([x, fill_block], axis=0)
+    return x, pad
+
+
+def detection_hist_sharded(iterations, max_iter: int, mesh: Mesh,
+                           axis: str = "data"):
+    """Per-iteration event histogram as a mesh collective.
+
+    iterations: [Q] int32 1-based iteration index per event (0 = unlinked,
+    ignored), sharded along `axis`.  Each device bincounts its shard and a
+    `psum` over ICI merges the partials — the rebuild's analogue of the
+    reference's per-issue counting loop (rq1_detection_rate.py:215-230).
+    Returns a replicated [max_iter] int32 histogram.
+    """
+
+    @partial(shard_map, mesh=mesh, in_specs=P(axis), out_specs=P())
+    def hist(shard):
+        # Out-of-range iterations route to the discarded 0 bin — same
+        # semantics as ops.segment.unique_pairs_count_per_iteration.
+        in_range = (shard >= 1) & (shard <= max_iter)
+        local = jnp.bincount(jnp.where(in_range, shard, 0),
+                             length=max_iter + 1)
+        return jax.lax.psum(local[1:], axis_name=axis)
+
+    return hist(jnp.asarray(iterations, dtype=jnp.int32))
