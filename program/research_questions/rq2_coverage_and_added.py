@@ -1,0 +1,19 @@
+"""RQ2 change-point entry point — drop-in replacement for the reference's
+``program/research_questions/rq2_coverage_and_added.py`` (which writes its
+artifacts under the rq3 result dir; kept for parity)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+from tse1m_tpu.analysis.rq2_changepoints import run_rq2_changepoints  # noqa: E402
+from tse1m_tpu.config import load_config  # noqa: E402
+
+
+def main():
+    run_rq2_changepoints(load_config())
+
+
+if __name__ == "__main__":
+    main()

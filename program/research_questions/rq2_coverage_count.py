@@ -1,0 +1,18 @@
+"""RQ2 coverage-trend entry point — drop-in replacement for the reference's
+``program/research_questions/rq2_coverage_count.py``."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+from tse1m_tpu.analysis.rq2_trends import run_rq2_trends  # noqa: E402
+from tse1m_tpu.config import load_config  # noqa: E402
+
+
+def main():
+    run_rq2_trends(load_config())
+
+
+if __name__ == "__main__":
+    main()

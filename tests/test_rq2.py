@@ -1,0 +1,153 @@
+"""RQ2: change-point + trend backend parity, oracle semantics, artifacts."""
+
+import os
+
+import numpy as np
+import pytest
+
+from tse1m_tpu.analysis.rq2_changepoints import run_rq2_changepoints
+from tse1m_tpu.analysis.rq2_trends import run_rq2_trends
+from tse1m_tpu.backend.jax_backend import JaxBackend
+from tse1m_tpu.backend.pandas_backend import PandasBackend, floor_day_ns
+from tse1m_tpu.config import Config
+from tse1m_tpu.data.columnar import StudyArrays
+
+LIMIT = "2026-01-01"
+
+
+@pytest.fixture(scope="module")
+def arrays(study_db):
+    cfg = Config(engine="sqlite", sqlite_path=study_db.config.sqlite_path,
+                 limit_date=LIMIT)
+    return StudyArrays.from_db(study_db, cfg)
+
+
+@pytest.fixture(scope="module")
+def limit_ns():
+    return int(np.datetime64(LIMIT, "ns").astype(np.int64))
+
+
+def test_change_points_backend_parity(arrays, limit_ns):
+    pd_res = PandasBackend().rq2_change_points(arrays, limit_ns)
+    jx_res = JaxBackend().rq2_change_points(arrays, limit_ns)
+    np.testing.assert_array_equal(pd_res.project_idx, jx_res.project_idx)
+    np.testing.assert_array_equal(pd_res.end_i, jx_res.end_i)
+    np.testing.assert_array_equal(pd_res.start_ip1, jx_res.start_ip1)
+    for f in ("covered_i", "total_i", "covered_ip1", "total_ip1"):
+        np.testing.assert_array_equal(getattr(pd_res, f), getattr(jx_res, f))
+    np.testing.assert_array_equal(pd_res.diff_total_line, jx_res.diff_total_line)
+    np.testing.assert_array_equal(pd_res.diff_coverage, jx_res.diff_coverage)
+    assert len(pd_res.project_idx) > 0
+
+
+def test_change_points_oracle(arrays, limit_ns, study_db):
+    """Re-derive change points straight from DB rows with the reference's
+    pandas shift/cumsum recipe (rq2_coverage_and_added.py:126-166)."""
+    import pandas as pd
+
+    res = PandasBackend().rq2_change_points(arrays, limit_ns)
+    got = {}
+    for k in range(len(res.project_idx)):
+        p = arrays.projects[int(res.project_idx[k])]
+        got.setdefault(p, []).append(
+            (int(arrays.covb.columns["time_ns"][res.end_i[k]]),
+             int(arrays.covb.columns["time_ns"][res.start_ip1[k]])))
+
+    for project in arrays.projects:
+        rows = study_db.query(
+            "SELECT timecreated, modules, revisions FROM buildlog_data "
+            "WHERE project = ? AND build_type='Coverage' AND result='Finish' "
+            "AND timecreated < ? ORDER BY timecreated", (project, LIMIT))
+        cov = study_db.query(
+            "SELECT date FROM total_coverage WHERE project = ? AND date < ?",
+            (project, LIMIT))
+        if not rows or not cov:
+            assert project not in got
+            continue
+        df = pd.DataFrame(rows, columns=["timecreated", "modules", "revisions"])
+        df["key"] = df["modules"].astype(str) + "_" + df["revisions"].astype(str)
+        df["gid"] = (df["key"] != df["key"].shift(1)).cumsum()
+        groups = df.groupby("gid")
+        bounds = [(g.iloc[0]["timecreated"], g.iloc[-1]["timecreated"])
+                  for _, g in groups]
+        expect = [(pd.Timestamp(bounds[i][1]).value,
+                   pd.Timestamp(bounds[i + 1][0]).value)
+                  for i in range(len(bounds) - 1)]
+        assert got.get(project, []) == expect, project
+
+
+def test_trends_backend_parity(arrays):
+    pd_res = PandasBackend().rq2_trends(arrays)
+    jx_res = JaxBackend().rq2_trends(arrays)
+    np.testing.assert_array_equal(pd_res.mask, jx_res.mask)
+    np.testing.assert_allclose(pd_res.matrix, jx_res.matrix, equal_nan=True)
+    np.testing.assert_array_equal(pd_res.counts, jx_res.counts)
+    np.testing.assert_allclose(pd_res.spearman, jx_res.spearman,
+                               atol=1e-5, equal_nan=True)
+    np.testing.assert_allclose(pd_res.percentiles, jx_res.percentiles,
+                               atol=5e-3, equal_nan=True)
+    np.testing.assert_allclose(pd_res.mean, jx_res.mean, atol=5e-3,
+                               equal_nan=True)
+    assert pd_res.matrix.shape[1] >= 365
+
+
+def test_trends_spearman_matches_scipy(arrays):
+    from scipy.stats import spearmanr
+
+    jx_res = JaxBackend().rq2_trends(arrays)
+    for p in range(arrays.n_projects):
+        t = jx_res.matrix[p, jx_res.mask[p]]
+        if len(t) >= 2:
+            rho, _ = spearmanr(range(len(t)), t)
+            assert abs(jx_res.spearman[p] - rho) < 1e-5
+
+
+def test_masked_spearman_ties():
+    """Tied values must get scipy's average ranks on device."""
+    from scipy.stats import spearmanr
+
+    from tse1m_tpu.ops.segment import masked_spearman
+
+    x = np.array([[3.0, 1.0, 1.0, 2.0, 2.0, 2.0, 5.0, 0.0]], dtype=np.float32)
+    mask = np.array([[True] * 7 + [False]])
+    got = float(np.asarray(masked_spearman(x, mask))[0])
+    want, _ = spearmanr(range(7), x[0, :7])
+    assert abs(got - want) < 1e-6
+
+
+def test_floor_day_ns():
+    t = int(np.datetime64("2024-05-06T17:33:12", "ns").astype(np.int64))
+    d = int(np.datetime64("2024-05-06", "ns").astype(np.int64))
+    assert floor_day_ns(np.array([t]))[0] == d
+
+
+@pytest.mark.parametrize("backend", ["pandas", "jax_tpu"])
+def test_run_rq2_end_to_end(backend, study_db, tmp_path):
+    cfg = Config(engine="sqlite", sqlite_path=study_db.config.sqlite_path,
+                 limit_date=LIMIT, backend=backend,
+                 result_dir=str(tmp_path / backend))
+    cfg.min_projects_per_iteration = 2
+    out_a = run_rq2_changepoints(cfg, db=study_db)
+    assert out_a["merged_csv"] and os.path.exists(out_a["merged_csv"])
+    with open(out_a["merged_csv"]) as f:
+        header = f.readline().strip()
+    assert header.startswith("project,timecreated_i,modules_i")
+
+    out_b = run_rq2_trends(cfg, db=study_db, per_project_figures=False)
+    assert os.path.exists(out_b["csv"])
+    rq2_dir = os.path.dirname(out_b["csv"])
+    for name in ("all_project_corr_hist.pdf", "session_coverage_boxplot.pdf",
+                 "average_median_lineplot.pdf",
+                 "session_coverage_distribution_trend.pdf"):
+        assert os.path.exists(os.path.join(rq2_dir, name)), name
+
+
+def test_rq2_artifacts_identical_across_backends(study_db, tmp_path):
+    paths = {}
+    for backend in ("pandas", "jax_tpu"):
+        cfg = Config(engine="sqlite", sqlite_path=study_db.config.sqlite_path,
+                     limit_date=LIMIT, backend=backend,
+                     result_dir=str(tmp_path / ("r_" + backend)))
+        paths[backend] = run_rq2_changepoints(cfg, db=study_db)["merged_csv"]
+    with open(paths["pandas"]) as a, open(paths["jax_tpu"]) as b:
+        assert a.read() == b.read()

@@ -1,0 +1,117 @@
+"""RQ2 change-point extraction — re-implementation of
+``program/research_questions/rq2_coverage_and_added.py``.
+
+Artifact parity (note: the reference writes this analysis under the *rq3*
+result dir, rq2_coverage_and_added.py:14-15 — kept for drop-in parity):
+
+- ``rq3/change_analysis/<project>.csv`` — one CSV per project with a change
+  row per (group i -> group i+1) revision change (rq2:96-102 header).
+- ``rq3/all_coverage_change_analysis.csv`` — all projects merged (rq2:232-238).
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+
+import numpy as np
+
+from .common import StudyContext, fmt_ts_ns, limit_date_ns
+from ..config import Config
+from ..db.ingest import pg_array_literal
+from ..utils.logging import get_logger
+from ..utils.manifest import RunManifest
+from ..utils.timing import PhaseTimer
+
+log = get_logger("rq2a")
+
+HEADER = [
+    "project", "timecreated_i", "modules_i", "revisions_i",
+    "timecreated_i+1", "modules_i+1", "revisions_i+1",
+    "covered_line_i", "total_line_i",
+    "covered_line_i+1", "total_line_i+1",
+    "diff_total_line", "diff_coverage",
+]
+
+
+def _fmt_num(x) -> str:
+    """Reference rows carry raw floats; NaN prints as empty (csv of np.nan
+    would print 'nan' — the pandas reference writes them via csv.writer the
+    same way, so keep 'nan' verbatim for byte parity)."""
+    return x
+
+
+def change_rows(ctx: StudyContext, result) -> dict[str, list[list]]:
+    """Per-project lists of CSV rows in reference column order."""
+    covb = ctx.arrays.covb
+    t = covb.columns["time_ns"]
+    mods = covb.columns["modules"]
+    revs = covb.columns["revisions"]
+    diff_total = result.diff_total_line
+    diff_cov = result.diff_coverage
+    per_project: dict[str, list[list]] = {}
+    for k in range(len(result.project_idx)):
+        p = int(result.project_idx[k])
+        e, s1 = int(result.end_i[k]), int(result.start_ip1[k])
+        row = [
+            ctx.projects[p],
+            fmt_ts_ns(int(t[e])),
+            pg_array_literal(mods[e]),
+            pg_array_literal(revs[e]),
+            fmt_ts_ns(int(t[s1])),
+            pg_array_literal(mods[s1]),
+            pg_array_literal(revs[s1]),
+            result.covered_i[k], result.total_i[k],
+            result.covered_ip1[k], result.total_ip1[k],
+            diff_total[k], diff_cov[k],
+        ]
+        per_project.setdefault(ctx.projects[p], []).append(row)
+    return per_project
+
+
+def run_rq2_changepoints(cfg: Config | None = None, db=None) -> dict:
+    timer = PhaseTimer()
+    with timer.phase("extract"):
+        ctx = StudyContext.open(cfg, db=db, announce=False)
+    manifest = RunManifest("rq2_changepoints", ctx.backend.name)
+
+    with timer.phase("changepoint_kernel"):
+        result = ctx.backend.rq2_change_points(ctx.arrays, limit_date_ns(ctx.cfg))
+
+    n_changes = len(result.project_idx)
+    log.info("found %d change points across %d projects", n_changes,
+             len(np.unique(result.project_idx)))
+
+    out_dir = ctx.out_dir("rq3")  # reference writes rq2a artifacts under rq3
+    change_dir = os.path.join(out_dir, "change_analysis")
+    os.makedirs(change_dir, exist_ok=True)
+
+    with timer.phase("artifacts"):
+        per_project = change_rows(ctx, result)
+        all_rows = []
+        for project, rows in per_project.items():
+            path = os.path.join(change_dir, f"{project}.csv")
+            with open(path, "w", newline="", encoding="utf-8") as f:
+                w = csv.writer(f)
+                w.writerow(HEADER)
+                w.writerows(rows)
+            all_rows.extend(rows)
+        merged = os.path.join(out_dir, "all_coverage_change_analysis.csv")
+        if all_rows:
+            with open(merged, "w", newline="", encoding="utf-8") as f:
+                w = csv.writer(f)
+                w.writerow(HEADER)
+                w.writerows(all_rows)
+            manifest.add_artifact(merged)
+
+    manifest.record(n_changes=n_changes, n_projects=len(per_project))
+    manifest.save(out_dir, timer.as_dict())
+    return {"result": result, "merged_csv": merged if all_rows else None}
+
+
+def main() -> None:
+    run_rq2_changepoints()
+
+
+if __name__ == "__main__":
+    main()

@@ -44,10 +44,78 @@ class RQ1Result:
         return self.link_idx >= 0
 
 
+@dataclass
+class RQ2ChangePointsResult:
+    """Revision change points per project (rq2_coverage_and_added.py:126-219).
+
+    Flat arrays over all change points, project-major in covb row order.
+    end_i / start_ip1 index into arrays.covb rows: the last build of group i
+    and the first build of group i+1.  covered/total are the same-day
+    total_coverage rows (NaN where no date match); diffs are NaN unless both
+    sides are valid with non-zero total (reference rq2:189-200).
+    """
+
+    project_idx: np.ndarray
+    end_i: np.ndarray
+    start_ip1: np.ndarray
+    covered_i: np.ndarray
+    total_i: np.ndarray
+    covered_ip1: np.ndarray
+    total_ip1: np.ndarray
+
+    def _valid(self):
+        vi = ~np.isnan(self.total_i) & (self.total_i != 0)
+        vp = ~np.isnan(self.total_ip1) & (self.total_ip1 != 0)
+        return vi, vp
+
+    @property
+    def diff_total_line(self) -> np.ndarray:
+        vi, vp = self._valid()
+        return np.where(vi & vp, self.total_ip1 - self.total_i, np.nan)
+
+    @property
+    def diff_coverage(self) -> np.ndarray:
+        vi, vp = self._valid()
+        with np.errstate(invalid="ignore", divide="ignore"):
+            ci = np.where(vi, self.covered_i / self.total_i * 100.0, np.nan)
+            cp = np.where(vp, self.covered_ip1 / self.total_ip1 * 100.0, np.nan)
+        return np.where(vi & vp, cp - ci, np.nan)
+
+
+@dataclass
+class RQ2TrendsResult:
+    """Per-project coverage%-vs-session trends (rq2_coverage_count.py).
+
+    matrix: [P, S] coverage% padded with NaN (S = longest trend); mask marks
+    valid cells.  Trends keep the reference's skip-zero-total rule
+    (rq2:300-303): sessions with total_line == 0 are dropped, then the rest
+    are re-indexed densely.  spearman aligns with arrays.projects;
+    percentiles rows follow PCTS; mean/counts are per session index.
+    """
+
+    PCTS = (5, 25, 50, 75, 95)
+
+    matrix: np.ndarray
+    mask: np.ndarray
+    spearman: np.ndarray
+    percentiles: np.ndarray  # [len(PCTS), S]
+    mean: np.ndarray         # [S]
+    counts: np.ndarray       # [S]
+
+
 class Backend(abc.ABC):
     name: str
 
     @abc.abstractmethod
     def rq1_detection(self, arrays: StudyArrays, limit_date_ns: int,
                       min_projects: int) -> RQ1Result:
+        ...
+
+    @abc.abstractmethod
+    def rq2_change_points(self, arrays: StudyArrays,
+                          limit_date_ns: int) -> RQ2ChangePointsResult:
+        ...
+
+    @abc.abstractmethod
+    def rq2_trends(self, arrays: StudyArrays) -> RQ2TrendsResult:
         ...

@@ -16,9 +16,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .base import Backend, RQ1Result
+from .base import (Backend, RQ1Result, RQ2ChangePointsResult, RQ2TrendsResult)
+from .pandas_backend import floor_day_ns
 from ..data.columnar import StudyArrays, ns_to_device_pair
-from ..ops.segment import (counts_to_survival, segment_searchsorted,
+from ..ops.segment import (counts_to_survival, masked_mean, masked_percentile,
+                           masked_spearman, segment_searchsorted,
                            unique_pairs_count_per_iteration)
 
 
@@ -97,3 +99,98 @@ class JaxBackend(Backend):
             iteration_of_issue=np.asarray(it, dtype=np.int64),
             link_idx=np.asarray(li, dtype=np.int64),
         )
+
+    def rq2_change_points(self, arrays: StudyArrays,
+                          limit_date_ns: int) -> RQ2ChangePointsResult:
+        """Group-boundary detection is vectorised numpy (irregular/cheap);
+        the date-equality join runs as one device searchsorted over the CSR
+        coverage-date arrays, and the final float64 gathers stay on host so
+        values are bit-exact vs the pandas backend."""
+        covb_t = arrays.covb.columns["time_ns"]
+        ghash = arrays.covb.columns["grouphash"]
+        n_covb = len(arrays.covb)
+        seg_all = np.repeat(np.arange(arrays.n_projects), arrays.covb.counts())
+        has_cov = arrays.cov.counts() > 0
+        keep = (covb_t < limit_date_ns) & has_cov[seg_all]
+        rows = np.flatnonzero(keep)
+        if rows.size == 0:
+            e = np.empty(0, np.int64)
+            f = np.empty(0, np.float64)
+            return RQ2ChangePointsResult(e, e, e, f, f, f, f)
+        seg = seg_all[rows]
+        g = ghash[rows]
+        new_group = np.concatenate(
+            [[True], (g[1:] != g[:-1]) | (seg[1:] != seg[:-1])])
+        start_pos = np.flatnonzero(new_group)
+        starts = rows[start_pos]
+        ends = rows[np.concatenate([start_pos[1:] - 1, [rows.size - 1]])]
+        gseg = seg[start_pos]
+        pair = np.flatnonzero(gseg[:-1] == gseg[1:])
+
+        end_i = ends[pair]
+        start_ip1 = starts[pair + 1]
+        proj = gseg[pair]
+        if end_i.size == 0:
+            e = np.empty(0, np.int64)
+            f = np.empty(0, np.float64)
+            return RQ2ChangePointsResult(e, e, e, f, f, f, f)
+
+        cov_days = arrays.cov.columns["date_ns"]
+        q_days = np.concatenate([floor_day_ns(covb_t[end_i]),
+                                 floor_day_ns(covb_t[start_ip1])])
+        q_seg = np.concatenate([proj, proj])
+        ds, dns = ns_to_device_pair(cov_days)
+        qs, qns = ns_to_device_pair(q_days)
+        pos = np.asarray(segment_searchsorted(
+            ds, jnp.asarray(arrays.cov.offsets, dtype=jnp.int32),
+            qs, q_seg.astype(np.int32), side="left",
+            values_lo=dns, queries_lo=qns))
+        gidx = arrays.cov.offsets[q_seg] + pos
+        in_seg = gidx < arrays.cov.offsets[q_seg + 1]
+        safe = np.clip(gidx, 0, max(len(arrays.cov) - 1, 0))
+        matched = in_seg & (cov_days[safe] == q_days)
+        covered = np.where(matched, arrays.cov.columns["covered"][safe], np.nan)
+        total = np.where(matched, arrays.cov.columns["total"][safe], np.nan)
+        n = end_i.size
+        return RQ2ChangePointsResult(
+            project_idx=proj.astype(np.int64),
+            end_i=end_i.astype(np.int64),
+            start_ip1=start_ip1.astype(np.int64),
+            covered_i=covered[:n], total_i=total[:n],
+            covered_ip1=covered[n:], total_ip1=total[n:],
+        )
+
+    def rq2_trends(self, arrays: StudyArrays) -> RQ2TrendsResult:
+        P = arrays.n_projects
+        cov = arrays.cov
+        coverage = cov.columns["coverage"]
+        covered = cov.columns["covered"]
+        total = cov.columns["total"]
+        sel = (~np.isnan(coverage)) & (coverage != 0) & (total != 0)
+        seg_all = np.repeat(np.arange(P), cov.counts())
+        lens = np.bincount(seg_all[sel], minlength=P)
+        S = int(lens.max()) if lens.size else 0
+        matrix = np.full((P, S), np.nan)
+        mask = np.zeros((P, S), dtype=bool)
+        # dense re-index: position of each kept row within its project
+        if S:
+            kept_seg = seg_all[sel]
+            pos_in_proj = np.arange(sel.sum()) - np.repeat(
+                np.concatenate([[0], np.cumsum(lens)[:-1]]), lens)
+            with np.errstate(invalid="ignore", divide="ignore"):
+                matrix[kept_seg, pos_in_proj] = (
+                    covered[sel] / total[sel] * 100.0)
+            mask[kept_seg, pos_in_proj] = True
+
+        mj = jnp.asarray(matrix, dtype=jnp.float32)
+        kj = jnp.asarray(mask)
+        spear = np.asarray(masked_spearman(mj, kj), dtype=np.float64)
+        cols = mj.T  # [S, P]: percentile/mean per session index
+        colmask = kj.T
+        pcts = np.asarray(masked_percentile(
+            cols, colmask, np.array(RQ2TrendsResult.PCTS, dtype=np.float32)),
+            dtype=np.float64)
+        mean = np.asarray(masked_mean(cols, colmask), dtype=np.float64)
+        counts = mask.sum(axis=0)
+        return RQ2TrendsResult(matrix=matrix, mask=mask, spearman=spear,
+                               percentiles=pcts, mean=mean, counts=counts)

@@ -10,8 +10,16 @@ from __future__ import annotations
 
 import numpy as np
 
-from .base import Backend, RQ1Result
+from .base import (Backend, RQ1Result, RQ2ChangePointsResult, RQ2TrendsResult)
 from ..data.columnar import StudyArrays
+
+DAY_NS = 86_400_000_000_000
+
+
+def floor_day_ns(ns: np.ndarray) -> np.ndarray:
+    """Timestamp -> midnight of its day (the reference's .dt.date join key,
+    rq2_coverage_and_added.py:124)."""
+    return (np.asarray(ns) // DAY_NS) * DAY_NS
 
 
 class PandasBackend(Backend):
@@ -76,3 +84,92 @@ class PandasBackend(Backend):
             iteration_of_issue=iteration_of_issue,
             link_idx=link_idx,
         )
+
+    def rq2_change_points(self, arrays: StudyArrays,
+                          limit_date_ns: int) -> RQ2ChangePointsResult:
+        # Mirrors the reference's per-project loop: collapse consecutive
+        # identical (modules, revisions) coverage builds into groups
+        # (rq2_coverage_and_added.py:129-149), pair each group's last build
+        # with the next group's first (rq2:152-166), join both sides to the
+        # same-day total_coverage row (rq2:170-184).
+        out = {k: [] for k in ("project_idx", "end_i", "start_ip1",
+                               "covered_i", "total_i", "covered_ip1",
+                               "total_ip1")}
+        covb_t = arrays.covb.columns["time_ns"]
+        ghash = arrays.covb.columns["grouphash"]
+        for p in range(arrays.n_projects):
+            lo, hi = arrays.covb.offsets[p], arrays.covb.offsets[p + 1]
+            rows = np.arange(lo, hi)[covb_t[lo:hi] < limit_date_ns]
+            clo, chi = arrays.cov.offsets[p], arrays.cov.offsets[p + 1]
+            if rows.size == 0 or chi == clo:
+                continue  # reference skips projects missing either input
+            cov_days = arrays.cov.columns["date_ns"][clo:chi]
+            cov_covered = arrays.cov.columns["covered"][clo:chi]
+            cov_total = arrays.cov.columns["total"][clo:chi]
+
+            g = ghash[rows]
+            new_group = np.concatenate([[True], g[1:] != g[:-1]])
+            starts = rows[new_group]
+            ends = np.concatenate([rows[np.flatnonzero(new_group)[1:] - 1],
+                                   rows[-1:]])
+
+            def day_row(day_ns):
+                j = np.searchsorted(cov_days, day_ns, side="left")
+                if j < cov_days.size and cov_days[j] == day_ns:
+                    return cov_covered[j], cov_total[j]
+                return np.nan, np.nan
+
+            for i in range(len(starts) - 1):
+                e, s1 = ends[i], starts[i + 1]
+                ci, ti = day_row(floor_day_ns(covb_t[e]))
+                cp, tp = day_row(floor_day_ns(covb_t[s1]))
+                out["project_idx"].append(p)
+                out["end_i"].append(e)
+                out["start_ip1"].append(s1)
+                out["covered_i"].append(ci)
+                out["total_i"].append(ti)
+                out["covered_ip1"].append(cp)
+                out["total_ip1"].append(tp)
+        return RQ2ChangePointsResult(
+            project_idx=np.array(out["project_idx"], dtype=np.int64),
+            end_i=np.array(out["end_i"], dtype=np.int64),
+            start_ip1=np.array(out["start_ip1"], dtype=np.int64),
+            covered_i=np.array(out["covered_i"], dtype=np.float64),
+            total_i=np.array(out["total_i"], dtype=np.float64),
+            covered_ip1=np.array(out["covered_ip1"], dtype=np.float64),
+            total_ip1=np.array(out["total_ip1"], dtype=np.float64),
+        )
+
+    def rq2_trends(self, arrays: StudyArrays) -> RQ2TrendsResult:
+        from scipy.stats import spearmanr
+
+        P = arrays.n_projects
+        trends = []
+        for p in range(P):
+            seg = arrays.cov.segment(p)
+            sel = (~np.isnan(seg["coverage"])) & (seg["coverage"] != 0)
+            covered, total = seg["covered"][sel], seg["total"][sel]
+            keep = total != 0  # reference drops zero-total sessions (rq2:302)
+            trends.append(covered[keep] / total[keep] * 100.0)
+
+        S = max((len(t) for t in trends), default=0)
+        matrix = np.full((P, S), np.nan)
+        mask = np.zeros((P, S), dtype=bool)
+        spear = np.full(P, np.nan)
+        for p, t in enumerate(trends):
+            matrix[p, :len(t)] = t
+            mask[p, :len(t)] = True
+            if len(t) >= 2:
+                corr, _ = spearmanr(range(len(t)), t)
+                spear[p] = corr
+
+        counts = mask.sum(axis=0)
+        pcts = np.full((len(RQ2TrendsResult.PCTS), S), np.nan)
+        mean = np.full(S, np.nan)
+        for s in range(S):
+            col = matrix[mask[:, s], s]
+            if col.size:
+                pcts[:, s] = np.percentile(col, RQ2TrendsResult.PCTS)
+                mean[s] = col.mean()
+        return RQ2TrendsResult(matrix=matrix, mask=mask, spearman=spear,
+                               percentiles=pcts, mean=mean, counts=counts)

@@ -58,6 +58,18 @@ def rev_hash(revisions: list[str]) -> np.int64:
     return np.int64(int.from_bytes(digest, "little") >> 1)
 
 
+def group_hash(modules_raw, revisions_raw) -> np.int64:
+    """63-bit hash of the exact (modules, revisions) string combination —
+    the RQ2 change-point group key (the reference concatenates the two
+    column strings, rq2_coverage_and_added.py:129); consecutive-equality
+    checks become integer compares."""
+    digest = hashlib.blake2b(
+        (str(modules_raw) + "\x1e" + str(revisions_raw)).encode(),
+        digest_size=8,
+    ).digest()
+    return np.int64(int.from_bytes(digest, "little") >> 1)
+
+
 def _offsets_from_sorted_codes(codes: np.ndarray, n_segments: int) -> np.ndarray:
     """CSR offsets from a sorted integer code column."""
     return np.searchsorted(codes, np.arange(n_segments + 1)).astype(np.int64)
@@ -151,6 +163,9 @@ class StudyArrays:
                 "revisions": np.array(revs, dtype=object),
                 "revhash": np.array([rev_hash(r) for r in revs], dtype=np.int64)
                 if rows else np.empty(0, np.int64),
+                "grouphash": np.array([group_hash(r[3], r[4]) for r in rows],
+                                      dtype=np.int64)
+                if rows else np.empty(0, np.int64),
             },
         )
 
@@ -174,7 +189,8 @@ class StudyArrays:
             offsets=_offsets_from_sorted_codes(vcodes, len(projects)),
             columns={
                 "date_ns": to_epoch_ns([r[1] for r in rows]) if rows else np.empty(0, np.int64),
-                "coverage": np.array([r[2] for r in rows], dtype=np.float64),
+                "coverage": np.array([r[2] if r[2] is not None else np.nan
+                                      for r in rows], dtype=np.float64),
                 "covered": np.array([r[3] if r[3] is not None else np.nan for r in rows],
                                     dtype=np.float64),
                 "total": np.array([r[4] if r[4] is not None else np.nan for r in rows],

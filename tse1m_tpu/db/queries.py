@@ -208,10 +208,13 @@ def total_coverage_each_project(project: str, export_type: str,
 
 def total_coverage_bulk(targets: Sequence[str],
                         limit_date: str = DEFAULT_LIMIT_DATE) -> Query:
+    """All pre-cutoff coverage rows, unfiltered: RQ2's change-point date
+    join reads rows regardless of coverage value
+    (rq2_coverage_and_added.py:30-47) while the trend/eligibility paths
+    apply their own coverage != 0 masks downstream."""
     return (
         "SELECT project, date, coverage, covered_line, total_line FROM total_coverage "
         f"WHERE project IN {_in(targets)} AND date < ? "
-        "AND coverage IS NOT NULL AND coverage > 0 "
         "ORDER BY project, date",
         (*targets, limit_date),
     )

@@ -115,6 +115,61 @@ def unique_pairs_count_per_iteration(segments, iterations, n_segments: int, max_
     return grid[:, 1:].sum(axis=0, dtype=jnp.int32)
 
 
+def masked_mean(x, mask):
+    """Mean per row of a padded matrix over valid entries; NaN if none."""
+    x = jnp.asarray(x, dtype=jnp.float32)
+    mask = jnp.asarray(mask)
+    n = mask.sum(axis=-1)
+    s = jnp.where(mask, x, 0.0).sum(axis=-1)
+    return jnp.where(n > 0, s / n, jnp.nan)
+
+
+def masked_spearman(x, mask):
+    """Spearman correlation of each padded row against its session index.
+
+    The device form of the reference's per-project
+    ``spearmanr(range(n), coverage_trend)`` loop
+    (rq2_coverage_count.py:316-320): average-rank ties (scipy's default),
+    Pearson on the ranks.  x: [R, C]; mask: [R, C] bool.  Rows with < 2
+    valid entries or zero variance return NaN.
+    """
+    x = jnp.asarray(x, dtype=jnp.float32)
+    mask = jnp.asarray(mask)
+    C = x.shape[-1]
+
+    def one_row(xr, mr):
+        big = jnp.float32(np.finfo(np.float32).max)
+        filled = jnp.where(mr, xr, big)
+        order = jnp.argsort(filled)          # valid entries first, by value
+        sorted_vals = filled[order]
+        pos = jnp.arange(C, dtype=jnp.float32)
+        new_grp = jnp.concatenate(
+            [jnp.ones((1,), jnp.bool_), sorted_vals[1:] != sorted_vals[:-1]])
+        gid = jnp.cumsum(new_grp.astype(jnp.int32)) - 1
+        gsum = jax.ops.segment_sum(pos, gid, num_segments=C)
+        gcnt = jax.ops.segment_sum(jnp.ones(C, jnp.float32), gid,
+                                   num_segments=C)
+        avg_pos = gsum / jnp.maximum(gcnt, 1.0)
+        ranks_sorted = avg_pos[gid] + 1.0     # 1-based average ranks
+        ranks = jnp.zeros(C, jnp.float32).at[order].set(ranks_sorted)
+        # index ranks: 1..n over valid entries in original order (no ties)
+        idx_rank = jnp.cumsum(mr.astype(jnp.float32)) * mr
+        n = mr.sum().astype(jnp.float32)
+        rx = jnp.where(mr, ranks, 0.0)
+        ry = idx_rank
+        sx, sy = rx.sum(), ry.sum()
+        sxx = (rx * rx).sum()
+        syy = (ry * ry).sum()
+        sxy = (rx * ry).sum()
+        cov = sxy - sx * sy / jnp.maximum(n, 1.0)
+        vx = sxx - sx * sx / jnp.maximum(n, 1.0)
+        vy = syy - sy * sy / jnp.maximum(n, 1.0)
+        denom = jnp.sqrt(vx * vy)
+        return jnp.where((n >= 2) & (denom > 0), cov / denom, jnp.nan)
+
+    return jax.vmap(one_row)(x, mask)
+
+
 def masked_percentile(x, mask, q):
     """Percentile per row of a padded matrix, ignoring masked-out entries.
 
