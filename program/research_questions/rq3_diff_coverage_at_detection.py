@@ -1,0 +1,20 @@
+"""RQ3 entry point — drop-in replacement for the reference's
+``program/research_questions/rq3_diff_coverage_at_detection.py``; the engine
+lives in ``tse1m_tpu.analysis.rq3`` and is selected by envFile.ini's backend
+key."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+from tse1m_tpu.analysis.rq3 import run_rq3  # noqa: E402
+from tse1m_tpu.config import load_config  # noqa: E402
+
+
+def main():
+    run_rq3(load_config())
+
+
+if __name__ == "__main__":
+    main()

@@ -9,7 +9,7 @@ from tse1m_tpu.analysis.rq2_changepoints import run_rq2_changepoints
 from tse1m_tpu.analysis.rq2_trends import run_rq2_trends
 from tse1m_tpu.backend.jax_backend import JaxBackend
 from tse1m_tpu.backend.pandas_backend import PandasBackend, floor_day_ns
-from tse1m_tpu.config import Config
+from tse1m_tpu.config import Config, RESULT_OK
 from tse1m_tpu.data.columnar import StudyArrays
 
 LIMIT = "2026-01-01"
@@ -56,7 +56,8 @@ def test_change_points_oracle(arrays, limit_ns, study_db):
     for project in arrays.projects:
         rows = study_db.query(
             "SELECT timecreated, modules, revisions FROM buildlog_data "
-            "WHERE project = ? AND build_type='Coverage' AND result='Finish' "
+            "WHERE project = ? AND build_type='Coverage' "
+            f"AND result IN {tuple(RESULT_OK)} "
             "AND timecreated < ? ORDER BY timecreated", (project, LIMIT))
         cov = study_db.query(
             "SELECT date FROM total_coverage WHERE project = ? AND date < ?",
@@ -76,9 +77,9 @@ def test_change_points_oracle(arrays, limit_ns, study_db):
         assert got.get(project, []) == expect, project
 
 
-def test_trends_backend_parity(arrays):
-    pd_res = PandasBackend().rq2_trends(arrays)
-    jx_res = JaxBackend().rq2_trends(arrays)
+def test_trends_backend_parity(arrays, limit_ns):
+    pd_res = PandasBackend().rq2_trends(arrays, limit_ns)
+    jx_res = JaxBackend().rq2_trends(arrays, limit_ns)
     np.testing.assert_array_equal(pd_res.mask, jx_res.mask)
     np.testing.assert_allclose(pd_res.matrix, jx_res.matrix, equal_nan=True)
     np.testing.assert_array_equal(pd_res.counts, jx_res.counts)
@@ -91,10 +92,10 @@ def test_trends_backend_parity(arrays):
     assert pd_res.matrix.shape[1] >= 365
 
 
-def test_trends_spearman_matches_scipy(arrays):
+def test_trends_spearman_matches_scipy(arrays, limit_ns):
     from scipy.stats import spearmanr
 
-    jx_res = JaxBackend().rq2_trends(arrays)
+    jx_res = JaxBackend().rq2_trends(arrays, limit_ns)
     for p in range(arrays.n_projects):
         t = jx_res.matrix[p, jx_res.mask[p]]
         if len(t) >= 2:

@@ -1,0 +1,141 @@
+"""RQ3: backend parity (pandas vs jax), oracle correctness vs a brute-force
+re-derivation of the reference's per-issue loop
+(rq3_diff_coverage_at_detection.py:241-302), and end-to-end artifacts."""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tse1m_tpu.analysis.rq3 import run_rq3, summary_statistics
+from tse1m_tpu.backend.jax_backend import JaxBackend
+from tse1m_tpu.backend.pandas_backend import PandasBackend
+from tse1m_tpu.config import Config, RESULT_OK
+from tse1m_tpu.data.columnar import StudyArrays
+
+LIMIT = "2026-01-01"
+
+
+@pytest.fixture(scope="module")
+def arrays(study_db):
+    cfg = Config(engine="sqlite", sqlite_path=study_db.config.sqlite_path,
+                 limit_date=LIMIT)
+    return StudyArrays.from_db(study_db, cfg)
+
+
+@pytest.fixture(scope="module")
+def limit_ns():
+    return int(np.datetime64(LIMIT, "ns").astype(np.int64))
+
+
+@pytest.fixture(scope="module")
+def pd_result(arrays, limit_ns):
+    return PandasBackend().rq3_coverage_at_detection(arrays, limit_ns)
+
+
+def test_backend_parity(arrays, limit_ns, pd_result):
+    jx = JaxBackend().rq3_coverage_at_detection(arrays, limit_ns)
+    for f in ("det_diff_percent", "det_diff_covered", "det_diff_total",
+              "det_project_idx", "det_issue_idx", "det_issue_time_ns",
+              "nondet_diff_percent", "nondet_diff_covered",
+              "nondet_diff_total", "nondet_project_idx"):
+        np.testing.assert_array_equal(getattr(pd_result, f), getattr(jx, f),
+                                      err_msg=f)
+
+
+def test_fixture_has_signal(pd_result):
+    # The synthetic study must exercise both branches non-trivially.
+    assert pd_result.det_diff_percent.size >= 10
+    assert pd_result.nondet_diff_percent.size >= 1000
+
+
+def test_oracle_reference_semantics(arrays, limit_ns, study_db, pd_result):
+    """Replay the reference's Python control flow straight from DB rows."""
+    day = np.timedelta64(1, "D")
+    limit = np.datetime64(LIMIT)
+    limit_p1 = str(limit + day)
+
+    detected, non_detected = [], []
+    for proj in arrays.projects:
+        issues = study_db.query(
+            "SELECT rts FROM issues WHERE project=? AND rts<? AND status IN "
+            "('Fixed','Fixed (Verified)') ORDER BY rts, number", (proj, LIMIT))
+        if not issues:
+            continue
+        fuzz = study_db.query(
+            "SELECT timecreated, revisions FROM buildlog_data WHERE project=? "
+            f"AND build_type='Fuzzing' AND result IN {tuple(RESULT_OK)} "
+            "AND timecreated<? ORDER BY timecreated", (proj, LIMIT))
+        covb = study_db.query(
+            "SELECT timecreated, revisions, result FROM buildlog_data "
+            "WHERE project=? AND build_type='Coverage' AND timecreated<? "
+            "ORDER BY timecreated", (proj, limit_p1))
+        cov = study_db.query(
+            "SELECT date, covered_line, total_line FROM total_coverage "
+            "WHERE project=? AND covered_line IS NOT NULL AND date<? "
+            "ORDER BY date", (proj, limit_p1))
+        det_days = set()
+        for (rts,) in issues if (fuzz and covb and cov) else []:
+            rts_ts = pd.Timestamp(rts)
+            lf = next((b for b in reversed(fuzz)
+                       if pd.Timestamp(b[0]) < rts_ts), None)
+            if lf is None:
+                continue
+            fc = next((b for b in covb if pd.Timestamp(b[0]) > rts_ts), None)
+            if fc is None or fc[2] not in RESULT_OK:
+                continue
+            gap = (pd.Timestamp(fc[0]) - pd.Timestamp(lf[0])).total_seconds()
+            if gap / 3600 > 24:
+                continue
+            strip = lambda s: sorted(s.strip("{}").split(","))  # noqa: E731
+            if strip(lf[1]) != strip(fc[1]):
+                continue
+            target = rts_ts.normalize() + pd.Timedelta(days=1)
+            pair = None
+            for i in range(1, len(cov)):
+                if pd.Timestamp(cov[i][0]) == target:
+                    if cov[i][1] == 0:
+                        break
+                    pair = (cov[i - 1], cov[i])
+                    break
+            if pair and pair[0][2] > 0 and pair[1][2] > 0:
+                detected.append(
+                    (pair[1][1] / pair[1][2] - pair[0][1] / pair[0][2]) * 100)
+                det_days.add(rts_ts.normalize())
+        for i in range(1, len(cov)):
+            if pd.Timestamp(cov[i][0]) in det_days:
+                continue
+            if cov[i - 1][2] > 0 and cov[i][2] > 0:
+                non_detected.append(
+                    (cov[i][1] / cov[i][2] - cov[i - 1][1] / cov[i - 1][2]) * 100)
+
+    np.testing.assert_allclose(np.sort(pd_result.det_diff_percent),
+                               np.sort(detected), rtol=1e-12)
+    np.testing.assert_allclose(np.sort(pd_result.nondet_diff_percent),
+                               np.sort(non_detected), rtol=1e-12)
+
+
+def test_summary_statistics():
+    s = summary_statistics(np.array([-1.0, 0.0, 1.0, 3.0]))
+    assert s["count"] == 4
+    assert s["positive_pct"] == 50.0
+    assert s["zero_pct"] == 25.0
+    assert s["negative_pct"] == 25.0
+    assert s["median"] == 0.5
+
+
+@pytest.mark.parametrize("backend", ["pandas", "jax_tpu"])
+def test_run_rq3_end_to_end(study_db, tmp_path, backend):
+    cfg = Config(engine="sqlite", sqlite_path=study_db.config.sqlite_path,
+                 backend=backend, result_dir=str(tmp_path), limit_date=LIMIT)
+    out = run_rq3(cfg, db=study_db)
+    assert os.path.exists(out["detected_csv"])
+    df = pd.read_csv(out["detected_csv"])
+    assert list(df.columns) == ["CoverageChangePercent", "CoveredLinesChange",
+                                "TotalLinesChange"]
+    assert len(df) == out["summary"]["detected"]["count"]
+    assert "brunner_munzel" in out["tests"]
+    for pdf in ("coverage_diff_boxplot.pdf", "coverage_diff_histograms.pdf",
+                "detected.pdf", "non_detected.pdf"):
+        assert os.path.exists(tmp_path / "rq3" / pdf)

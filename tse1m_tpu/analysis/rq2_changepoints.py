@@ -34,13 +34,6 @@ HEADER = [
 ]
 
 
-def _fmt_num(x) -> str:
-    """Reference rows carry raw floats; NaN prints as empty (csv of np.nan
-    would print 'nan' — the pandas reference writes them via csv.writer the
-    same way, so keep 'nan' verbatim for byte parity)."""
-    return x
-
-
 def change_rows(ctx: StudyContext, result) -> dict[str, list[list]]:
     """Per-project lists of CSV rows in reference column order."""
     covb = ctx.arrays.covb

@@ -25,7 +25,7 @@ import os
 
 import numpy as np
 
-from .common import StudyContext
+from .common import StudyContext, limit_date_ns
 from ..config import Config
 from ..utils.logging import get_logger
 from ..utils.manifest import RunManifest
@@ -91,7 +91,7 @@ def plot_session_boxplot(result, path: str, min_projects: int,
     ax2.bar(range(1, len(data) + 1), [len(d) for d in data],
             color="#88c778", alpha=0.6, zorder=1)
     ax2.set_ylabel("Number of Projects")
-    box = ax1.boxplot(data, vert=True, patch_artist=True, zorder=3)
+    box = ax1.boxplot(data, patch_artist=True, zorder=3)
     for patch in box["boxes"]:
         patch.set_facecolor("#e3eefa")
     for median in box["medians"]:
@@ -202,7 +202,7 @@ def run_rq2_trends(cfg: Config | None = None, db=None,
     manifest = RunManifest("rq2_trends", ctx.backend.name)
 
     with timer.phase("trend_kernel"):
-        result = ctx.backend.rq2_trends(ctx.arrays)
+        result = ctx.backend.rq2_trends(ctx.arrays, limit_date_ns(ctx.cfg))
 
     # Shapiro-Wilk normality per project (rq2:305-314) — host scipy on the
     # already-reduced per-project trends.

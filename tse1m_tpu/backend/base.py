@@ -103,6 +103,33 @@ class RQ2TrendsResult:
     counts: np.ndarray       # [S]
 
 
+@dataclass
+class RQ3Result:
+    """Coverage change at detection vs elsewhere
+    (rq3_diff_coverage_at_detection.py:202-302).
+
+    Detected rows: for each fixed issue that links to a fuzzing build, a
+    nearby successful coverage build with identical revisions (<24h gap),
+    and a day-after coverage report — the (prev, day-after) coverage delta.
+    Non-detected rows: every other consecutive coverage-day pair of projects
+    with >= 1 fixed issue, excluding pairs whose current date equals a
+    detected issue's report date (the reference's exclusion key, rq3:249-251).
+    det_issue_idx indexes into arrays.issues rows; *_project_idx into
+    arrays.projects.
+    """
+
+    det_diff_percent: np.ndarray
+    det_diff_covered: np.ndarray
+    det_diff_total: np.ndarray
+    det_project_idx: np.ndarray
+    det_issue_idx: np.ndarray
+    det_issue_time_ns: np.ndarray
+    nondet_diff_percent: np.ndarray
+    nondet_diff_covered: np.ndarray
+    nondet_diff_total: np.ndarray
+    nondet_project_idx: np.ndarray
+
+
 class Backend(abc.ABC):
     name: str
 
@@ -117,5 +144,11 @@ class Backend(abc.ABC):
         ...
 
     @abc.abstractmethod
-    def rq2_trends(self, arrays: StudyArrays) -> RQ2TrendsResult:
+    def rq2_trends(self, arrays: StudyArrays,
+                   limit_date_ns: int) -> RQ2TrendsResult:
+        ...
+
+    @abc.abstractmethod
+    def rq3_coverage_at_detection(self, arrays: StudyArrays,
+                                  limit_date_ns: int) -> RQ3Result:
         ...

@@ -16,12 +16,22 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .base import (Backend, RQ1Result, RQ2ChangePointsResult, RQ2TrendsResult)
-from .pandas_backend import floor_day_ns
+from .base import (Backend, RQ1Result, RQ2ChangePointsResult, RQ2TrendsResult,
+                   RQ3Result)
+from .pandas_backend import DAY_NS, HOUR_NS, floor_day_ns
 from ..data.columnar import StudyArrays, ns_to_device_pair
 from ..ops.segment import (counts_to_survival, masked_mean, masked_percentile,
                            masked_spearman, segment_searchsorted,
                            unique_pairs_count_per_iteration)
+
+
+def masked_csr(offsets: np.ndarray, mask: np.ndarray):
+    """Filter a CSR view by a row mask: returns (original row indices of the
+    kept rows, new per-segment offsets).  Robust to empty segments — offsets
+    are boundary values of the running kept-row count."""
+    pos = np.flatnonzero(mask)
+    running = np.concatenate([[0], np.cumsum(mask.astype(np.int64))])
+    return pos, running[offsets]
 
 
 @partial(jax.jit, static_argnames=("n_projects", "max_iter"))
@@ -68,12 +78,9 @@ class JaxBackend(Backend):
 
         btimes_ns = arrays.fuzz.columns["time_ns"]
         fs, fns = ns_to_device_pair(btimes_ns)
-        ok_mask = arrays.fuzz.columns["ok"] & (btimes_ns < limit_date_ns)
-        ok_pos = np.flatnonzero(ok_mask)
-        # Per-segment successful-build offsets via boundary differences of
-        # the running sum (robust to empty segments).
-        running = np.concatenate([[0], np.cumsum(ok_mask.astype(np.int64))])
-        ok_offsets = running[arrays.fuzz.offsets]
+        ok_pos, ok_offsets = masked_csr(
+            arrays.fuzz.offsets,
+            arrays.fuzz.columns["ok"] & (btimes_ns < limit_date_ns))
 
         issue_seg = np.repeat(np.arange(P), arrays.issues.counts())
         is_, ins = ns_to_device_pair(arrays.issues.columns["time_ns"])
@@ -108,10 +115,16 @@ class JaxBackend(Backend):
         values are bit-exact vs the pandas backend."""
         covb_t = arrays.covb.columns["time_ns"]
         ghash = arrays.covb.columns["grouphash"]
-        n_covb = len(arrays.covb)
         seg_all = np.repeat(np.arange(arrays.n_projects), arrays.covb.counts())
-        has_cov = arrays.cov.counts() > 0
-        keep = (covb_t < limit_date_ns) & has_cov[seg_all]
+        # cov rows are fetched to limit+1 day; restrict the join (and the
+        # project-has-coverage guard) to pre-cutoff rows via a masked CSR
+        # (dates ascend within a segment, so the mask keeps a prefix).
+        cov_date_all = arrays.cov.columns["date_ns"]
+        cov_pos, cov_offsets = masked_csr(arrays.cov.offsets,
+                                          cov_date_all < limit_date_ns)
+        has_cov = np.diff(cov_offsets) > 0
+        keep = ((covb_t < limit_date_ns) & arrays.covb.columns["ok"]
+                & has_cov[seg_all])
         rows = np.flatnonzero(keep)
         if rows.size == 0:
             e = np.empty(0, np.int64)
@@ -135,22 +148,24 @@ class JaxBackend(Backend):
             f = np.empty(0, np.float64)
             return RQ2ChangePointsResult(e, e, e, f, f, f, f)
 
-        cov_days = arrays.cov.columns["date_ns"]
+        cov_days = cov_date_all[cov_pos]
+        cov_covered = arrays.cov.columns["covered"][cov_pos]
+        cov_total = arrays.cov.columns["total"][cov_pos]
         q_days = np.concatenate([floor_day_ns(covb_t[end_i]),
                                  floor_day_ns(covb_t[start_ip1])])
         q_seg = np.concatenate([proj, proj])
         ds, dns = ns_to_device_pair(cov_days)
         qs, qns = ns_to_device_pair(q_days)
         pos = np.asarray(segment_searchsorted(
-            ds, jnp.asarray(arrays.cov.offsets, dtype=jnp.int32),
+            ds, jnp.asarray(cov_offsets, dtype=jnp.int32),
             qs, q_seg.astype(np.int32), side="left",
             values_lo=dns, queries_lo=qns))
-        gidx = arrays.cov.offsets[q_seg] + pos
-        in_seg = gidx < arrays.cov.offsets[q_seg + 1]
-        safe = np.clip(gidx, 0, max(len(arrays.cov) - 1, 0))
+        gidx = cov_offsets[q_seg] + pos
+        in_seg = gidx < cov_offsets[q_seg + 1]
+        safe = np.clip(gidx, 0, max(cov_pos.size - 1, 0))
         matched = in_seg & (cov_days[safe] == q_days)
-        covered = np.where(matched, arrays.cov.columns["covered"][safe], np.nan)
-        total = np.where(matched, arrays.cov.columns["total"][safe], np.nan)
+        covered = np.where(matched, cov_covered[safe], np.nan)
+        total = np.where(matched, cov_total[safe], np.nan)
         n = end_i.size
         return RQ2ChangePointsResult(
             project_idx=proj.astype(np.int64),
@@ -160,13 +175,132 @@ class JaxBackend(Backend):
             covered_ip1=covered[n:], total_ip1=total[n:],
         )
 
-    def rq2_trends(self, arrays: StudyArrays) -> RQ2TrendsResult:
+    def rq3_coverage_at_detection(self, arrays: StudyArrays,
+                                  limit_date_ns: int) -> RQ3Result:
+        """Vectorised form of the reference's per-issue scans (rq3:241-302):
+        the three linear searches per issue (last fuzz build, first coverage
+        build, day-after coverage row) become three device
+        segment-searchsorted calls over masked CSR arrays; the final float64
+        delta gathers stay on host for bit-exactness vs the pandas oracle.
+        Same three documented deviations as the pandas backend."""
+        P = arrays.n_projects
+        issue_t = arrays.issues.columns["time_ns"]
+        n_issues = issue_t.size
+        cutoff_plus1 = limit_date_ns + DAY_NS
+
+        fuzz_t = arrays.fuzz.columns["time_ns"]
+        f_pos, f_off = masked_csr(
+            arrays.fuzz.offsets,
+            arrays.fuzz.columns["ok"] & (fuzz_t < limit_date_ns))
+        covb_t = arrays.covb.columns["time_ns"]
+        c_pos, c_off = masked_csr(arrays.covb.offsets, covb_t < cutoff_plus1)
+        v_pos, v_off = masked_csr(
+            arrays.cov.offsets, ~np.isnan(arrays.cov.columns["covered"]))
+        days = arrays.cov.columns["date_ns"][v_pos]
+        covered = arrays.cov.columns["covered"][v_pos]
+        total = arrays.cov.columns["total"][v_pos]
+
+        issue_seg = np.repeat(np.arange(P), arrays.issues.counts())
+        # Projects must have all three inputs (rq3:266).
+        has_all = ((np.diff(f_off) > 0) & (np.diff(c_off) > 0)
+                   & (np.diff(v_off) > 0))
+
+        def dev(x):
+            return jnp.asarray(x)
+
+        can_detect = bool(n_issues and f_pos.size and c_pos.size and v_pos.size)
+        seg32 = issue_seg.astype(np.int32)
+        is_, ins = ns_to_device_pair(issue_t)
+        fts, ftn = ns_to_device_pair(fuzz_t[f_pos])
+        cts, ctn = ns_to_device_pair(covb_t[c_pos])
+        # Last successful fuzzing build strictly before rts (rq3:269).
+        pos_f = np.asarray(segment_searchsorted(
+            dev(fts), jnp.asarray(f_off, jnp.int32), dev(is_), seg32,
+            side="left", values_lo=dev(ftn), queries_lo=dev(ins)))
+        # First coverage build strictly after rts (rq3:273).
+        pos_c = np.asarray(segment_searchsorted(
+            dev(cts), jnp.asarray(c_off, jnp.int32), dev(is_), seg32,
+            side="right", values_lo=dev(ctn), queries_lo=dev(ins)))
+        # Day-after coverage row (rq3:287-293).
+        target = floor_day_ns(issue_t) + DAY_NS
+        dts, dtn = ns_to_device_pair(days)
+        qts, qtn = ns_to_device_pair(target)
+        pos_d = np.asarray(segment_searchsorted(
+            dev(dts), jnp.asarray(v_off, jnp.int32), dev(qts), seg32,
+            side="left", values_lo=dev(dtn), queries_lo=dev(qtn)))
+
+        if can_detect:
+            cand = (has_all[issue_seg] & (pos_f > 0)
+                    & (pos_c < np.diff(c_off)[issue_seg]))
+            k_glob = np.where(cand, f_off[issue_seg] + pos_f - 1, 0)
+            m_glob = np.where(cand, c_off[issue_seg] + pos_c, 0)
+            m_glob = np.clip(m_glob, 0, c_pos.size - 1)
+            cand &= arrays.covb.columns["ok"][c_pos[m_glob]]
+            cand &= (covb_t[c_pos[m_glob]]
+                     - fuzz_t[f_pos[k_glob]]) <= 24 * HOUR_NS
+            if cand.any():
+                rev_eq = np.zeros(n_issues, dtype=bool)
+                ci = np.flatnonzero(cand)
+                rev_eq[ci] = (arrays.fuzz_revhash_at(f_pos[k_glob[ci]])
+                              == arrays.covb.columns["revhash"][c_pos[m_glob[ci]]])
+                cand &= rev_eq
+            i_glob = np.where(cand, v_off[issue_seg] + pos_d, 0)
+            in_seg = pos_d < np.diff(v_off)[issue_seg]
+            safe = np.clip(i_glob, 0, max(days.size - 1, 0))
+            cand &= (in_seg & (i_glob > v_off[issue_seg])
+                     & (days[safe] == target) & (covered[safe] != 0)
+                     & (total[np.maximum(safe - 1, 0)] > 0) & (total[safe] > 0))
+            di = np.flatnonzero(cand)
+            gi = i_glob[di]
+        else:
+            di = np.empty(0, np.int64)
+            gi = np.empty(0, np.int64)
+        det_pct = ((covered[gi] / total[gi]
+                    - covered[gi - 1] / total[gi - 1]) * 100.0)
+
+        # Non-detected: all other consecutive coverage-day pairs of projects
+        # with >= 1 fixed issue (rq3:246-257), excluding pairs whose current
+        # date equals a detected issue's report date.
+        has_issues = arrays.issues.counts() > 0
+        row_seg = np.repeat(np.arange(P), np.diff(v_off))
+        not_start = np.ones(days.size, dtype=bool)
+        not_start[v_off[:-1][v_off[:-1] < days.size]] = False
+        pair_i = np.flatnonzero(not_start)
+        pair_seg = row_seg[pair_i]
+        keep = (has_issues[pair_seg] & (total[pair_i - 1] > 0)
+                & (total[pair_i] > 0))
+        if di.size:
+            det_key = (issue_seg[di].astype(np.int64) << 32) | (
+                floor_day_ns(issue_t[di]) // DAY_NS)
+            pair_key = (pair_seg.astype(np.int64) << 32) | (days[pair_i] // DAY_NS)
+            keep &= ~np.isin(pair_key, det_key)
+        ni = pair_i[keep]
+        nd_pct = ((covered[ni] / total[ni]
+                   - covered[ni - 1] / total[ni - 1]) * 100.0)
+
+        return RQ3Result(
+            det_diff_percent=det_pct,
+            det_diff_covered=covered[gi] - covered[gi - 1],
+            det_diff_total=total[gi] - total[gi - 1],
+            det_project_idx=issue_seg[di].astype(np.int64),
+            det_issue_idx=di.astype(np.int64),
+            det_issue_time_ns=issue_t[di],
+            nondet_diff_percent=nd_pct,
+            nondet_diff_covered=covered[ni] - covered[ni - 1],
+            nondet_diff_total=total[ni] - total[ni - 1],
+            nondet_project_idx=pair_seg[keep].astype(np.int64),
+        )
+
+    def rq2_trends(self, arrays: StudyArrays,
+                   limit_date_ns: int) -> RQ2TrendsResult:
         P = arrays.n_projects
         cov = arrays.cov
         coverage = cov.columns["coverage"]
         covered = cov.columns["covered"]
         total = cov.columns["total"]
-        sel = (~np.isnan(coverage)) & (coverage != 0) & (total != 0)
+        sel = ((~np.isnan(coverage)) & (coverage != 0) & (total != 0)
+               & ~np.isnan(total) & ~np.isnan(covered)
+               & (cov.columns["date_ns"] < limit_date_ns))
         seg_all = np.repeat(np.arange(P), cov.counts())
         lens = np.bincount(seg_all[sel], minlength=P)
         S = int(lens.max()) if lens.size else 0

@@ -10,10 +10,12 @@ from __future__ import annotations
 
 import numpy as np
 
-from .base import (Backend, RQ1Result, RQ2ChangePointsResult, RQ2TrendsResult)
+from .base import (Backend, RQ1Result, RQ2ChangePointsResult, RQ2TrendsResult,
+                   RQ3Result)
 from ..data.columnar import StudyArrays
 
 DAY_NS = 86_400_000_000_000
+HOUR_NS = 3_600_000_000_000
 
 
 def floor_day_ns(ns: np.ndarray) -> np.ndarray:
@@ -96,16 +98,23 @@ class PandasBackend(Backend):
                                "covered_i", "total_i", "covered_ip1",
                                "total_ip1")}
         covb_t = arrays.covb.columns["time_ns"]
+        covb_ok = arrays.covb.columns["ok"]
         ghash = arrays.covb.columns["grouphash"]
         for p in range(arrays.n_projects):
             lo, hi = arrays.covb.offsets[p], arrays.covb.offsets[p + 1]
-            rows = np.arange(lo, hi)[covb_t[lo:hi] < limit_date_ns]
+            # Successful pre-cutoff coverage builds only (the reference's
+            # GET_BUILD_LOGS filter, rq2_coverage_and_added.py:60-68).
+            rows = np.arange(lo, hi)[(covb_t[lo:hi] < limit_date_ns)
+                                     & covb_ok[lo:hi]]
             clo, chi = arrays.cov.offsets[p], arrays.cov.offsets[p + 1]
-            if rows.size == 0 or chi == clo:
+            # cov rows are fetched to limit+1 day; this RQ joins against
+            # pre-cutoff rows only (reference rq2:44 fetches date < limit).
+            cov_in = arrays.cov.columns["date_ns"][clo:chi] < limit_date_ns
+            if rows.size == 0 or not cov_in.any():
                 continue  # reference skips projects missing either input
-            cov_days = arrays.cov.columns["date_ns"][clo:chi]
-            cov_covered = arrays.cov.columns["covered"][clo:chi]
-            cov_total = arrays.cov.columns["total"][clo:chi]
+            cov_days = arrays.cov.columns["date_ns"][clo:chi][cov_in]
+            cov_covered = arrays.cov.columns["covered"][clo:chi][cov_in]
+            cov_total = arrays.cov.columns["total"][clo:chi][cov_in]
 
             g = ghash[rows]
             new_group = np.concatenate([[True], g[1:] != g[:-1]])
@@ -140,16 +149,113 @@ class PandasBackend(Backend):
             total_ip1=np.array(out["total_ip1"], dtype=np.float64),
         )
 
-    def rq2_trends(self, arrays: StudyArrays) -> RQ2TrendsResult:
+    def rq3_coverage_at_detection(self, arrays: StudyArrays,
+                                  limit_date_ns: int) -> RQ3Result:
+        """Oracle mirror of the reference's per-issue loop
+        (rq3_diff_coverage_at_detection.py:241-302), with three documented
+        deviations: (a) result filters use the canonical RESULT_OK enum (the
+        reference's 'HalfWay' spelling matched only 'Finish' rows, rq3:261,
+        274); (b) revision-set equality is exact over parsed arrays (the
+        reference's ``[1:-2].split(',')`` truncates the final element's last
+        character, rq3:280); (c) the final project's non-detected pairs are
+        included (the reference only flushes them on project *change*,
+        rq3:246-257, silently dropping the last project)."""
+        det = {k: [] for k in ("pct", "cov", "tot", "proj", "issue", "rts")}
+        nondet = {k: [] for k in ("pct", "cov", "tot", "proj")}
+        fuzz_t = arrays.fuzz.columns["time_ns"]
+        fuzz_ok = arrays.fuzz.columns["ok"]
+        covb_t = arrays.covb.columns["time_ns"]
+        covb_ok = arrays.covb.columns["ok"]
+        covb_rev = arrays.covb.columns["revhash"]
+        issue_t = arrays.issues.columns["time_ns"]
+        cutoff_plus1 = limit_date_ns + DAY_NS
+
+        for p in range(arrays.n_projects):
+            ilo, ihi = arrays.issues.offsets[p], arrays.issues.offsets[p + 1]
+            if ihi == ilo:
+                continue  # projects without fixed issues never enter rq3:241
+            flo, fhi = arrays.fuzz.offsets[p], arrays.fuzz.offsets[p + 1]
+            fsel = np.flatnonzero(fuzz_ok[flo:fhi]
+                                  & (fuzz_t[flo:fhi] < limit_date_ns)) + flo
+            ftimes = fuzz_t[fsel]
+            clo, chi = arrays.covb.offsets[p], arrays.covb.offsets[p + 1]
+            csel = np.flatnonzero(covb_t[clo:chi] < cutoff_plus1) + clo
+            ctimes = covb_t[csel]
+            vseg = arrays.cov.segment(p)
+            vsel = ~np.isnan(vseg["covered"])
+            days = vseg["date_ns"][vsel]
+            covered = vseg["covered"][vsel]
+            total = vseg["total"][vsel]
+            detected_days = set()
+            # Empty inputs skip issue *processing* only (rq3:266); the
+            # non-detected flush still runs for the project (rq3:246-257).
+            can_detect = ftimes.size and ctimes.size and days.size
+            for j in range(ilo, ihi) if can_detect else ():
+                rts = issue_t[j]
+                k = np.searchsorted(ftimes, rts, side="left") - 1
+                if k < 0:
+                    continue  # no fuzzing build strictly before rts (rq3:269)
+                m = np.searchsorted(ctimes, rts, side="right")
+                if m >= ctimes.size or not covb_ok[csel[m]]:
+                    continue  # rq3:273-274
+                if ctimes[m] - ftimes[k] > 24 * HOUR_NS:
+                    continue  # rq3:277
+                if arrays.fuzz_revhash_at([fsel[k]])[0] != covb_rev[csel[m]]:
+                    continue  # rq3:280
+                target = floor_day_ns(rts) + DAY_NS
+                i = int(np.searchsorted(days, target, side="left"))
+                if i == 0 or i >= days.size or days[i] != target:
+                    continue  # day-after row absent (rq3:287-293)
+                if covered[i] == 0:
+                    continue  # rq3:289-290 breaks the search -> issue skipped
+                if total[i - 1] > 0 and total[i] > 0:
+                    det["pct"].append((covered[i] / total[i]
+                                       - covered[i - 1] / total[i - 1]) * 100.0)
+                    det["cov"].append(covered[i] - covered[i - 1])
+                    det["tot"].append(total[i] - total[i - 1])
+                    det["proj"].append(p)
+                    det["issue"].append(j)
+                    det["rts"].append(rts)
+                    detected_days.add(floor_day_ns(rts))
+
+            for i in range(1, days.size):
+                if days[i] in detected_days:
+                    continue  # exclusion key = issue report date (rq3:249-251)
+                if total[i - 1] > 0 and total[i] > 0:
+                    nondet["pct"].append((covered[i] / total[i]
+                                          - covered[i - 1] / total[i - 1]) * 100.0)
+                    nondet["cov"].append(covered[i] - covered[i - 1])
+                    nondet["tot"].append(total[i] - total[i - 1])
+                    nondet["proj"].append(p)
+
+        return RQ3Result(
+            det_diff_percent=np.array(det["pct"], dtype=np.float64),
+            det_diff_covered=np.array(det["cov"], dtype=np.float64),
+            det_diff_total=np.array(det["tot"], dtype=np.float64),
+            det_project_idx=np.array(det["proj"], dtype=np.int64),
+            det_issue_idx=np.array(det["issue"], dtype=np.int64),
+            det_issue_time_ns=np.array(det["rts"], dtype=np.int64),
+            nondet_diff_percent=np.array(nondet["pct"], dtype=np.float64),
+            nondet_diff_covered=np.array(nondet["cov"], dtype=np.float64),
+            nondet_diff_total=np.array(nondet["tot"], dtype=np.float64),
+            nondet_project_idx=np.array(nondet["proj"], dtype=np.int64),
+        )
+
+    def rq2_trends(self, arrays: StudyArrays,
+                   limit_date_ns: int) -> RQ2TrendsResult:
         from scipy.stats import spearmanr
 
         P = arrays.n_projects
         trends = []
         for p in range(P):
             seg = arrays.cov.segment(p)
-            sel = (~np.isnan(seg["coverage"])) & (seg["coverage"] != 0)
+            sel = ((~np.isnan(seg["coverage"])) & (seg["coverage"] != 0)
+                   & (seg["date_ns"] < limit_date_ns))
             covered, total = seg["covered"][sel], seg["total"][sel]
-            keep = total != 0  # reference drops zero-total sessions (rq2:302)
+            # Reference drops zero-total sessions (rq2:302); rows with
+            # non-null coverage but NULL covered/total lines must drop too
+            # (NaN passes a bare != 0).
+            keep = (total != 0) & ~np.isnan(total) & ~np.isnan(covered)
             trends.append(covered[keep] / total[keep] * 100.0)
 
         S = max((len(t) for t in trends), default=0)

@@ -150,7 +150,7 @@ class StudyArrays:
             },
         )
 
-        # Coverage builds with precomputed revision-set hashes.
+        # Coverage builds (all results) with precomputed revision-set hashes.
         sql, params = queries.coverage_builds_bulk(projects)
         rows, ccodes = order_rows(db.query(sql, params))
         revs = [parse_array(r[4]) for r in rows]
@@ -161,6 +161,8 @@ class StudyArrays:
                 "name": np.array([r[1] for r in rows], dtype=object),
                 "modules": np.array([parse_array(r[3]) for r in rows], dtype=object),
                 "revisions": np.array(revs, dtype=object),
+                "result": np.array([r[5] for r in rows], dtype=object),
+                "ok": np.array([r[5] in RESULT_OK for r in rows], dtype=bool),
                 "revhash": np.array([rev_hash(r) for r in revs], dtype=np.int64)
                 if rows else np.empty(0, np.int64),
                 "grouphash": np.array([group_hash(r[3], r[4]) for r in rows],
@@ -182,8 +184,11 @@ class StudyArrays:
             },
         )
 
-        # Daily coverage rows (non-zero, pre-cutoff).
-        sql, params = queries.total_coverage_bulk(projects, cfg.limit_date)
+        # Daily coverage rows up to limit_date + 1 day: RQ3 reads the
+        # boundary day (rq3:263 fetches DATE(date) < limit + 1); every other
+        # consumer masks date_ns < limit back down to the study cutoff.
+        plus1 = str(np.datetime64(cfg.limit_date) + np.timedelta64(1, "D"))
+        sql, params = queries.total_coverage_bulk(projects, plus1)
         rows, vcodes = order_rows(db.query(sql, params))
         cov = Segmented(
             offsets=_offsets_from_sorted_codes(vcodes, len(projects)),
@@ -201,6 +206,20 @@ class StudyArrays:
         log.info("columnar: %d fuzz builds, %d coverage builds, %d issues, %d coverage days",
                  len(fuzz), len(covb), len(issues), len(cov))
         return cls(projects=projects, fuzz=fuzz, covb=covb, issues=issues, cov=cov)
+
+    def fuzz_revhash_at(self, idx: np.ndarray) -> np.ndarray:
+        """Revision-set hashes for the given fuzz-row indices.
+
+        Fuzz revisions are kept raw (columnar comment above); RQ3 compares
+        revision sets only for the handful of issue-linked builds
+        (rq3_diff_coverage_at_detection.py:280), so hashing on demand over
+        the gathered rows avoids a ~1M-row parse at extraction."""
+        idx = np.asarray(idx, dtype=np.int64)
+        raw = self.fuzz.columns["revisions_raw"]
+        uniq, inv = np.unique(idx, return_inverse=True)
+        hashes = np.array([rev_hash(parse_array(raw[i])) for i in uniq],
+                          dtype=np.int64)
+        return hashes[inv] if idx.size else np.empty(0, np.int64)
 
     # -- device views ------------------------------------------------------
 

@@ -123,6 +123,7 @@ def generate_study(spec: SynthSpec | None = None) -> SynthStudy:
         session_idx = 0
         build_serial = 0
         rev_sha = None
+        rev_serial = 0
         # G4 corpus introduced at a build index >= ~10; G3 within 1-7 days.
         group = int(group_labels[p])
         corpus_build_idx = None
@@ -134,7 +135,11 @@ def generate_study(spec: SynthSpec | None = None) -> SynthStudy:
             day = day0 + np.timedelta64(d, "D")
             if d % spec.revision_period == 0 or rev_sha is None:
                 rev_sha = "".join(rng.choice(list("0123456789abcdef"), 40))
-            base_serial = 350000 + d * 100
+                # Serial advances with the source revision, so all builds in
+                # one revision period share the exact revision set — the
+                # property RQ2's change-point grouping and RQ3's
+                # fuzz-vs-coverage revision-equality gate both key on.
+                rev_serial = 350000 + d * 100
 
             # Fuzzing builds.
             k = rng.poisson(spec.fuzz_rate)
@@ -154,7 +159,7 @@ def generate_study(spec: SynthSpec | None = None) -> SynthStudy:
                     "build_type": "Fuzzing",
                     "result": result,
                     "modules": "{" + name + ",libfuzzer}",
-                    "revisions": "{" + rev_sha + "," + str(base_serial + int(h)) + "}",
+                    "revisions": "{" + rev_sha + "," + str(rev_serial) + "}",
                 })
                 if corpus_build_idx is not None and session_idx == corpus_build_idx:
                     introduced_day = d
@@ -189,9 +194,12 @@ def generate_study(spec: SynthSpec | None = None) -> SynthStudy:
                 "project": name,
                 "timecreated": str(cov_ts.astype("datetime64[s]")).replace("T", " "),
                 "build_type": "Coverage",
-                "result": "Finish" if rng.random() < 0.97 else "Error",
+                # Mix in 'Halfway' so the canonical RESULT_OK handling (vs
+                # the reference's 'HalfWay' typo) is actually exercised.
+                "result": ("Finish" if (cr := rng.random()) < 0.92
+                           else ("Halfway" if cr < 0.97 else "Error")),
                 "modules": "{" + name + ",libfuzzer}",
-                "revisions": "{" + rev_sha + "," + str(base_serial + 13) + "}",
+                "revisions": "{" + rev_sha + "," + str(rev_serial) + "}",
             })
 
             # Daily coverage report row.

@@ -108,9 +108,19 @@ def coverage_builds(project: str) -> Query:
 
 
 def coverage_builds_bulk(targets: Sequence[str]) -> Query:
+    """ALL Coverage builds with their result column (no result filter).
+
+    RQ3 walks the full sequence and requires the *first* build after an
+    issue to be successful (rq3_diff_coverage_at_detection.py:273-274), so
+    OK-filtering at fetch time would change which build is "first".
+    Downstream paths mask by result instead (RQ2 change-points keep
+    RESULT_OK rows — note the reference's 'HalfWay' spelling in
+    rq2_coverage_and_added.py:65 / rq3:261 silently matched only 'Finish'
+    against the DB's 'Halfway' vocabulary; we use the canonical enum)."""
     return (
-        "SELECT project, name, timecreated, modules, revisions FROM buildlog_data "
-        f"WHERE build_type = 'Coverage' AND result = 'Finish' AND project IN {_in(targets)} "
+        "SELECT project, name, timecreated, modules, revisions, result "
+        "FROM buildlog_data "
+        f"WHERE build_type = 'Coverage' AND project IN {_in(targets)} "
         "ORDER BY project, timecreated",
         tuple(targets),
     )
@@ -208,10 +218,13 @@ def total_coverage_each_project(project: str, export_type: str,
 
 def total_coverage_bulk(targets: Sequence[str],
                         limit_date: str = DEFAULT_LIMIT_DATE) -> Query:
-    """All pre-cutoff coverage rows, unfiltered: RQ2's change-point date
-    join reads rows regardless of coverage value
+    """All coverage rows before ``limit_date``, unfiltered: RQ2's
+    change-point date join reads rows regardless of coverage value
     (rq2_coverage_and_added.py:30-47) while the trend/eligibility paths
-    apply their own coverage != 0 masks downstream."""
+    apply their own coverage != 0 masks downstream.  Callers pass
+    ``limit_date + 1 day`` when they need the boundary day RQ3 reads
+    (``DATE(date) < '2025-01-09'``, rq3_diff_coverage_at_detection.py:263)
+    and mask back down to the study cutoff elsewhere."""
     return (
         "SELECT project, date, coverage, covered_line, total_line FROM total_coverage "
         f"WHERE project IN {_in(targets)} AND date < ? "
