@@ -1,0 +1,19 @@
+"""RQ4a entry point — drop-in replacement for the reference's
+``program/research_questions/rq4a_bug.py``; the engine lives in
+``tse1m_tpu.analysis.rq4a`` and is selected by envFile.ini's backend key."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+from tse1m_tpu.analysis.rq4a import run_rq4a  # noqa: E402
+from tse1m_tpu.config import load_config  # noqa: E402
+
+
+def main():
+    run_rq4a(load_config())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,19 @@
+"""RQ4b entry point — drop-in replacement for the reference's
+``program/research_questions/rq4b_coverage.py``; the engine lives in
+``tse1m_tpu.analysis.rq4b`` and is selected by envFile.ini's backend key."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+from tse1m_tpu.analysis.rq4b import run_rq4b  # noqa: E402
+from tse1m_tpu.config import load_config  # noqa: E402
+
+
+def main():
+    run_rq4b(load_config())
+
+
+if __name__ == "__main__":
+    main()

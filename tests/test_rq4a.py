@@ -1,0 +1,170 @@
+"""RQ4a: corpus grouping, backend parity, G4 pre/post oracle, artifacts."""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tse1m_tpu.analysis.corpus import g4_prepost, load_corpus_groups
+from tse1m_tpu.analysis.rq4a import run_rq4a
+from tse1m_tpu.backend.jax_backend import JaxBackend
+from tse1m_tpu.backend.pandas_backend import PandasBackend
+from tse1m_tpu.config import Config
+from tse1m_tpu.data.columnar import StudyArrays
+
+LIMIT = "2026-01-01"
+
+
+@pytest.fixture(scope="module")
+def arrays(study_db):
+    cfg = Config(engine="sqlite", sqlite_path=study_db.config.sqlite_path,
+                 limit_date=LIMIT)
+    return StudyArrays.from_db(study_db, cfg)
+
+
+@pytest.fixture(scope="module")
+def limit_ns():
+    return int(np.datetime64(LIMIT, "ns").astype(np.int64))
+
+
+@pytest.fixture(scope="module")
+def corpus_csv(synth_study, tmp_path_factory):
+    path = tmp_path_factory.mktemp("corpus") / "project_corpus_analysis.csv"
+    synth_study.corpus_analysis.to_csv(path, index=False)
+    return str(path)
+
+
+@pytest.fixture(scope="module")
+def groups(corpus_csv, arrays):
+    return load_corpus_groups(corpus_csv, set(arrays.projects))
+
+
+def test_grouping_matches_reference_rules(groups, synth_study, arrays):
+    df = synth_study.corpus_analysis
+    df = df[df["project_name"].isin(set(arrays.projects))]
+    elapsed = pd.to_numeric(df["time_elapsed_seconds"], errors="coerce")
+    assert groups.groups["group2"] == set(df[elapsed == 0]["project_name"])
+    assert groups.groups["group4"] == set(
+        df[elapsed >= 7 * 86400]["project_name"])
+    # Every eligible project lands in exactly one group.
+    all_assigned = set().union(*groups.groups.values())
+    assert all_assigned == set(arrays.projects)
+    sizes = sum(len(v) for v in groups.groups.values())
+    assert sizes == len(arrays.projects)
+    # G4 projects all carry a commit time.
+    assert groups.groups["group4"] <= set(groups.corpus_time_ns)
+
+
+def test_missing_csv_rows_default_to_g1(corpus_csv, arrays):
+    df = pd.read_csv(corpus_csv)
+    truncated = df[df["project_name"] != sorted(arrays.projects)[0]]
+    path = corpus_csv + ".trunc.csv"
+    truncated.to_csv(path, index=False)
+    g = load_corpus_groups(path, set(arrays.projects))
+    assert sorted(arrays.projects)[0] in g.groups["group1"]
+
+
+def test_trend_backend_parity(arrays, limit_ns, groups):
+    pidx = arrays.project_index()
+    g1 = groups.indices("group1", pidx)
+    g2 = groups.indices("group2", pidx)
+    res_pd = PandasBackend().rq4a_detection_trend(arrays, limit_ns, g1, g2,
+                                                  min_projects=2)
+    res_jx = JaxBackend().rq4a_detection_trend(arrays, limit_ns, g1, g2,
+                                               min_projects=2)
+    assert res_pd.iterations.size > 50
+    for f in ("iterations", "g1_total", "g1_detected", "g2_total",
+              "g2_detected"):
+        np.testing.assert_array_equal(getattr(res_pd, f), getattr(res_jx, f),
+                                      err_msg=f)
+
+
+def test_trend_oracle(arrays, limit_ns, groups, study_db):
+    """Replay the reference's per-project loop (rq4a:324-346) from DB rows."""
+    from collections import defaultdict
+
+    pidx = arrays.project_index()
+    g1 = groups.indices("group1", pidx)
+    g2 = groups.indices("group2", pidx)
+    res = PandasBackend().rq4a_detection_trend(arrays, limit_ns, g1, g2,
+                                               min_projects=1)
+    stats = {"g1": defaultdict(lambda: [0, set()]),
+             "g2": defaultdict(lambda: [0, set()])}
+    for key, idx in (("g1", g1), ("g2", g2)):
+        for p in idx:
+            name = arrays.projects[p]
+            builds = [pd.Timestamp(r[0]) for r in study_db.query(
+                "SELECT timecreated FROM buildlog_data WHERE project=? AND "
+                "build_type='Fuzzing' AND timecreated<? ORDER BY timecreated",
+                (name, LIMIT))]
+            if not builds:
+                continue
+            for i in range(len(builds)):
+                stats[key][i + 1][0] += 1
+            issues = [pd.Timestamp(r[0]) for r in study_db.query(
+                "SELECT rts FROM issues WHERE project=? AND rts<? AND status "
+                "IN ('Fixed','Fixed (Verified)') ORDER BY rts",
+                (name, LIMIT))]
+            for rts in issues:
+                k = sum(1 for b in builds if b < rts)
+                if k > 0:
+                    stats[key][k][1].add(name)
+
+    for i, it in enumerate(res.iterations):
+        it = int(it)
+        assert res.g1_total[i] == stats["g1"][it][0]
+        assert res.g1_detected[i] == len(stats["g1"][it][1])
+        assert res.g2_total[i] == stats["g2"][it][0]
+        assert res.g2_detected[i] == len(stats["g2"][it][1])
+
+
+def test_g4_prepost_oracle(arrays, limit_ns, groups, study_db):
+    """Replay the reference's fixed-N window logic (rq4a:348-412)."""
+    N = 7
+    pp = g4_prepost(arrays, limit_ns, groups, N)
+    assert pp.detect.shape[1] == 2 * N
+    assert len(pp.kept_projects) > 0
+
+    for name in groups.groups["group4"]:
+        t_corpus = pd.Timestamp(groups.corpus_time_ns[name])
+        builds = [pd.Timestamp(r[0]) for r in study_db.query(
+            "SELECT timecreated FROM buildlog_data WHERE project=? AND "
+            "build_type='Fuzzing' AND timecreated<? ORDER BY timecreated",
+            (name, LIMIT))]
+        issues = [pd.Timestamp(r[0]) for r in study_db.query(
+            "SELECT rts FROM issues WHERE project=? AND rts<? AND status IN "
+            "('Fixed','Fixed (Verified)') ORDER BY rts", (name, LIMIT))]
+        pre_idx = [i for i, b in enumerate(builds) if b < t_corpus]
+        assert pp.intro_iteration[name] == len(pre_idx)
+        if not pre_idx:
+            assert name not in pp.kept_projects
+            continue
+        last = pre_idx[-1]
+        if (last - (N - 1) < 0) or (last + N >= len(builds) - 1):
+            assert name in pp.missing_pre
+            assert name not in pp.kept_projects
+            continue
+        row = pp.detect[pp.kept_projects.index(name)]
+        for j, s in enumerate(pp.steps):
+            idx = last - (-s - 1) if s < 0 else last + s
+            expect = any(builds[idx] <= r < builds[idx + 1] for r in issues)
+            assert row[j] == expect, (name, s)
+
+
+@pytest.mark.parametrize("backend", ["pandas", "jax_tpu"])
+def test_run_rq4a_end_to_end(study_db, tmp_path, corpus_csv, backend):
+    cfg = Config(engine="sqlite", sqlite_path=study_db.config.sqlite_path,
+                 backend=backend, result_dir=str(tmp_path), limit_date=LIMIT,
+                 corpus_csv=corpus_csv, min_projects_per_iteration=2)
+    out = run_rq4a(cfg, db=study_db)
+    df = pd.read_csv(out["trend_csv"])
+    assert len(df) == out["result"].iterations.size
+    assert df.columns[0] == "Iteration"
+    intro = pd.read_csv(out["intro_csv"])
+    assert list(intro.columns) == ["Project", "Introduction_Iteration"]
+    assert (intro["Introduction_Iteration"].values
+            == np.sort(intro["Introduction_Iteration"].values)).all()
+    for pdf in ("rq4_g1_g2_detection_trend.pdf", "rq4_gc_detection_trend.pdf",
+                "rq4_gc_bug_detection_venn.pdf"):
+        assert os.path.exists(tmp_path / "rq4" / "bug" / pdf)

@@ -1,0 +1,164 @@
+"""Seed-corpus grouping shared by RQ4a and RQ4b.
+
+Mirrors the reference's categorisation of eligible projects by
+seed-corpus-introduction timing (rq4a_bug.py:82-121; identical logic in
+rq4b_coverage.py:164-230) from C8's ``project_corpus_analysis.csv``:
+
+- G1 "No Corpus":      time_elapsed_seconds is NaN, plus every eligible
+                       project absent from the CSV (rq4a:110-113).
+- G2 "Initial Corpus": time_elapsed_seconds == 0.
+- G3 "1-7 Days":       0 < s < days_threshold * 86400.
+- G4 ">= 7 Days":      s >= days_threshold * 86400 (the pre/post cohort;
+                       carries corpus_commit_time).
+
+The G4 pre/post detection windows (rq4a:348-412) are computed here on host:
+they touch O(|G4| x N) scalars — far below device-dispatch granularity —
+and both backends share this exact code path so parity is structural.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+import pandas as pd
+
+from ..data.columnar import StudyArrays
+from ..utils.logging import get_logger
+
+log = get_logger("corpus")
+
+GROUP_LABELS = {
+    "group1": "Group A (No Corpus)",
+    "group2": "Group B (Initial Corpus)",
+    "group3": "Group D (1-5 Day Corpus)",
+    "group4": "Group C (>5 Day Corpus)",
+}
+
+
+@dataclass
+class CorpusGroups:
+    groups: dict[str, set]          # group key -> project names
+    corpus_time_ns: dict[str, int]  # project -> corpus_commit_time (ns); every
+                                    # non-null-elapsed project (G2/G3/G4) that
+                                    # has a parseable commit time (rq4b:216)
+
+    def indices(self, key: str, project_index: dict[str, int]) -> np.ndarray:
+        return np.array(sorted(project_index[p] for p in self.groups[key]
+                               if p in project_index), dtype=np.int64)
+
+
+def load_corpus_groups(csv_path: str, eligible: set,
+                       days_threshold: int = 7) -> CorpusGroups:
+    """rq4a_bug.py:82-121 — missing CSV file is an error; missing rows
+    default to G1."""
+    df = pd.read_csv(csv_path)
+    df["corpus_commit_time"] = pd.to_datetime(
+        df["corpus_commit_time"], errors="coerce", utc=True, format="mixed")
+    df = df[df["project_name"].isin(eligible)].copy()
+    elapsed = pd.to_numeric(df["time_elapsed_seconds"], errors="coerce")
+    bound = days_threshold * 86400
+    null_g1 = elapsed.isna()
+    groups = {
+        "group1": set(df[null_g1]["project_name"]),
+        "group2": set(df[(elapsed == 0) & ~null_g1]["project_name"]),
+        "group3": set(df[(elapsed > 0) & (elapsed < bound)
+                         & ~null_g1]["project_name"]),
+        "group4": set(df[(elapsed >= bound) & ~null_g1]["project_name"]),
+    }
+    groups["group1"].update(eligible - set(df["project_name"]))
+
+    with_corpus = df[~null_g1]
+    corpus_time_ns = {}
+    for name, t in zip(with_corpus["project_name"],
+                       with_corpus["corpus_commit_time"]):
+        if pd.notna(t):
+            corpus_time_ns[name] = int(t.tz_convert(None).value
+                                       if t.tzinfo else t.value)
+    log.info("Projects categorized: G1=%d, G2=%d, G3=%d, G4=%d",
+             *(len(groups[k]) for k in ("group1", "group2", "group3",
+                                        "group4")))
+    return CorpusGroups(groups=groups, corpus_time_ns=corpus_time_ns)
+
+
+@dataclass
+class G4PrePost:
+    """Fixed-N pre/post windows around corpus introduction (rq4a:348-412).
+
+    detect: [n_kept, 2N] bool, columns ordered step -N..-1, 1..N; kept
+    projects pass the completeness filter (rq4a:374).  intro_iteration maps
+    every G4 project (with builds data) to the iteration at which its
+    corpus arrived (rq4a:246-299; 0 when the project has no builds)."""
+
+    steps: np.ndarray               # [-N..-1, 1..N]
+    detect: np.ndarray              # [n_kept, 2N] bool
+    kept_projects: list[str]
+    missing_pre: set
+    intro_iteration: dict[str, int]
+
+    @property
+    def pre_any(self) -> np.ndarray:
+        return self.detect[:, : self.detect.shape[1] // 2].any(axis=1)
+
+    @property
+    def post_any(self) -> np.ndarray:
+        return self.detect[:, self.detect.shape[1] // 2:].any(axis=1)
+
+    def transition_counts(self) -> dict:
+        pre, post = self.pre_any, self.post_any
+        return {
+            "no_detection": int((~pre & ~post).sum()),
+            "pre_only": int((pre & ~post).sum()),
+            "pre_and_post": int((pre & post).sum()),
+            "post_only": int((~pre & post).sum()),
+        }
+
+    def step_rates(self) -> np.ndarray:
+        """Detection rate (%) per step column."""
+        if self.detect.size == 0:
+            return np.zeros(self.steps.size)
+        return self.detect.mean(axis=0) * 100.0
+
+
+def g4_prepost(arrays: StudyArrays, limit_date_ns: int,
+               groups: CorpusGroups, n_windows: int) -> G4PrePost:
+    N = n_windows
+    pidx = arrays.project_index()
+    fuzz_t = arrays.fuzz.columns["time_ns"]
+    issue_t = arrays.issues.columns["time_ns"]
+
+    steps = np.array([s for s in range(-N, N + 1) if s != 0], dtype=np.int64)
+    rows, kept, missing, intro = [], [], set(), {}
+    for name in sorted(groups.groups["group4"]):
+        t_corpus = groups.corpus_time_ns.get(name)
+        if t_corpus is None or name not in pidx:
+            continue
+        p = pidx[name]
+        flo, fhi = arrays.fuzz.offsets[p], arrays.fuzz.offsets[p + 1]
+        btimes = fuzz_t[flo:fhi][fuzz_t[flo:fhi] < limit_date_ns]
+        # Introduction iteration = #builds strictly before corpus arrival
+        # (rq4a:269); 0 when the project has no builds (rq4a:265-267).
+        pos = int(np.searchsorted(btimes, t_corpus, side="left"))
+        intro[name] = pos
+        if btimes.size == 0 or pos == 0:
+            continue  # no pre-introduction build (rq4a:365-366)
+        idx_pre_last = pos - 1
+        if (idx_pre_last - (N - 1) < 0) or (idx_pre_last + N >= btimes.size - 1):
+            missing.add(name)  # incomplete N-window (rq4a:374-376)
+            continue
+        ilo, ihi = arrays.issues.offsets[p], arrays.issues.offsets[p + 1]
+        itimes = issue_t[ilo:ihi]
+        row = np.zeros(2 * N, dtype=bool)
+        for j, s in enumerate(steps):
+            idx = idx_pre_last - (-s - 1) if s < 0 else idx_pre_last + s
+            t_start, t_end = btimes[idx], btimes[idx + 1]
+            # any issue with t_start <= rts < t_end (rq4a:392,403)
+            row[j] = (np.searchsorted(itimes, t_end, side="left")
+                      - np.searchsorted(itimes, t_start, side="left")) > 0
+        rows.append(row)
+        kept.append(name)
+
+    detect = (np.array(rows, dtype=bool) if rows
+              else np.zeros((0, 2 * N), dtype=bool))
+    return G4PrePost(steps=steps, detect=detect, kept_projects=kept,
+                     missing_pre=missing, intro_iteration=intro)

@@ -130,6 +130,52 @@ class RQ3Result:
     nondet_project_idx: np.ndarray
 
 
+@dataclass
+class RQ4aTrendResult:
+    """G1-vs-G2 detection-rate trend (rq4a_bug.py:302-346,156-207).
+
+    Unlike RQ1, iteration totals count ALL fuzzing builds before the cutoff
+    regardless of result (rq4a:128-134), and a project counts as detecting
+    at iteration k when k = #builds strictly before a fixed issue's report
+    time is > 0 — no successful-build linkage required (rq4a:343-346).
+    iterations holds only rows where BOTH groups have >= min_projects
+    (rq4a:170-177); per-group arrays align with it.
+    """
+
+    iterations: np.ndarray
+    g1_total: np.ndarray
+    g1_detected: np.ndarray
+    g2_total: np.ndarray
+    g2_detected: np.ndarray
+
+    def rates(self, group: str) -> np.ndarray:
+        tot = getattr(self, f"{group}_total")
+        det = getattr(self, f"{group}_detected")
+        with np.errstate(invalid="ignore", divide="ignore"):
+            return np.where(tot > 0, det / tot * 100.0, 0.0)
+
+
+@dataclass
+class RQ4bTrendsResult:
+    """Per-session coverage% distributions for two corpus groups
+    (rq4b_coverage.py:910-1015).
+
+    Trends are the raw ``coverage`` column (non-null, > 0, pre-cutoff,
+    rq4b:315-326) re-indexed densely per project — NOT covered/total like
+    RQ2.  matrix/mask are [P, S] over ALL projects (S = longest trend);
+    group percentile rows follow ``percentiles`` and counts are per-session
+    group populations.
+    """
+
+    percentiles: tuple
+    matrix: np.ndarray            # [P, S] float64, NaN-padded
+    mask: np.ndarray              # [P, S] bool
+    g1_percentiles: np.ndarray    # [K, S]
+    g1_counts: np.ndarray         # [S]
+    g2_percentiles: np.ndarray    # [K, S]
+    g2_counts: np.ndarray         # [S]
+
+
 class Backend(abc.ABC):
     name: str
 
@@ -151,4 +197,17 @@ class Backend(abc.ABC):
     @abc.abstractmethod
     def rq3_coverage_at_detection(self, arrays: StudyArrays,
                                   limit_date_ns: int) -> RQ3Result:
+        ...
+
+    @abc.abstractmethod
+    def rq4a_detection_trend(self, arrays: StudyArrays, limit_date_ns: int,
+                             g1_idx: np.ndarray, g2_idx: np.ndarray,
+                             min_projects: int) -> RQ4aTrendResult:
+        ...
+
+    @abc.abstractmethod
+    def rq4b_group_trends(self, arrays: StudyArrays, limit_date_ns: int,
+                          g1_idx: np.ndarray, g2_idx: np.ndarray,
+                          percentiles: tuple = (25, 50, 75)
+                          ) -> RQ4bTrendsResult:
         ...

@@ -17,7 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .base import (Backend, RQ1Result, RQ2ChangePointsResult, RQ2TrendsResult,
-                   RQ3Result)
+                   RQ3Result, RQ4aTrendResult, RQ4bTrendsResult)
 from .pandas_backend import DAY_NS, HOUR_NS, floor_day_ns
 from ..data.columnar import StudyArrays, ns_to_device_pair
 from ..ops.segment import (counts_to_survival, masked_mean, masked_percentile,
@@ -289,6 +289,101 @@ class JaxBackend(Backend):
             nondet_diff_covered=covered[ni] - covered[ni - 1],
             nondet_diff_total=total[ni] - total[ni - 1],
             nondet_project_idx=pair_seg[keep].astype(np.int64),
+        )
+
+    def rq4a_detection_trend(self, arrays: StudyArrays, limit_date_ns: int,
+                             g1_idx: np.ndarray, g2_idx: np.ndarray,
+                             min_projects: int) -> RQ4aTrendResult:
+        """Device form of the reference's G1/G2 loop (rq4a_bug.py:324-346):
+        one segment-searchsorted maps every issue of both groups to its
+        iteration; per-group populations are bincount survival curves and
+        detected-project counts a boolean scatter — the same kernel shapes
+        as RQ1 but over ALL builds (no result filter) per rq4a:128-134."""
+        P = arrays.n_projects
+        fuzz_t = arrays.fuzz.columns["time_ns"]
+        f_pos, f_off = masked_csr(arrays.fuzz.offsets, fuzz_t < limit_date_ns)
+        counts = np.diff(f_off)
+        in_g = np.zeros(P, dtype=np.int8)  # 1 -> g1, 2 -> g2
+        in_g[np.asarray(g1_idx, dtype=np.int64)] = 1
+        in_g[np.asarray(g2_idx, dtype=np.int64)] = 2
+        both = {}
+        max_iter = int(counts[in_g > 0].max()) if (in_g > 0).any() else 0
+        if max_iter == 0:
+            e = np.empty(0, np.int64)
+            return RQ4aTrendResult(e, e, e, e, e)
+
+        issue_seg = np.repeat(np.arange(P), arrays.issues.counts())
+        issue_mask = in_g[issue_seg] > 0
+        qi = np.flatnonzero(issue_mask)
+        is_, ins = ns_to_device_pair(arrays.issues.columns["time_ns"][qi])
+        fts, ftn = ns_to_device_pair(fuzz_t[f_pos])
+        ks = np.asarray(segment_searchsorted(
+            jnp.asarray(fts), jnp.asarray(f_off, jnp.int32),
+            jnp.asarray(is_), issue_seg[qi].astype(np.int32), side="left",
+            values_lo=jnp.asarray(ftn), queries_lo=jnp.asarray(ins)))
+
+        for key, gid in (("g1", 1), ("g2", 2)):
+            sel = in_g == gid
+            tot = np.asarray(counts_to_survival(
+                jnp.asarray(counts[sel & (counts > 0)]), max_iter),
+                dtype=np.int64)
+            gi = in_g[issue_seg[qi]] == gid
+            det = np.asarray(unique_pairs_count_per_iteration(
+                jnp.asarray(issue_seg[qi][gi], jnp.int32),
+                jnp.asarray(ks[gi], jnp.int32), P, max_iter), dtype=np.int64)
+            both[key] = (tot, det)
+
+        valid = ((both["g1"][0] >= min_projects)
+                 & (both["g2"][0] >= min_projects))
+        keep = np.flatnonzero(valid)
+        return RQ4aTrendResult(
+            iterations=keep + 1,
+            g1_total=both["g1"][0][keep], g1_detected=both["g1"][1][keep],
+            g2_total=both["g2"][0][keep], g2_detected=both["g2"][1][keep],
+        )
+
+    def rq4b_group_trends(self, arrays: StudyArrays, limit_date_ns: int,
+                          g1_idx: np.ndarray, g2_idx: np.ndarray,
+                          percentiles: tuple = (25, 50, 75)
+                          ) -> RQ4bTrendsResult:
+        """Device form of rq4b_coverage.py:914-976: the padded trend matrix
+        is scattered on host (irregular) and the per-session per-group
+        percentile reductions run as masked device kernels."""
+        P = arrays.n_projects
+        cov = arrays.cov
+        coverage = cov.columns["coverage"]
+        sel = ((~np.isnan(coverage)) & (coverage > 0)
+               & (cov.columns["date_ns"] < limit_date_ns))
+        seg_all = np.repeat(np.arange(P), cov.counts())
+        lens = np.bincount(seg_all[sel], minlength=P)
+        S = int(lens.max()) if lens.size else 0
+        matrix = np.full((P, S), np.nan)
+        mask = np.zeros((P, S), dtype=bool)
+        if S:
+            kept_seg = seg_all[sel]
+            pos_in_proj = np.arange(int(sel.sum())) - np.repeat(
+                np.concatenate([[0], np.cumsum(lens)[:-1]]), lens)
+            matrix[kept_seg, pos_in_proj] = coverage[sel]
+            mask[kept_seg, pos_in_proj] = True
+
+        q = np.array(percentiles, dtype=np.float32)
+        out = {}
+        for key, idx in (("g1", np.asarray(g1_idx, dtype=np.int64)),
+                         ("g2", np.asarray(g2_idx, dtype=np.int64))):
+            if S == 0 or idx.size == 0:
+                out[key] = (np.full((len(percentiles), S), np.nan),
+                            np.zeros(S, dtype=np.int64))
+                continue
+            cols = jnp.asarray(matrix[idx].T, dtype=jnp.float32)  # [S, |g|]
+            colmask = jnp.asarray(mask[idx].T)
+            pcts = np.asarray(masked_percentile(cols, colmask, q),
+                              dtype=np.float64)
+            counts = mask[idx].sum(axis=0)
+            out[key] = (pcts, counts)
+        return RQ4bTrendsResult(
+            percentiles=tuple(percentiles), matrix=matrix, mask=mask,
+            g1_percentiles=out["g1"][0], g1_counts=out["g1"][1],
+            g2_percentiles=out["g2"][0], g2_counts=out["g2"][1],
         )
 
     def rq2_trends(self, arrays: StudyArrays,

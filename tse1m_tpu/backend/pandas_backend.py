@@ -11,7 +11,7 @@ from __future__ import annotations
 import numpy as np
 
 from .base import (Backend, RQ1Result, RQ2ChangePointsResult, RQ2TrendsResult,
-                   RQ3Result)
+                   RQ3Result, RQ4aTrendResult, RQ4bTrendsResult)
 from ..data.columnar import StudyArrays
 
 DAY_NS = 86_400_000_000_000
@@ -239,6 +239,93 @@ class PandasBackend(Backend):
             nondet_diff_covered=np.array(nondet["cov"], dtype=np.float64),
             nondet_diff_total=np.array(nondet["tot"], dtype=np.float64),
             nondet_project_idx=np.array(nondet["proj"], dtype=np.int64),
+        )
+
+    def rq4a_detection_trend(self, arrays: StudyArrays, limit_date_ns: int,
+                             g1_idx: np.ndarray, g2_idx: np.ndarray,
+                             min_projects: int) -> RQ4aTrendResult:
+        """Oracle mirror of the reference's G1/G2 loop (rq4a_bug.py:324-346):
+        ALL fuzzing builds before the cutoff define iterations; a fixed
+        issue marks its project detected at k = #builds before rts."""
+        fuzz_t = arrays.fuzz.columns["time_ns"]
+        issue_t = arrays.issues.columns["time_ns"]
+        per_group = {}
+        max_iter = 0
+        for key, idx in (("g1", g1_idx), ("g2", g2_idx)):
+            counts = {}
+            detected: dict[int, set] = {}
+            for p in idx:
+                flo, fhi = arrays.fuzz.offsets[p], arrays.fuzz.offsets[p + 1]
+                btimes = fuzz_t[flo:fhi][fuzz_t[flo:fhi] < limit_date_ns]
+                if btimes.size == 0:
+                    continue  # rq4a:335-336
+                counts[p] = btimes.size
+                max_iter = max(max_iter, btimes.size)
+                ilo, ihi = (arrays.issues.offsets[p],
+                            arrays.issues.offsets[p + 1])
+                ks = np.searchsorted(btimes, issue_t[ilo:ihi], side="left")
+                for k in ks[ks > 0]:
+                    detected.setdefault(int(k), set()).add(int(p))
+            per_group[key] = (counts, detected)
+
+        totals = {}
+        dets = {}
+        for key, (counts, detected) in per_group.items():
+            tot = np.zeros(max_iter, dtype=np.int64)
+            for c in counts.values():
+                tot[:c] += 1
+            det = np.array([len(detected.get(k, ())) for k in
+                            range(1, max_iter + 1)], dtype=np.int64)
+            totals[key], dets[key] = tot, det
+
+        valid = ((totals["g1"] >= min_projects)
+                 & (totals["g2"] >= min_projects)) if max_iter else \
+            np.zeros(0, dtype=bool)
+        keep = np.flatnonzero(valid)
+        return RQ4aTrendResult(
+            iterations=keep + 1,
+            g1_total=totals["g1"][keep] if max_iter else np.empty(0, np.int64),
+            g1_detected=dets["g1"][keep] if max_iter else np.empty(0, np.int64),
+            g2_total=totals["g2"][keep] if max_iter else np.empty(0, np.int64),
+            g2_detected=dets["g2"][keep] if max_iter else np.empty(0, np.int64),
+        )
+
+    def rq4b_group_trends(self, arrays: StudyArrays, limit_date_ns: int,
+                          g1_idx: np.ndarray, g2_idx: np.ndarray,
+                          percentiles: tuple = (25, 50, 75)
+                          ) -> RQ4bTrendsResult:
+        """Oracle mirror of the reference's ragged per-session aggregation
+        (rq4b_coverage.py:914-976): trend = raw coverage column (non-null,
+        > 0, pre-cutoff), session-indexed densely per project."""
+        P = arrays.n_projects
+        trends = []
+        for p in range(P):
+            seg = arrays.cov.segment(p)
+            sel = ((~np.isnan(seg["coverage"])) & (seg["coverage"] > 0)
+                   & (seg["date_ns"] < limit_date_ns))
+            trends.append(seg["coverage"][sel])
+        S = max((len(t) for t in trends), default=0)
+        matrix = np.full((P, S), np.nan)
+        mask = np.zeros((P, S), dtype=bool)
+        for p, t in enumerate(trends):
+            matrix[p, :len(t)] = t
+            mask[p, :len(t)] = True
+
+        out = {}
+        for key, idx in (("g1", np.asarray(g1_idx, dtype=np.int64)),
+                         ("g2", np.asarray(g2_idx, dtype=np.int64))):
+            pcts = np.full((len(percentiles), S), np.nan)
+            counts = np.zeros(S, dtype=np.int64)
+            for s in range(S):
+                col = matrix[idx, s][mask[idx, s]]
+                counts[s] = col.size
+                if col.size:
+                    pcts[:, s] = np.percentile(col, percentiles)
+            out[key] = (pcts, counts)
+        return RQ4bTrendsResult(
+            percentiles=tuple(percentiles), matrix=matrix, mask=mask,
+            g1_percentiles=out["g1"][0], g1_counts=out["g1"][1],
+            g2_percentiles=out["g2"][0], g2_counts=out["g2"][1],
         )
 
     def rq2_trends(self, arrays: StudyArrays,

@@ -39,6 +39,14 @@ def _cmd_synth(args) -> int:
     if args.csv_dir:
         study.to_csv_dir(args.csv_dir)
         log.info("CSV copies in %s", args.csv_dir)
+    else:
+        # RQ4 reads the corpus-analysis CSV from disk (rq4a_bug.py:34), so a
+        # synthetic study must always materialise it.
+        import os
+
+        os.makedirs(os.path.dirname(cfg.corpus_csv) or ".", exist_ok=True)
+        study.corpus_analysis.to_csv(cfg.corpus_csv, index=False)
+        log.info("corpus analysis CSV at %s", cfg.corpus_csv)
     return 0
 
 

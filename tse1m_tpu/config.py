@@ -55,6 +55,12 @@ class Config:
     # Artifact root (reference writes under data/result_data/).
     result_dir: str = "data/result_data"
     data_dir: str = "data"
+    # C8's corpus-analysis output, consumed by RQ4a/RQ4b (rq4a_bug.py:34).
+    corpus_csv: str = "data/processed_data/csv/project_corpus_analysis.csv"
+    # Pre/post window half-width N and the G3/G4 boundary in days
+    # (rq4a_bug.py:43-44).
+    analysis_iterations: int = 7
+    days_threshold: int = 7
     # Test-mode subset switch (rq1_detection_rate.py:20,155-158,233).
     test_mode: bool = False
 
@@ -91,11 +97,14 @@ def load_config(ini_path: str | None = None) -> Config:
             cfg.sqlite_path = fw.get("sqlite_path", cfg.sqlite_path)
             cfg.limit_date = fw.get("limit_date", cfg.limit_date)
             cfg.result_dir = fw.get("result_dir", cfg.result_dir)
+            cfg.corpus_csv = fw.get("corpus_csv", cfg.corpus_csv)
             cfg.test_mode = fw.getboolean("test_mode", cfg.test_mode)
 
     cfg.backend = os.environ.get("TSE1M_BACKEND", cfg.backend)
     cfg.engine = os.environ.get("TSE1M_ENGINE", cfg.engine)
     cfg.sqlite_path = os.environ.get("TSE1M_SQLITE_PATH", cfg.sqlite_path)
+    cfg.corpus_csv = os.environ.get("TSE1M_CORPUS_CSV", cfg.corpus_csv)
+    cfg.result_dir = os.environ.get("TSE1M_RESULT_DIR", cfg.result_dir)
     if "TSE1M_TEST_MODE" in os.environ:
         cfg.test_mode = os.environ["TSE1M_TEST_MODE"].lower() in ("1", "true", "yes")
     if cfg.backend not in ("pandas", "jax_tpu"):

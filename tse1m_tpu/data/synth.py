@@ -217,26 +217,31 @@ def generate_study(spec: SynthSpec | None = None) -> SynthStudy:
                 "total_line": total_line,
             })
 
-        # Corpus-analysis record (C8's project_corpus_analysis.csv shape,
-        # user_corpus.py:219-240: timing of seed-corpus introduction).
+        # Corpus-analysis record in C8's exact CSV schema
+        # (user_corpus.py:225-233): rq4a groups on time_elapsed_seconds
+        # (NaN -> G1, 0 -> G2, <7d -> G3, >=7d -> G4, rq4a_bug.py:97-100)
+        # and reads corpus_commit_time for G4 (rq4a_bug.py:117).
         if group == 0:
-            corpus_delay_days, category = None, "No Corpus"
+            elapsed_s = None
         elif group == 1:
-            corpus_delay_days, category = 0.0, "Under 1 Day"
+            elapsed_s = 0.0
         elif group == 2:
-            corpus_delay_days, category = float(rng.uniform(1, 7)), "1-7 Days"
+            elapsed_s = float(rng.uniform(1, 7)) * 86400.0
         else:
-            corpus_delay_days = float(introduced_day if introduced_day is not None
-                                      else rng.uniform(7, 60))
-            category = "7+ Days"
+            delay_days = float(introduced_day if introduced_day is not None
+                               else rng.uniform(7, 60))
+            elapsed_s = max(delay_days, 7.0) * 86400.0
+        commit_time = ("" if elapsed_s is None else str(
+            (day0 + np.timedelta64(int(elapsed_s), "s")).astype("datetime64[s]")
+        ).replace("T", " "))
         corpus_rows.append({
-            "project": name,
-            "first_commit_time": str(day0) + " 00:00:00",
-            "corpus_introduction_time":
-                (str(day0 + np.timedelta64(int(corpus_delay_days), "D")) + " 00:00:00")
-                if corpus_delay_days is not None else "",
-            "delay_days": corpus_delay_days if corpus_delay_days is not None else "",
-            "category": category,
+            "project_name": name,
+            "is_Corpus": elapsed_s is not None,
+            "corpus_commit_time": commit_time,
+            "corpus_merged_time": "",
+            "project_creation_time": str(day0) + " 00:00:00",
+            "time_elapsed_seconds": elapsed_s if elapsed_s is not None else "",
+            "merged_time_elapsed_seconds": "",
         })
 
     return SynthStudy(
