@@ -24,8 +24,10 @@ def main() -> int:
     p.add_argument("--hashes", type=int, default=128)
     p.add_argument("--bands", type=int, default=16)
     p.add_argument("--seed", type=int, default=0)
-    p.add_argument("--ari-sample", type=int, default=0,
-                   help="if >0, also ARI-check a host-clustered subsample")
+    p.add_argument("--ari-sample", type=int, default=100_000,
+                   help="if >0, also ARI-check a host-clustered subsample "
+                        "(the BASELINE.json acceptance gate: >= 0.98 vs the "
+                        "CPU/pandas baseline)")
     args = p.parse_args()
 
     import jax

@@ -1,0 +1,185 @@
+"""RQ4b: backend parity, DB-replay oracles, artifacts (both backends)."""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tse1m_tpu.analysis.corpus import load_corpus_groups
+from tse1m_tpu.analysis.rq4b import (PERCENTILES, coverage_deltas,
+                                     initial_coverage_stats, run_rq4b,
+                                     session_bm_pvalues, summarize_trends)
+from tse1m_tpu.backend.jax_backend import JaxBackend
+from tse1m_tpu.backend.pandas_backend import PandasBackend, floor_day_ns
+from tse1m_tpu.config import Config
+from tse1m_tpu.data.columnar import StudyArrays
+
+LIMIT = "2026-01-01"
+DAY_NS = 86_400_000_000_000
+
+
+@pytest.fixture(scope="module")
+def arrays(study_db):
+    cfg = Config(engine="sqlite", sqlite_path=study_db.config.sqlite_path,
+                 limit_date=LIMIT)
+    return StudyArrays.from_db(study_db, cfg)
+
+
+@pytest.fixture(scope="module")
+def limit_ns():
+    return int(np.datetime64(LIMIT, "ns").astype(np.int64))
+
+
+@pytest.fixture(scope="module")
+def corpus_csv(synth_study, tmp_path_factory):
+    path = tmp_path_factory.mktemp("corpus") / "project_corpus_analysis.csv"
+    synth_study.corpus_analysis.to_csv(path, index=False)
+    return str(path)
+
+
+@pytest.fixture(scope="module")
+def groups(corpus_csv, arrays):
+    return load_corpus_groups(corpus_csv, set(arrays.projects))
+
+
+@pytest.fixture(scope="module")
+def group_indices(groups, arrays):
+    pidx = arrays.project_index()
+    return groups.indices("group1", pidx), groups.indices("group2", pidx)
+
+
+def test_trends_backend_parity(arrays, limit_ns, group_indices):
+    """Bit-exact parity — the percentile values feed summarize_trends' G2>G1
+    win counts, which flip on any rounding divergence (ADVICE r1)."""
+    g1, g2 = group_indices
+    res_pd = PandasBackend().rq4b_group_trends(arrays, limit_ns, g1, g2,
+                                               PERCENTILES)
+    res_jx = JaxBackend().rq4b_group_trends(arrays, limit_ns, g1, g2,
+                                            PERCENTILES)
+    assert res_pd.matrix.shape == res_jx.matrix.shape
+    assert res_pd.matrix.shape[1] > 0
+    for f in ("matrix", "mask", "g1_percentiles", "g1_counts",
+              "g2_percentiles", "g2_counts"):
+        np.testing.assert_array_equal(getattr(res_pd, f), getattr(res_jx, f),
+                                      err_msg=f)
+    # ... and therefore identical downstream win counts / Spearman summary.
+    p_pd = session_bm_pvalues(res_pd, g1, g2)
+    p_jx = session_bm_pvalues(res_jx, g1, g2)
+    s_pd = summarize_trends(res_pd, p_pd, min_projects=2)
+    s_jx = summarize_trends(res_jx, p_jx, min_projects=2)
+    assert s_pd["wins"] == s_jx["wins"]
+    assert s_pd["bm_significant"] == s_jx["bm_significant"]
+
+
+def test_trend_matrix_oracle(arrays, limit_ns, study_db):
+    """Replay the reference's per-project trend extraction
+    (rq4b_coverage.py:914-936) from raw DB rows: non-null > 0 coverage rows
+    before the cutoff, densely session-indexed per project."""
+    res = PandasBackend().rq4b_group_trends(
+        arrays, limit_ns, np.arange(arrays.n_projects), np.array([], np.int64),
+        PERCENTILES)
+    for p, name in enumerate(arrays.projects):
+        rows = study_db.query(
+            "SELECT coverage FROM total_coverage WHERE project=? AND date<? "
+            "AND coverage IS NOT NULL AND coverage > 0 ORDER BY date",
+            (name, LIMIT))
+        trend = np.array([r[0] for r in rows], dtype=np.float64)
+        got = res.matrix[p][res.mask[p]]
+        np.testing.assert_array_equal(got, trend, err_msg=name)
+    # g1 == all projects here: percentiles must match np.percentile per
+    # session over the raw columns.
+    S = res.matrix.shape[1]
+    for s in range(0, S, max(1, S // 7)):
+        col = res.matrix[:, s][res.mask[:, s]]
+        np.testing.assert_array_equal(res.g1_percentiles[:, s],
+                                      np.percentile(col, PERCENTILES))
+        assert res.g1_counts[s] == col.size
+
+
+def test_coverage_deltas_oracle(arrays, limit_ns, groups, study_db):
+    """Replay the reference's pre/post delta semantics (rq4b:744-794): last /
+    first N positive coverage rows strictly before / from the corpus *day*,
+    deltas relative to Pre-1."""
+    N = 7
+    deltas = coverage_deltas(arrays, groups, N)
+    target = groups.groups["group3"] | groups.groups["group4"]
+    pidx = arrays.project_index()
+    expected_kept = []
+    for name in sorted(target):
+        t_corpus = groups.corpus_time_ns.get(name)
+        if t_corpus is None or name not in pidx:
+            continue
+        rows = study_db.query(
+            "SELECT date, coverage FROM total_coverage WHERE project=? "
+            "AND coverage IS NOT NULL AND coverage > 0 ORDER BY date", (name,))
+        # extraction window mirrors StudyArrays: date < limit + 1 day
+        limit_plus = pd.Timestamp(limit_ns + DAY_NS)
+        rows = [(pd.Timestamp(d), c) for d, c in rows
+                if pd.Timestamp(d) < limit_plus]
+        corpus_day = pd.Timestamp(floor_day_ns(np.int64(t_corpus)))
+        pre = [c for d, c in rows if d < corpus_day][-N:][::-1]
+        post = [c for d, c in rows if d >= corpus_day][:N]
+        if len(pre) < N or len(post) < N:
+            assert name not in deltas["projects"]
+            if len(pre) == 0:
+                assert name in deltas["missing_pre"]
+            continue
+        expected_kept.append(name)
+        i = deltas["projects"].index(name)
+        np.testing.assert_allclose(deltas["pre_coverages"][i], pre)
+        np.testing.assert_allclose(deltas["post_coverages"][i], post)
+        base = pre[0]
+        np.testing.assert_allclose(deltas["pre_deltas"][i],
+                                   [base - v for v in pre])
+        np.testing.assert_allclose(deltas["post_deltas"][i],
+                                   [v - base for v in post])
+        expect_g = 4 if name in groups.groups["group4"] else 3
+        assert deltas["group_num"][i] == expect_g
+    assert deltas["projects"] == expected_kept
+    assert len(expected_kept) > 0, "fixture produced no pre/post cohort"
+
+
+def test_initial_coverage_stats_empty():
+    out = initial_coverage_stats(np.array([]), np.array([1.0, 2.0]))
+    assert out == {"n_g2": 0, "n_g1": 2}
+
+
+@pytest.mark.parametrize("backend", ["pandas", "jax_tpu"])
+def test_run_rq4b_end_to_end(study_db, tmp_path, corpus_csv, backend):
+    cfg = Config(engine="sqlite", sqlite_path=study_db.config.sqlite_path,
+                 backend=backend, result_dir=str(tmp_path / backend),
+                 limit_date=LIMIT, corpus_csv=corpus_csv,
+                 min_projects_per_iteration=2)
+    out = run_rq4b(cfg, db=study_db)
+    df = pd.read_csv(out["trend_csv"])
+    assert df.columns[0] == "Session"
+    assert {"G2_25", "G2_50", "G2_75", "G2_Count", "G1_25", "G1_50", "G1_75",
+            "G1_Count", "BM_p_value"} <= set(df.columns)
+    assert len(df) == out["result"].matrix.shape[1]
+    assert out["summary"]["valid_sessions"] > 0
+    assert {"n_g2", "n_g1"} <= set(out["initial_stats"])
+    base = tmp_path / backend / "rq4" / "coverage"
+    for pdf in ("coverage_delta_timeseries_linear.pdf",
+                "g2_g1_boxplot_comparison.pdf"):
+        assert os.path.exists(base / pdf)
+
+
+def test_run_rq4b_empty_study(tmp_path, corpus_csv):
+    """An empty trend matrix must degrade to n_g2 = n_g1 = 0, not IndexError
+    (ADVICE r1)."""
+    from tse1m_tpu.data.synth import SynthSpec, generate_study
+    from tse1m_tpu.db.connection import DB
+
+    path = str(tmp_path / "empty.sqlite")
+    cfg = Config(engine="sqlite", sqlite_path=path, backend="pandas",
+                 result_dir=str(tmp_path / "out"), corpus_csv=corpus_csv,
+                 limit_date="2000-01-02")
+    db = DB(config=cfg).connect()
+    generate_study(SynthSpec(n_projects=3, days=30, seed=1)).to_db(db)
+    try:
+        out = run_rq4b(cfg, db=db)
+    finally:
+        db.closeConnection()
+    assert out["initial_stats"] == {"n_g2": 0, "n_g1": 0}
+    assert out["summary"] == {"valid_sessions": 0}

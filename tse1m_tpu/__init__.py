@@ -20,13 +20,12 @@ sessions.  This package keeps its *contract* — the same entry points
                 that NCCL holds in the reference's GPU analogues: none —
                 see SURVEY.md §2.4)
 - ``backend``   the {pandas, jax_tpu} dispatcher behind envFile.ini
-- ``models``    session-dedup (MinHash+LSH), crash clustering, and the
-                trainable detection-decay model
+- ``cluster``   north-star session dedup: MinHash signatures (pallas),
+                banded LSH, label propagation, host oracle, ARI
 - ``analysis``  RQ1..RQ4b re-implemented over backend primitives
                 (reference: ``program/research_questions/*.py``)
 - ``collect``   the six offline ETL collectors
                 (reference: ``program/preparation/*.py``)
-- ``native``    C++ fast paths (CSV/timestamp decode) via ctypes
 - ``utils``     structured logging, phase timing, run manifests
 """
 

@@ -70,6 +70,7 @@ def coverage_deltas(arrays, groups: CorpusGroups, n_iters: int) -> dict:
         "group_num": np.zeros(0, dtype=np.int64),
         "projects": [], "missing_pre": set(),
     }
+    out["post_truncated"] = set()
     pre_rows, post_rows, gnum, kept = [], [], [], []
     for name in sorted(target):
         t_corpus = groups.corpus_time_ns.get(name)
@@ -87,6 +88,13 @@ def coverage_deltas(arrays, groups: CorpusGroups, n_iters: int) -> dict:
         if pre.size < N or post.size < N:
             if pre.size == 0:
                 out["missing_pre"].add(name)
+            elif pre.size >= N:  # hence post.size < N
+                # The reference's pre/post queries are date-unbounded
+                # (rq4b:758-774); our extraction stops at limit_date + 1 day,
+                # so a full-pre project short only on the post side may be a
+                # casualty of the truncated window — record it so the
+                # deviation is observable.
+                out["post_truncated"].add(name)
             continue
         pre_rows.append(pre)
         post_rows.append(post)
@@ -376,6 +384,12 @@ def run_rq4b(cfg: Config | None = None, db=None) -> dict:
           "Analysis (Group C: Strict Filter Applied) ===")
     print(f"Number of projects meeting conditions and analyzed: "
           f"{len(deltas['projects'])}")
+    if deltas["post_truncated"]:
+        log.warning(
+            "%d project(s) dropped with a full pre but short post window; "
+            "coverage extraction ends at limit_date + 1 day while the "
+            "reference's pre/post queries are date-unbounded",
+            len(deltas["post_truncated"]))
     if deltas["projects"]:
         print("\n--- Coverage Median for Each Step (Group C) ---")
         for i in reversed(range(N)):
@@ -389,10 +403,14 @@ def run_rq4b(cfg: Config | None = None, db=None) -> dict:
 
     # Analysis 1: initial coverage = session-1 column of the trend matrix
     # (first non-null > 0 coverage row per project, rq4b:230-239).
-    first_col = result.matrix[:, 0] if result.matrix.shape[1] else np.array([])
-    first_mask = result.mask[:, 0] if result.matrix.shape[1] else np.array([], bool)
-    g2_cov = first_col[g2_idx][first_mask[g2_idx]]
-    g1_cov = first_col[g1_idx][first_mask[g1_idx]]
+    if result.matrix.shape[1]:
+        first_col = result.matrix[:, 0]
+        first_mask = result.mask[:, 0]
+        g2_cov = first_col[g2_idx][first_mask[g2_idx]]
+        g1_cov = first_col[g1_idx][first_mask[g1_idx]]
+    else:
+        g2_cov = np.array([])
+        g1_cov = np.array([])
     print("\n=== Analysis 1: G2 vs G1 Initial Coverage Comparison ===")
     print(f"Number of Group 2 projects: {len(groups.groups['group2'])}")
     print(f"Number of Group 1 projects: {len(groups.groups['group1'])}")
@@ -421,7 +439,8 @@ def run_rq4b(cfg: Config | None = None, db=None) -> dict:
         trend_summary=summary,
         initial_coverage=init_stats,
         deltas={"n_projects": len(deltas["projects"]),
-                "missing_pre": len(deltas["missing_pre"])},
+                "missing_pre": len(deltas["missing_pre"]),
+                "post_truncated": len(deltas["post_truncated"])},
     )
     manifest.save(out_dir, timer.as_dict())
     print("--- Analysis Finished ---")

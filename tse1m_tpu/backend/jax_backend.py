@@ -346,9 +346,11 @@ class JaxBackend(Backend):
                           g1_idx: np.ndarray, g2_idx: np.ndarray,
                           percentiles: tuple = (25, 50, 75)
                           ) -> RQ4bTrendsResult:
-        """Device form of rq4b_coverage.py:914-976: the padded trend matrix
-        is scattered on host (irregular) and the per-session per-group
-        percentile reductions run as masked device kernels."""
+        """Vectorised form of rq4b_coverage.py:914-976: the padded trend
+        matrix is scattered on host (irregular) and the per-session per-group
+        percentile reductions run as float64 nanpercentile columns — host,
+        not device, so win-count comparisons downstream are bit-exact vs the
+        pandas oracle (see the float32 note below)."""
         P = arrays.n_projects
         cov = arrays.cov
         coverage = cov.columns["coverage"]
@@ -366,7 +368,9 @@ class JaxBackend(Backend):
             matrix[kept_seg, pos_in_proj] = coverage[sel]
             mask[kept_seg, pos_in_proj] = True
 
-        q = np.array(percentiles, dtype=np.float32)
+        import warnings
+
+        q = np.array(percentiles, dtype=np.float64)
         out = {}
         for key, idx in (("g1", np.asarray(g1_idx, dtype=np.int64)),
                          ("g2", np.asarray(g2_idx, dtype=np.int64))):
@@ -374,10 +378,13 @@ class JaxBackend(Backend):
                 out[key] = (np.full((len(percentiles), S), np.nan),
                             np.zeros(S, dtype=np.int64))
                 continue
-            cols = jnp.asarray(matrix[idx].T, dtype=jnp.float32)  # [S, |g|]
-            colmask = jnp.asarray(mask[idx].T)
-            pcts = np.asarray(masked_percentile(cols, colmask, q),
-                              dtype=np.float64)
+            # Percentiles reduce in float64 on host (like the RQ3 delta
+            # gathers): summarize_trends counts G2>G1 wins on these values,
+            # and a float32 device reduction diverges from the pandas oracle
+            # at ~1e-5 relative — enough to flip near-equal comparisons.
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", RuntimeWarning)
+                pcts = np.nanpercentile(matrix[idx], q, axis=0)
             counts = mask[idx].sum(axis=0)
             out[key] = (pcts, counts)
         return RQ4bTrendsResult(

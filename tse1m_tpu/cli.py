@@ -39,14 +39,14 @@ def _cmd_synth(args) -> int:
     if args.csv_dir:
         study.to_csv_dir(args.csv_dir)
         log.info("CSV copies in %s", args.csv_dir)
-    else:
-        # RQ4 reads the corpus-analysis CSV from disk (rq4a_bug.py:34), so a
-        # synthetic study must always materialise it.
-        import os
+    # RQ4 reads the corpus-analysis CSV from cfg.corpus_csv (rq4a_bug.py:34),
+    # so a synthetic study must always materialise it there — regardless of
+    # whether --csv-dir also received a copy.
+    import os
 
-        os.makedirs(os.path.dirname(cfg.corpus_csv) or ".", exist_ok=True)
-        study.corpus_analysis.to_csv(cfg.corpus_csv, index=False)
-        log.info("corpus analysis CSV at %s", cfg.corpus_csv)
+    os.makedirs(os.path.dirname(cfg.corpus_csv) or ".", exist_ok=True)
+    study.corpus_analysis.to_csv(cfg.corpus_csv, index=False)
+    log.info("corpus analysis CSV at %s", cfg.corpus_csv)
     return 0
 
 
@@ -104,12 +104,30 @@ def _cmd_rq(args) -> int:
 
 
 def _cmd_cluster(args) -> int:
-    try:
-        from .models.session_dedup import run_dedup_demo
-    except ModuleNotFoundError:
-        log.error("session dedup model not implemented yet")
-        return 1
-    return run_dedup_demo(n_sessions=args.n, seed=args.seed)
+    """North-star session dedup: MinHash+LSH clustering with an ARI report
+    against the planted truth (and the host oracle on a subsample)."""
+    import json
+
+    from .cluster import ClusterParams, adjusted_rand_index, cluster_sessions, host_cluster
+    from .data.synth import synth_session_sets
+
+    items, truth = synth_session_sets(args.n, seed=args.seed)
+    params = ClusterParams(seed=args.seed)
+    labels = cluster_sessions(items, params)
+    ari = adjusted_rand_index(labels, truth)
+    k = min(args.ari_sample, args.n)
+    report = {"n_sessions": args.n,
+              "n_clusters": int(len(set(labels.tolist()))),
+              "ari_vs_planted": round(float(ari), 5)}
+    if k > 0:
+        host_k = host_cluster(items[:k], n_hashes=params.n_hashes,
+                              n_bands=params.n_bands, seed=params.seed)
+        dev_k = labels if k == args.n else cluster_sessions(items[:k], params)
+        report["ari_vs_host_sample"] = round(
+            float(adjusted_rand_index(dev_k, host_k)), 5)
+        report["ari_sample_n"] = k
+    print(json.dumps(report))
+    return 0
 
 
 def main(argv=None) -> int:
@@ -138,6 +156,8 @@ def main(argv=None) -> int:
     p = sub.add_parser("cluster", help="MinHash+LSH session dedup demo")
     p.add_argument("--n", type=int, default=100_000)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--ari-sample", type=int, default=10_000,
+                   help="subsample size for the device-vs-host ARI gate")
     p.set_defaults(fn=_cmd_cluster)
 
     args = ap.parse_args(argv)
