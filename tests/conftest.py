@@ -63,3 +63,48 @@ def study_cfg(study_db):
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(0)
+
+
+def _git(repo, *args, env=None):
+    import subprocess
+
+    subprocess.run(["git", *args], cwd=repo, check=True, capture_output=True,
+                   env=env)
+
+
+def _commit(repo, message, when):
+    env = dict(os.environ,
+               GIT_AUTHOR_DATE=when, GIT_COMMITTER_DATE=when,
+               GIT_AUTHOR_NAME="t", GIT_AUTHOR_EMAIL="t@x",
+               GIT_COMMITTER_NAME="t", GIT_COMMITTER_EMAIL="t@x")
+    _git(repo, "add", "-A")
+    _git(repo, "commit", "-m", message, "--allow-empty", env=env)
+
+
+@pytest.fixture()
+def oss_fuzz_repo(tmp_path):
+    """Tiny synthetic oss-fuzz checkout: two projects with project.yaml +
+    build.sh, deterministic commit times, a seed-corpus introduction."""
+    repo = str(tmp_path / "oss-fuzz")
+    os.makedirs(repo)
+    _git(repo, "init", "-q")
+    zlib = os.path.join(repo, "projects", "zlib")
+    os.makedirs(zlib)
+    with open(os.path.join(zlib, "project.yaml"), "w") as fh:
+        fh.write("language: c\nhomepage: https://zlib.net\n"
+                 "sanitizers:\n- address\n- memory\n"
+                 "auto_ccs: []\nmain_repo: https://github.com/madler/zlib\n")
+    with open(os.path.join(zlib, "build.sh"), "w") as fh:
+        fh.write("#!/bin/bash\ncompile\n")
+    _commit(repo, "add zlib", "2021-03-01T10:00:00+00:00")
+    brotli = os.path.join(repo, "projects", "brotli")
+    os.makedirs(brotli)
+    with open(os.path.join(brotli, "project.yaml"), "w") as fh:
+        fh.write("language: c++\nvendor_ccs:\n  a: 1\n")
+    with open(os.path.join(brotli, "build.sh"), "w") as fh:
+        fh.write("#!/bin/bash\ncp x_seed_corpus.zip $OUT/\ncompile\n")
+    _commit(repo, "add brotli", "2021-04-01T10:00:00+00:00")
+    with open(os.path.join(zlib, "build.sh"), "a") as fh:
+        fh.write("cp zlib_seed_corpus.zip $OUT/\n")
+    _commit(repo, "seed corpus for zlib", "2021-04-15T10:00:00+00:00")
+    return repo

@@ -1,0 +1,391 @@
+"""Collection layer: transport policy, checkpointing, and the C3-C6
+collectors against recorded fixtures (no network)."""
+
+import json
+import os
+import subprocess
+from datetime import date
+
+import pandas as pd
+import pytest
+
+from tse1m_tpu.collect.buildlogs import BuildLogAnalyzer, parse_build_log
+from tse1m_tpu.collect.checkpoint import (CsvBatchCheckpointer,
+                                          last_date_in_csv,
+                                          processed_ids_from_csvs,
+                                          resume_start_date)
+from tse1m_tpu.collect.coverage import (CoverageCollector, extract_tables,
+                                        fetch_day_coverage,
+                                        parse_c_family_report,
+                                        parse_jvm_report, parse_python_report)
+from tse1m_tpu.collect.gcs_metadata import (GcsMetadataCollector,
+                                            extract_log_records,
+                                            is_build_log_name)
+from tse1m_tpu.collect.projects import collect_project_info, first_commit_time
+from tse1m_tpu.collect.transport import (DirFetcher, FetchError, FetchPolicy,
+                                         HttpFetcher, Response)
+
+UUID_NAME = "log-6259f647-370a-40e2-916b-8f4aaf105697.txt"
+
+
+# -- transport ----------------------------------------------------------------
+
+class _FakeHttpResponse:
+    def __init__(self, status_code, content=b""):
+        self.status_code = status_code
+        self.content = content
+
+    def raise_for_status(self):
+        if self.status_code >= 400:
+            raise RuntimeError(f"HTTP {self.status_code}")
+
+
+class _ScriptedSession:
+    """requests.Session stand-in replaying a scripted status sequence."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.calls = 0
+
+    def get(self, url, params=None, timeout=None):
+        self.calls += 1
+        item = self.script.pop(0)
+        if isinstance(item, Exception):
+            raise item
+        status, content = item
+        return _FakeHttpResponse(status, content)
+
+
+def _policy(**kw):
+    kw.setdefault("backoff_factor", 0.0)
+    return FetchPolicy(**kw)
+
+
+def test_http_fetcher_retries_then_succeeds():
+    session = _ScriptedSession([(503, b""), (503, b""), (200, b"ok")])
+    f = HttpFetcher(_policy(retries=3), session=session)
+    resp = f.get("https://x/y")
+    assert resp.text == "ok"
+    assert session.calls == 3
+
+
+def test_http_fetcher_404_is_absent_not_error():
+    f = HttpFetcher(_policy(), session=_ScriptedSession([(404, b"")]))
+    assert f.get("https://x/missing") is None
+
+
+def test_http_fetcher_exhausts_budget():
+    session = _ScriptedSession([(503, b"")] * 3)
+    f = HttpFetcher(_policy(retries=2), session=session)
+    with pytest.raises(FetchError):
+        f.get("https://x/y")
+    assert session.calls == 3
+
+
+def test_http_fetcher_retries_connection_errors():
+    session = _ScriptedSession([OSError("reset"), (200, b"fine")])
+    f = HttpFetcher(_policy(retries=1), session=session)
+    assert f.get("https://x/y").text == "fine"
+
+
+def test_dir_fetcher_maps_urls_and_params(tmp_path):
+    base = tmp_path / "host" / "a"
+    base.mkdir(parents=True)
+    (base / "b.html").write_text("payload")
+    (tmp_path / "host" / "api#c=1&d=2").parent.mkdir(exist_ok=True)
+    (tmp_path / "host" / "api#c=1&d=2").write_text("{}")
+    f = DirFetcher(str(tmp_path))
+    assert f.get("https://host/a/b.html").text == "payload"
+    assert f.get("https://host/api", params={"d": 2, "c": 1}).text == "{}"
+    assert f.get("https://host/nope") is None
+
+
+# -- checkpoint ---------------------------------------------------------------
+
+def test_batch_checkpointer_flush_merge_cleanup(tmp_path):
+    ckpt = CsvBatchCheckpointer(str(tmp_path / "b"), "meta", batch_size=2,
+                                fieldnames=["id", "v"])
+    for i in range(5):
+        ckpt.add({"id": i, "v": i * 10})
+    final = tmp_path / "final.csv"
+    n = ckpt.merge(str(final))
+    assert n == 5
+    df = pd.read_csv(final)
+    assert sorted(df["id"]) == [0, 1, 2, 3, 4]
+    assert not list((tmp_path / "b").glob("meta_batch_*.csv"))
+
+
+def test_batch_checkpointer_resumes_numbering(tmp_path):
+    d = str(tmp_path / "b")
+    c1 = CsvBatchCheckpointer(d, "meta", batch_size=1)
+    c1.add({"id": 1})
+    c2 = CsvBatchCheckpointer(d, "meta", batch_size=1)
+    c2.add({"id": 2})
+    files = sorted(os.path.basename(p) for p in
+                   (tmp_path / "b").glob("meta_batch_*.csv"))
+    assert files == ["meta_batch_1.csv", "meta_batch_2.csv"]
+
+
+def test_processed_ids_plain_and_json(tmp_path):
+    (tmp_path / "w").mkdir()
+    pd.DataFrame({"id": [3, 4]}).to_csv(tmp_path / "w" / "a.csv", index=False)
+    pd.DataFrame({"id": ['"7"', "null"]}).to_csv(tmp_path / "w" / "b.csv",
+                                                 index=False)
+    assert processed_ids_from_csvs(str(tmp_path)) == {3, 4, '"7"', "null"}
+    assert processed_ids_from_csvs(str(tmp_path), json_encoded=True) == {3, 4, 7}
+
+
+def test_resume_start_date(tmp_path):
+    path = tmp_path / "proj.csv"
+    assert resume_start_date(str(path), date(2025, 1, 1)) == date(2025, 1, 1)
+    pd.DataFrame({"date": ["20250103", "20250105"]}).to_csv(path, index=False)
+    assert last_date_in_csv(str(path)) == date(2025, 1, 5)
+    assert resume_start_date(str(path), date(2025, 1, 1)) == date(2025, 1, 6)
+    # default_start after the resume point wins (3_…py:266-267)
+    assert resume_start_date(str(path), date(2025, 2, 1)) == date(2025, 2, 1)
+
+
+# -- C4: GCS metadata pager ---------------------------------------------------
+
+class _PagedFetcher:
+    def __init__(self, pages):
+        self.pages = pages  # token -> page dict
+
+    def get(self, url, params=None):
+        token = (params or {}).get("pageToken", "")
+        return Response(url=url, status=200,
+                        content=json.dumps(self.pages[token]).encode())
+
+
+def test_gcs_name_filter():
+    assert is_build_log_name(UUID_NAME)
+    assert not is_build_log_name("log-not-a-uuid.txt")
+    assert not is_build_log_name("x" * len(UUID_NAME))  # length-only fails
+    recs = extract_log_records([
+        {"name": UUID_NAME, "size": "10", "timeCreated": "2024-01-01",
+         "mediaLink": "m", "selfLink": "s", "extra": "dropped"},
+        {"name": "junk.txt"},
+    ])
+    assert len(recs) == 1
+    assert set(recs[0]) == {"name", "selfLink", "mediaLink", "size",
+                            "timeCreated"}
+
+
+def test_gcs_collector_pages_batches_and_merges(tmp_path):
+    def page(i, next_token=None):
+        name = f"log-{i:08d}-370a-40e2-916b-8f4aaf105697.txt"
+        d = {"items": [{"name": name, "selfLink": f"s{i}",
+                        "mediaLink": f"m{i}", "size": str(i),
+                        "timeCreated": "2024-01-01T00:00:00Z"}]}
+        if next_token:
+            d["nextPageToken"] = next_token
+        return d
+
+    fetcher = _PagedFetcher({"": page(0, "t1"), "t1": page(1, "t2"),
+                             "t2": page(2)})
+    coll = GcsMetadataCollector(fetcher, str(tmp_path / "batches"),
+                                pages_per_batch=2)
+    final = tmp_path / "buildlog_metadata.csv"
+    assert coll.collect(str(final)) == 3
+    df = pd.read_csv(final)
+    assert len(df) == 3 and coll.pages_fetched == 3
+    assert not list((tmp_path / "batches").glob("*.csv"))
+
+
+# -- C5: coverage parsing + collector -----------------------------------------
+
+C_FAMILY_HTML = """<html><body><table>
+<tr><th>Filename</th><th>Function Coverage</th><th>Line Coverage</th></tr>
+<tr><td>a.c</td><td>80.00% (8/10)</td><td>75.00% (30/40)</td></tr>
+<tr><td>Totals</td><td>85.00% (17/20)</td><td>90.00% (180/200)</td></tr>
+</table></body></html>"""
+
+PYTHON_HTML = """<html><body><table>
+<tr><th>Module</th><th>statements</th><th>missing</th><th>coverage</th></tr>
+<tr><td>a.py</td><td>100</td><td>20</td><td>80%</td></tr>
+<tr><td>Total</td><td>400</td><td>100</td><td>75%</td></tr>
+</table></body></html>"""
+
+JVM_HTML = """<html><body><table>
+<tr><th>Element</th><th>Missed</th><th>Cov.</th><th>Lines</th><th>Missed</th></tr>
+<tr><td>pkg.a</td><td>5</td><td>50%</td><td>200</td><td>40</td></tr>
+<tr><td>Total</td><td>12</td><td>70%</td><td>1,000</td><td>250</td></tr>
+</table></body></html>"""
+
+
+def test_extract_tables_stdlib_parser():
+    tables = extract_tables(C_FAMILY_HTML)
+    assert len(tables) == 1
+    assert tables[0][0] == ["Filename", "Function Coverage", "Line Coverage"]
+    assert tables[0][-1][0] == "Totals"
+
+
+def test_parse_c_family_report():
+    s = parse_c_family_report(C_FAMILY_HTML)
+    assert (s.coverage, s.covered_line, s.total_line) == (90.0, 180.0, 200.0)
+    assert parse_c_family_report("<html><p>no table</p></html>") is None
+
+
+def test_parse_python_report():
+    s = parse_python_report(PYTHON_HTML)
+    assert (s.coverage, s.covered_line, s.total_line) == (75.0, 300.0, 400.0)
+
+
+def test_parse_jvm_report_uses_second_missed_column():
+    s = parse_jvm_report(JVM_HTML)
+    assert (s.covered_line, s.total_line) == (750.0, 1000.0)
+    assert s.coverage == 75.0
+
+
+def _coverage_fixture(tmp_path, project, day, html, page="file_view_index.html"):
+    d = (tmp_path / "storage.googleapis.com" / "oss-fuzz-coverage" / project
+         / "reports" / day / "linux")
+    d.mkdir(parents=True, exist_ok=True)
+    (d / page).write_text(html)
+
+
+def test_fetch_day_coverage_missing_report(tmp_path):
+    f = DirFetcher(str(tmp_path))
+    assert fetch_day_coverage(f, "zlib", "c", "20250101") is None
+
+
+def test_coverage_collector_walks_and_resumes(tmp_path):
+    _coverage_fixture(tmp_path, "zlib", "20250101", C_FAMILY_HTML)
+    _coverage_fixture(tmp_path, "zlib", "20250103", C_FAMILY_HTML)
+    f = DirFetcher(str(tmp_path))
+    coll = CoverageCollector(f, str(tmp_path / "per_project"),
+                             finish_date=date(2025, 1, 3))
+    n = coll.collect_project("zlib", "c", date(2025, 1, 1))
+    assert n == 2  # the 404 day is skipped silently
+    # Resume: a later day appears; only it is fetched.
+    _coverage_fixture(tmp_path, "zlib", "20250104", C_FAMILY_HTML)
+    coll2 = CoverageCollector(f, str(tmp_path / "per_project"),
+                              finish_date=date(2025, 1, 4))
+    f.requests.clear()
+    assert coll2.collect_project("zlib", "c", date(2025, 1, 1)) == 1
+    assert all("20250104" not in r or "20250104" in r for r in f.requests)
+    df = pd.read_csv(tmp_path / "per_project" / "zlib.csv")
+    assert len(df) == 3
+    merged = tmp_path / "total_coverage.csv"
+    assert coll2.merge(str(merged)) == 3
+
+
+def test_coverage_collect_all_skips_unsupported(tmp_path):
+    _coverage_fixture(tmp_path, "pyproj", "20250101", PYTHON_HTML,
+                      page="index.html")
+    info = pd.DataFrame({
+        "project": ["pyproj", "goproj"],
+        "language": ["python", "go"],
+        "first_commit_datetime": ["2025-01-01T00:00:00Z"] * 2,
+    })
+    f = DirFetcher(str(tmp_path))
+    coll = CoverageCollector(f, str(tmp_path / "pp"),
+                             finish_date=date(2025, 1, 1))
+    total = coll.collect_all(info, str(tmp_path / "total.csv"))
+    assert total == 1  # go has no parse rule; python day collected
+
+
+# -- C6: build-log analyzer ---------------------------------------------------
+
+FUZZ_LOG = """\
+starting build "abc"
+Step #1: Already have image: gcr.io/oss-fuzz/zlib
+Starting Step #2 - "srcmap"
+Step #2: {
+Step #2:   "/src/zlib": {
+Step #2:     "type": "git",
+Step #2:     "url": "https://github.com/madler/zlib.git",
+Step #2:     "rev": "deadbeefcafe"
+Step #2:   },
+Step #2:   "/src/extra": {
+Step #2:     "type": "git",
+Step #2:     "url": "https://example.com/extra.git",
+Step #2:     "rev": "0123456789ab"
+Step #2:   }
+Step #2: }
+Starting Step #3 - "compile-libfuzzer-address-x86_64"
+Step #3: jq_inplace /tmp/f.json '."/src/zlib" = { type: "git", url: "https://github.com/madler/zlib.git", rev: "deadbeefcafe" }'
+Step #5: Pulling image: gcr.io/oss-fuzz-base/base-runner
+PUSH
+DONE
+"""
+
+COVERAGE_LOG = """\
+Step #1: Already have image: gcr.io/oss-fuzz/zlib
+Starting Step #3 - "compile-libfuzzer-coverage-x86_64"
+Step #4: /report/linux/index.html
+PUSH
+DONE
+"""
+
+ERROR_LOG = """\
+Step #1: No URLs matched: gs://oss-fuzz-coverage/brotli/textcov_reports
+Starting Step #3 - "compile-libfuzzer-address-x86_64"
+ERROR
+ERROR: build step 3 failed
+"""
+
+
+def test_parse_fuzzing_log():
+    rec = parse_build_log("b1", FUZZ_LOG)
+    assert rec.project == "zlib"
+    assert rec.build_type == "Fuzzing"
+    assert rec.result == "Success"
+    # srcmap JSON (brace-depth delimited) + jq_inplace both contribute
+    assert "Zlib" in rec.modules and "Extra" in rec.modules
+    assert "deadbeefcafe" in rec.revisions
+    assert len(rec.paths) == 3  # 2 srcmap entries + 1 jq_inplace
+
+
+def test_parse_coverage_and_error_logs():
+    cov = parse_build_log("b2", COVERAGE_LOG)
+    assert cov.build_type == "Coverage"   # PUSH DONE must not flip it
+    assert cov.result == "Success"
+    err = parse_build_log("b3", ERROR_LOG)
+    assert err.project == "brotli"
+    assert err.result == "Error"
+    assert parse_build_log("b4", "").result == ""
+
+
+def test_buildlog_analyzer_resume_and_output(tmp_path):
+    logs = tmp_path / "oss-fuzz-build-logs.storage.googleapis.com"
+    logs.mkdir(parents=True)
+    (logs / "log-b1.txt").write_text(FUZZ_LOG)
+    (logs / "log-b2.txt").write_text(COVERAGE_LOG)
+    meta = pd.DataFrame({
+        "name": ["b1", "b2"],
+        "mediaLink": ["https://oss-fuzz-build-logs.storage.googleapis.com/"
+                      f"log-{i}.txt" for i in ("b1", "b2")],
+        "size": [100, 200],
+        "timeCreated": ["2024-05-01T10:00:00Z", "2024-05-01T11:00:00Z"],
+    })
+    f = DirFetcher(str(tmp_path))
+    an = BuildLogAnalyzer(f, str(tmp_path / "analyzed"), batch_size=10)
+    assert an.analyze(meta) == 2
+    assert an.analyze(meta) == 0  # processed-id resume
+    batches = list((tmp_path / "analyzed").glob("*.csv"))
+    assert len(batches) == 1
+    df = pd.read_csv(batches[0])
+    assert set(df["id"]) == {"b1", "b2"}
+    assert set(df["build_type"]) == {"Fuzzing", "Coverage"}
+    assert json.loads(df[df["id"] == "b1"]["modules"].iloc[0])[0] == "Zlib"
+
+
+# -- C3: project info (oss_fuzz_repo fixture lives in conftest) ---------------
+
+def test_first_commit_time(oss_fuzz_repo):
+    t = first_commit_time(oss_fuzz_repo, "projects/zlib")
+    assert t is not None and t.year == 2021 and t.month == 3
+    assert first_commit_time(oss_fuzz_repo, "projects/nope") is None
+
+
+def test_collect_project_info(oss_fuzz_repo):
+    df = collect_project_info(oss_fuzz_repo)
+    assert list(df["project"]) == ["brotli", "zlib"]
+    assert list(df.columns[:2]) == ["project", "first_commit_datetime"]
+    zrow = df[df["project"] == "zlib"].iloc[0]
+    assert zrow["language"] == "c"
+    assert zrow["sanitizers"] == "['address', 'memory']"
+    assert pd.isna(zrow["auto_ccs"])  # empty list -> None (1_…py:29-30)
+    brow = df[df["project"] == "brotli"].iloc[0]
+    assert json.loads(brow["vendor_ccs"]) == {"a": 1}

@@ -1,0 +1,356 @@
+"""C7 issue scraping logic, C8 corpus archaeology, normalization adapters,
+and the collector->ingest->columnar->RQ1 round trip."""
+
+import json
+import os
+import subprocess
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tse1m_tpu.collect.corpus import (GitHubMergeTimeResolver,
+                                      analyze_repository,
+                                      run_corpus_collector)
+from tse1m_tpu.collect.issues import (IssueEvent, RawIssuePage, RevisionTable,
+                                      assemble_issue_record,
+                                      extract_fixed_from_events, issue_url,
+                                      merge_window_csvs, parse_description,
+                                      plan_run, run_scraper_window,
+                                      save_issue_batch, scrape_issues,
+                                      select_rescrape_ids,
+                                      split_revision_range)
+from tse1m_tpu.collect.normalize import (buildlog_table_rows,
+                                         coverage_table_rows,
+                                         issue_table_rows)
+from tse1m_tpu.collect.transport import Response
+
+SHA_A = "a" * 40
+SHA_B = "b" * 40
+
+DESCRIPTION = """\
+Detailed Report: https://oss-fuzz.com/testcase?key=123
+
+Project: zlib
+Fuzzing Engine: libFuzzer
+Fuzz Target: compress_fuzzer
+Job Type: libfuzzer_asan_zlib
+Platform Id: linux
+
+Crash Type: Heap-buffer-overflow
+Crash Address: 0x60200000eff0
+Crash State:
+  inflate
+  inflateInit2_
+  compress_fuzzer
+
+Sanitizer: address (ASAN)
+Recommended Security Severity: Medium
+
+Regressed: https://oss-fuzz.com/revisions?job=libfuzzer_asan_zlib&range=1111:2222 extra-tail
+Minimized Testcase (1.23 Kb): https://oss-fuzz.com/download?testcase_id=5
+
+Issue filed automatically.
+See https://google.github.io/oss-fuzz/ for more information.
+"""
+
+
+def test_issue_url_routing():
+    assert "bugs.chromium.org" in issue_url(9_999_999)
+    assert "issues.oss-fuzz.com" in issue_url(10_000_000)
+
+
+def test_split_revision_range():
+    assert split_revision_range(f"{SHA_A}:{SHA_B}") == [SHA_A, SHA_B]
+    assert split_revision_range("v1.2:3") == ["v1.2:3"]
+    assert split_revision_range(SHA_A) == [SHA_A]
+
+
+def test_parse_description_keys_continuations_and_urls():
+    d = parse_description(DESCRIPTION)
+    assert d["Project"] == "zlib"
+    assert d["Crash Type"] == "Heap-buffer-overflow"
+    # multi-line continuation -> list (5_…py:261-267)
+    assert d["Crash State"] == ["inflate", "inflateInit2_", "compress_fuzzer"]
+    # URL keys keep only the URL token (5_…py:254-257)
+    assert d["Regressed"].endswith("range=1111:2222")
+    # parenthesised size must not defeat the label (5_…py:245)
+    assert d["Minimized Testcase"].endswith("testcase_id=5")
+    assert d["Recommended Security Severity"] == "Medium"
+    # boilerplate never leaks into values
+    assert not any("oss-fuzz" in str(v) and "github.io" in str(v)
+                   for v in d.values())
+
+
+def test_extract_fixed_from_events():
+    events = [
+        IssueEvent(text="filed", time_iso="2024-01-01T00:00:00Z"),
+        IssueEvent(text="Fixed: https://oss-fuzz.com/revisions?range=3:4\nmore",
+                   time_iso="2024-02-01T00:00:00Z"),
+        IssueEvent(text="unrelated comment", time_iso="2024-03-01T00:00:00Z"),
+    ]
+    url, t = extract_fixed_from_events(events)
+    assert url == "https://oss-fuzz.com/revisions?range=3:4"
+    assert t == "2024-02-01T00:00:00Z"
+    verified = [IssueEvent(
+        text="ClusterFuzz testcase 123 is verified as fixed in\nrange",
+        time_iso="2024-04-01T00:00:00Z",
+        revision_links=["https://oss-fuzz.com/revisions?range=5:6"])]
+    url2, t2 = extract_fixed_from_events(verified)
+    assert url2.endswith("range=5:6") and t2.startswith("2024-04")
+    assert extract_fixed_from_events([]) == (None, None)
+
+
+class FakeClient:
+    """Offline IssuePageClient over canned pages/revision tables."""
+
+    def __init__(self, pages, revisions=None, fail_ids=()):
+        self.pages = pages
+        self.revisions = revisions or {}
+        self.fail_ids = set(fail_ids)
+        self.closed = 0
+
+    def fetch_issue(self, issue_no):
+        if issue_no in self.fail_ids:
+            raise RuntimeError(f"browser crashed on {issue_no}")
+        return self.pages[issue_no]
+
+    def fetch_revisions(self, url):
+        return self.revisions.get(url)
+
+    def close(self):
+        self.closed += 1
+
+
+def _page(issue_no, project="zlib"):
+    return RawIssuePage(
+        final_id=str(issue_no), url=issue_url(issue_no),
+        title=f"Issue {issue_no} in {project}: crash",
+        reported_time_iso="2024-05-02T11:30:00Z",
+        metadata={"Status": "Fixed (Verified)", "Type": "Vulnerability",
+                  "Severity": "S2", "Reported": "2024-05-02",
+                  "Assignee": None},
+        events=[IssueEvent(
+            text=f"Fixed: https://oss-fuzz.com/revisions?range={SHA_A}:{SHA_B}",
+            time_iso="2024-05-20T09:00:00Z")],
+        description=DESCRIPTION.replace("zlib", project),
+    )
+
+
+def _revision_tables():
+    url = "https://oss-fuzz.com/revisions?job=libfuzzer_asan_zlib&range=1111:2222"
+    return {url: RevisionTable(components=["zlib"],
+                               revisions=[[SHA_A, SHA_B]],
+                               buildtime=["1111", "2222"])}
+
+
+def test_assemble_issue_record():
+    client = FakeClient({42: _page(42)}, _revision_tables())
+    rec = assemble_issue_record(client.fetch_issue(42), client)
+    assert rec["id"] == "42"
+    assert rec["reported_time"] == "2024-05-02 11:30"
+    assert rec["Metadata_Reported_Date"] == "2024-05-02"
+    assert rec["Status"] == "Fixed (Verified)"
+    assert rec["Fixed"].endswith(f"{SHA_A}:{SHA_B}")
+    assert rec["fixed_time"] == "2024-05-20 09:00"
+    assert rec["regressed_components"] == ["zlib"]
+    assert rec["regressed_revisions"] == [[SHA_A, SHA_B]]
+    assert rec["regressed_buildtime"] == ["1111", "2222"]
+
+
+def test_load_error_page_short_record():
+    page = RawIssuePage(final_id="7", url=issue_url(7), load_error=True)
+    rec = assemble_issue_record(page, FakeClient({}))
+    assert rec["error"] is True and rec["title"] == "Failed to load page"
+
+
+def test_window_checkpoints_and_recovers(tmp_path):
+    ids = [101, 102, 103, 104]
+    pages = {i: _page(i) for i in ids}
+    made = []
+
+    def factory():
+        c = FakeClient(pages, _revision_tables(), fail_ids={102})
+        made.append(c)
+        return c
+
+    done = run_scraper_window(factory, ids, 0, str(tmp_path), save_interval=2)
+    assert done == 3                      # 102 lost to the crash
+    assert len(made) == 2                 # client restarted (5_…py:328-332)
+    assert made[0].closed == 1
+    out = tmp_path / "window_0"
+    files = sorted(os.listdir(out))
+    assert files == ["001.csv", "002.csv"]
+    ids_seen = set()
+    for f in files:
+        ids_seen |= {json.loads(v) for v in pd.read_csv(out / f)["id"]}
+    assert ids_seen == {"101", "103", "104"}
+
+
+def test_scrape_issues_inline_windows_disjoint_dirs(tmp_path):
+    ids = list(range(200, 206))
+    pages = {i: _page(i) for i in ids}
+    scrape_issues(lambda: FakeClient(pages), ids, str(tmp_path),
+                  num_workers=3, parallel=False)
+    dirs = sorted(d for d in os.listdir(tmp_path) if d.startswith("window_"))
+    assert dirs == ["window_0", "window_1", "window_2"]
+    merged = tmp_path / "merged_output.csv"
+    assert merge_window_csvs(str(tmp_path), str(merged)) == 6
+
+
+def test_plan_run_resume_and_rescrape(tmp_path):
+    results = tmp_path / "results"
+    save_issue_batch([{"id": "300", "Status": "Fixed"},
+                      {"id": "301", "Status": None}], str(results / "w0"), 1)
+    merged = tmp_path / "merged.csv"
+    merge_window_csvs(str(results), str(merged))
+    plan = plan_run({300, 301, 302}, str(results))
+    assert plan == [302]
+    # DSL: Status missing -> re-scrape 301 (5_…py:419-422)
+    plan2 = plan_run({300, 301, 302}, str(results), str(merged),
+                     {"Status": True})
+    assert plan2 == [302, 301]
+    df = pd.read_csv(merged)
+    assert select_rescrape_ids(df, {"Status": "fixed"}) == [300]
+    assert select_rescrape_ids(df, {"Status": False}) == [300]
+
+
+# -- C8: corpus ---------------------------------------------------------------
+
+class FakeGitHub:
+    def __init__(self, merged_at):
+        self.merged_at = merged_at
+
+    def get(self, url, params=None):
+        if url.endswith("/pulls") and "commits" in url:
+            body = [{"number": 77}]
+        elif url.endswith("/pulls/77"):
+            body = {"merged_at": self.merged_at}
+        else:
+            return None
+        return Response(url=url, status=200, content=json.dumps(body).encode())
+
+
+def test_corpus_analysis(oss_fuzz_repo):
+    resolver = GitHubMergeTimeResolver(
+        fetcher=FakeGitHub("2021-04-16T12:00:00Z"), token="t")
+    df = analyze_repository(oss_fuzz_repo, ["brotli", "zlib", "ghost"],
+                            resolver)
+    assert list(df["project_name"]) == ["brotli", "zlib"]  # ghost skipped
+    z = df[df["project_name"] == "zlib"].iloc[0]
+    assert bool(z["is_Corpus"])
+    # corpus landed 45 days after creation (fixture commits)
+    assert z["time_elapsed_seconds"] == pytest.approx(45 * 86400)
+    assert z["merged_time_elapsed_seconds"] == pytest.approx(
+        (46 * 86400) + 2 * 3600)
+    b = df[df["project_name"] == "brotli"].iloc[0]
+    assert bool(b["is_Corpus"]) and b["time_elapsed_seconds"] == 0.0
+
+
+def test_corpus_collector_skips_existing(oss_fuzz_repo, tmp_path):
+    out = tmp_path / "project_corpus_analysis.csv"
+    df1 = run_corpus_collector(oss_fuzz_repo, str(out))
+    assert out.exists() and len(df1) == 2
+    # git history untouched; cached CSV served (user_corpus.py:367-370)
+    df2 = run_corpus_collector(oss_fuzz_repo, str(out))
+    assert len(df2) == 2
+
+
+def test_corpus_groups_accept_collector_csv(oss_fuzz_repo, tmp_path):
+    """The collection half's CSV feeds the analysis half unchanged."""
+    from tse1m_tpu.analysis.corpus import load_corpus_groups
+
+    out = tmp_path / "c.csv"
+    run_corpus_collector(oss_fuzz_repo, str(out))
+    groups = load_corpus_groups(str(out), {"brotli", "zlib", "other"})
+    assert "brotli" in groups.groups["group2"]   # corpus at creation
+    assert "zlib" in groups.groups["group4"]     # 45 days later
+    assert "other" in groups.groups["group1"]    # absent from CSV
+
+
+# -- normalization + round trip ----------------------------------------------
+
+def test_issue_table_rows():
+    records = [assemble_issue_record(_page(i, project="zlib"),
+                                     FakeClient({}, _revision_tables()))
+               for i in (42, 43)]
+    records.append({"id": "99", "error": True,
+                    "title": "Failed to load page"})
+    df = pd.DataFrame([{k: json.dumps(v, ensure_ascii=False)
+                        for k, v in r.items()} for r in records])
+    table = issue_table_rows(df)
+    assert len(table) == 2                      # error row dropped
+    row = table.iloc[0]
+    assert row["project"] == "zlib"
+    assert row["rts"] == "2024-05-02 11:30"
+    assert row["status"] == "Fixed (Verified)"
+    assert row["crash_type"] == "Heap-buffer-overflow"
+    assert row["severity"] == "S2"
+    assert row["regressed_build"] == "{" + SHA_A + "," + SHA_B + "}"
+
+
+def test_round_trip_collectors_to_rq1(tmp_path, oss_fuzz_repo):
+    """Collector outputs -> normalize -> ingest_csv_dir -> StudyArrays ->
+    RQ1 kernel: proves the layer feeds the analysis engine end to end."""
+    from tse1m_tpu.backend.pandas_backend import PandasBackend
+    from tse1m_tpu.collect.buildlogs import parse_build_log
+    from tse1m_tpu.collect.projects import collect_project_info
+    from tse1m_tpu.config import Config
+    from tse1m_tpu.data.columnar import StudyArrays
+    from tse1m_tpu.db.connection import DB
+    from tse1m_tpu.db.ingest import ingest_csv_dir
+    from tests.test_collect import FUZZ_LOG, COVERAGE_LOG
+
+    csv_dir = tmp_path / "csv"
+    csv_dir.mkdir()
+
+    collect_project_info(oss_fuzz_repo).to_csv(csv_dir / "project_info.csv",
+                                               index=False)
+
+    analyzed = []
+    base = pd.Timestamp("2024-05-01 10:00:00")
+    for i in range(30):
+        rec = parse_build_log(f"b{i}", FUZZ_LOG if i % 3 else COVERAGE_LOG)
+        analyzed.append({
+            "id": rec.build_id, "project": rec.project,
+            "build_type": rec.build_type, "result": rec.result,
+            "timecreated": str(base + pd.Timedelta(hours=i)),
+            "modules": json.dumps(rec.modules),
+            "revisions": json.dumps(rec.revisions),
+        })
+    buildlog_table_rows(pd.DataFrame(analyzed)).to_csv(
+        csv_dir / "buildlog_data.csv", index=False)
+
+    cov = pd.DataFrame({
+        "date": [f"202405{d:02d}" for d in range(1, 11)],
+        "project": ["zlib"] * 10,
+        "coverage": np.linspace(50, 60, 10),
+        "covered_line": np.linspace(500, 600, 10),
+        "total_line": [1000.0] * 10,
+        "exist": [True] * 10,
+    })
+    coverage_table_rows(cov).to_csv(csv_dir / "total_coverage.csv",
+                                    index=False)
+
+    issue_records = [assemble_issue_record(_page(500 + i),
+                                           FakeClient({}, _revision_tables()))
+                     for i in range(3)]
+    issues_df = pd.DataFrame([{k: json.dumps(v, ensure_ascii=False)
+                               for k, v in r.items()}
+                              for r in issue_records])
+    issue_table_rows(issues_df).to_csv(csv_dir / "issues.csv", index=False)
+
+    cfg = Config(engine="sqlite", sqlite_path=str(tmp_path / "rt.sqlite"),
+                 limit_date="2026-01-01")
+    db = DB(config=cfg).connect()
+    try:
+        counts = ingest_csv_dir(db, str(csv_dir))
+        assert counts["buildlog_data"] == 30
+        assert counts["issues"] == 3
+        arrays = StudyArrays.from_db(db, cfg, projects=["zlib"])
+        limit_ns = int(np.datetime64("2026-01-01", "ns").astype(np.int64))
+        res = PandasBackend().rq1_detection(arrays, limit_ns, min_projects=1)
+        assert res.total_projects.size > 0
+        assert res.iteration_of_issue.size == arrays.issues.counts().sum()
+    finally:
+        db.closeConnection()

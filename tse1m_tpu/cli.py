@@ -103,6 +103,93 @@ def _cmd_rq(args) -> int:
     return 0
 
 
+def _cmd_collect(args) -> int:
+    """Run one offline collection step (C3-C8).  Network steps construct an
+    HttpFetcher with the reference's politeness/retry policy; everything
+    funnels into --data-dir in ingest-ready layouts."""
+    import os
+    from datetime import date, timedelta
+
+    import pandas as pd
+
+    from .collect.transport import FetchPolicy, HttpFetcher
+
+    data_dir = args.data_dir
+    os.makedirs(data_dir, exist_ok=True)
+    if args.step == "projects":
+        from .collect.projects import OSS_FUZZ_URL, run_project_info_collector
+
+        run_project_info_collector(
+            args.repo, os.path.join(data_dir, "project_info.csv"),
+            clone_url=None if args.no_clone else OSS_FUZZ_URL)
+    elif args.step == "gcs-metadata":
+        from .collect.gcs_metadata import GcsMetadataCollector
+
+        fetcher = HttpFetcher(FetchPolicy(retries=5, backoff_factor=1.0,
+                                          politeness_delay=5.0,
+                                          timeout=30.0))
+        coll = GcsMetadataCollector(
+            fetcher, os.path.join(data_dir, "buildlog_metadata_batches"),
+            max_pages=args.max_pages)
+        coll.collect(os.path.join(data_dir, "buildlog_metadata.csv"))
+    elif args.step == "coverage":
+        from .collect.coverage import CoverageCollector
+
+        info = pd.read_csv(os.path.join(data_dir, "project_info.csv"))
+        fetcher = HttpFetcher(FetchPolicy(politeness_delay=0.5))
+        coll = CoverageCollector(
+            fetcher, os.path.join(data_dir, "coverage_by_project"),
+            finish_date=date.today() - timedelta(days=2))
+        coll.collect_all(info, os.path.join(data_dir, "total_coverage.csv"))
+    elif args.step == "buildlogs":
+        from .collect.buildlogs import BuildLogAnalyzer
+        from .collect.normalize import buildlog_table_rows
+
+        meta = pd.read_csv(os.path.join(data_dir, "buildlog_metadata.csv"))
+        batch_dir = os.path.join(data_dir, "buildlog_analyzed_batches")
+        an = BuildLogAnalyzer(HttpFetcher(FetchPolicy()), batch_dir,
+                              limit=args.limit)
+        an.analyze(meta)
+        import glob
+
+        frames = [pd.read_csv(f) for f in
+                  sorted(glob.glob(os.path.join(batch_dir, "*.csv")))]
+        if frames:
+            buildlog_table_rows(pd.concat(frames, ignore_index=True)).to_csv(
+                os.path.join(data_dir, "buildlog_data.csv"), index=False)
+    elif args.step == "issues":
+        from .collect.issues import (merge_window_csvs, plan_run,
+                                     scrape_issues)
+        from .collect.normalize import issue_table_rows
+
+        results_dir = os.path.join(data_dir, "issue_scraping_results")
+        targets = set()
+        if args.ids_file and os.path.exists(args.ids_file):
+            with open(args.ids_file, encoding="utf-8") as f:
+                targets = {int(ln) for ln in f if ln.strip().isdigit()}
+        plan = plan_run(targets, results_dir)
+        if plan:
+            from .collect.issues_selenium import SeleniumIssueClient
+
+            scrape_issues(SeleniumIssueClient, plan, results_dir,
+                          num_workers=args.workers)
+        merged_csv = os.path.join(data_dir, "issues_merged.csv")
+        if merge_window_csvs(results_dir, merged_csv):
+            issue_table_rows(pd.read_csv(merged_csv, low_memory=False)).to_csv(
+                os.path.join(data_dir, "issues.csv"), index=False)
+    elif args.step == "corpus":
+        from .collect.corpus import (GitHubMergeTimeResolver,
+                                     run_corpus_collector)
+
+        resolver = GitHubMergeTimeResolver(
+            fetcher=HttpFetcher(FetchPolicy()),
+            token=os.environ.get("GITHUB_TOKEN"))
+        run_corpus_collector(
+            args.repo,
+            os.path.join(data_dir, "project_corpus_analysis.csv"), resolver)
+    return 0
+
+
 def _cmd_cluster(args) -> int:
     """North-star session dedup: MinHash+LSH clustering with an ARI report
     against the planted truth (and the host oracle on a subsample)."""
@@ -152,6 +239,18 @@ def main(argv=None) -> int:
         p.add_argument("--db", default=None)
         p.add_argument("--backend", choices=("pandas", "jax_tpu"), default=None)
         p.set_defaults(fn=_cmd_rq)
+
+    p = sub.add_parser("collect", help="run an offline collection step")
+    p.add_argument("step", choices=("projects", "gcs-metadata", "coverage",
+                                    "buildlogs", "issues", "corpus"))
+    p.add_argument("--repo", default="data/collect_data/repos/oss-fuzz")
+    p.add_argument("--data-dir", default="data/processed_data/csv")
+    p.add_argument("--no-clone", action="store_true")
+    p.add_argument("--max-pages", type=int, default=None)
+    p.add_argument("--limit", type=int, default=None)
+    p.add_argument("--ids-file", default=None)
+    p.add_argument("--workers", type=int, default=8)
+    p.set_defaults(fn=_cmd_collect)
 
     p = sub.add_parser("cluster", help="MinHash+LSH session dedup demo")
     p.add_argument("--n", type=int, default=100_000)

@@ -1,0 +1,168 @@
+"""Checkpoint/resume helpers shared by all collectors (SURVEY.md §5: A4).
+
+The reference implements the same three patterns independently per script:
+
+- batch-CSV checkpointing: flush every N pages / 50 issues to numbered batch
+  files, then merge + delete (``2_get_buildlog_metadata.py:141-147,24-68``;
+  ``5_get_issue_reports.py:333-334,293-309``);
+- processed-id resume: scan prior output CSVs for already-done ids and skip
+  them (``4_get_buildlog_analysis.py:263-272``; ``5_…py:29-51``);
+- resume-from-last-date: continue a per-project time series from the day
+  after its max recorded date (``3_get_coverage_data.py:255-259``).
+
+Here each is one tested helper used by every driver.
+"""
+
+from __future__ import annotations
+
+import csv
+import glob
+import json
+import os
+from datetime import date, timedelta
+
+import pandas as pd
+
+from ..utils.logging import get_logger
+
+log = get_logger("collect.checkpoint")
+
+
+class CsvBatchCheckpointer:
+    """Accumulate records; flush to ``<prefix>_batch_<k>.csv`` every
+    ``batch_size`` records; ``merge()`` concatenates all batches into the
+    final CSV and removes them.
+
+    A crash between flushes loses at most one unflushed batch — the same
+    durability contract as the reference's page/issue batching.
+    """
+
+    def __init__(self, directory: str, prefix: str, batch_size: int,
+                 fieldnames: list[str] | None = None):
+        self.directory = directory
+        self.prefix = prefix
+        self.batch_size = batch_size
+        self.fieldnames = fieldnames
+        self._pending: list[dict] = []
+        os.makedirs(directory, exist_ok=True)
+        existing = self._batch_files()
+        self._next_index = len(existing) + 1
+
+    def _batch_files(self) -> list[str]:
+        return sorted(glob.glob(
+            os.path.join(self.directory, f"{self.prefix}_batch_*.csv")))
+
+    def add(self, record: dict) -> None:
+        self._pending.append(record)
+        if len(self._pending) >= self.batch_size:
+            self.flush()
+
+    def flush(self) -> str | None:
+        if not self._pending:
+            return None
+        path = os.path.join(self.directory,
+                            f"{self.prefix}_batch_{self._next_index}.csv")
+        fields = self.fieldnames or sorted(
+            {k for r in self._pending for k in r})
+        with open(path, "w", newline="", encoding="utf-8") as f:
+            w = csv.DictWriter(f, fieldnames=fields, extrasaction="ignore")
+            w.writeheader()
+            w.writerows(self._pending)
+        log.info("checkpointed %d records to %s", len(self._pending), path)
+        self._pending.clear()
+        self._next_index += 1
+        return path
+
+    def merge(self, final_path: str, cleanup: bool = True) -> int:
+        """Concatenate all batch files into ``final_path``; returns the
+        merged row count.  Batches are deleted only after a successful
+        write (the reference deletes as it goes, 2_…py:61-67)."""
+        self.flush()
+        files = self._batch_files()
+        if not files:
+            log.info("no batch files to merge for %s", self.prefix)
+            return 0
+        frames = []
+        for path in files:
+            try:
+                frames.append(pd.read_csv(path))
+            except Exception as e:
+                log.warning("skipping unreadable batch %s: %s", path, e)
+        if not frames:
+            return 0
+        merged = pd.concat(frames, ignore_index=True)
+        os.makedirs(os.path.dirname(final_path) or ".", exist_ok=True)
+        merged.to_csv(final_path, index=False, encoding="utf-8")
+        log.info("merged %d records from %d batches into %s",
+                 len(merged), len(files), final_path)
+        if cleanup:
+            for path in files:
+                os.remove(path)
+        return len(merged)
+
+
+def processed_ids_from_csvs(base_dir: str, id_column: str = "id",
+                            json_encoded: bool = False) -> set:
+    """Recursively scan CSVs under ``base_dir`` for already-processed ids.
+
+    ``json_encoded=True`` decodes each cell as JSON first — the issue
+    scraper stores every value json.dumps'd (``5_…py:303``)."""
+    found: set = set()
+    if not os.path.isdir(base_dir):
+        return found
+    for root, _, files in os.walk(base_dir):
+        for name in files:
+            if not name.endswith(".csv"):
+                continue
+            path = os.path.join(root, name)
+            try:
+                with open(path, newline="", encoding="utf-8") as f:
+                    reader = csv.DictReader(f)
+                    if not reader.fieldnames or id_column not in reader.fieldnames:
+                        continue
+                    for row in reader:
+                        raw = row.get(id_column)
+                        if raw in (None, ""):
+                            continue
+                        if json_encoded:
+                            try:
+                                raw = json.loads(raw)
+                            except (json.JSONDecodeError, TypeError):
+                                continue
+                        if raw is None:
+                            continue
+                        s = str(raw)
+                        found.add(int(s) if s.isdigit() else s)
+            except Exception as e:
+                log.warning("could not scan %s: %s", path, e)
+    return found
+
+
+def last_date_in_csv(path: str, column: str = "date") -> date | None:
+    """Max recorded date in a per-project CSV, or None if absent/empty."""
+    if not os.path.exists(path):
+        return None
+    try:
+        df = pd.read_csv(path)
+    except Exception:
+        return None
+    if column not in df.columns or df.empty:
+        return None
+    # YYYYMMDD stamps read back from CSV as ints; normalise through str so
+    # 20250105 parses as a date, not an epoch offset.
+    parsed = pd.to_datetime(df[column].astype(str), errors="coerce",
+                            format="mixed")
+    if parsed.isna().all():
+        return None
+    return parsed.max().date()
+
+
+def resume_start_date(csv_path: str, default_start: date,
+                      column: str = "date") -> date:
+    """Day after the last recorded date, clamped to ``default_start``
+    (3_get_coverage_data.py:255-267)."""
+    last = last_date_in_csv(csv_path, column)
+    if last is None:
+        return default_start
+    nxt = last + timedelta(days=1)
+    return max(nxt, default_start)

@@ -1,0 +1,133 @@
+"""Adapters from collector outputs to the ``ingest_csv_dir`` table CSVs.
+
+The reference never closes this loop — its collectors emit CSVs in
+scraper-native shapes and the DB ships pre-built (SURVEY.md §1, "gap in
+the reference").  These functions map each collector's output onto the
+canonical table schemas in :mod:`tse1m_tpu.db.schema`:
+
+- C6 analyzed batches       -> ``buildlog_data.csv``
+- C7 merged issue records   -> ``issues.csv``
+- C5 merged coverage rows   -> ``total_coverage.csv``
+- C3 project rows           -> ``project_info.csv`` (already table-shaped)
+"""
+
+from __future__ import annotations
+
+import json
+
+import pandas as pd
+
+from ..db.ingest import pg_array_literal
+from ..utils.logging import get_logger
+
+log = get_logger("collect.normalize")
+
+
+def _json_cell(value):
+    """Issue CSVs store every value JSON-encoded (5_…py:303)."""
+    if value is None or (isinstance(value, float) and value != value):
+        return None
+    if not isinstance(value, str):
+        return value
+    try:
+        return json.loads(value)
+    except (json.JSONDecodeError, TypeError):
+        return value
+
+
+def buildlog_table_rows(analyzed: pd.DataFrame) -> pd.DataFrame:
+    """C6 batch rows -> buildlog_data.csv columns.  Arrays go out as
+    Postgres literals so the CSV round-trips through ``parse_array`` and
+    matches the golden artifact format."""
+    out = pd.DataFrame({
+        "name": analyzed["id"],
+        "project": analyzed["project"],
+        "timecreated": analyzed["timecreated"],
+        "build_type": analyzed["build_type"],
+        "result": analyzed["result"],
+        "modules": [pg_array_literal(_json_cell(v) or [])
+                    for v in analyzed["modules"]],
+        "revisions": [pg_array_literal(_json_cell(v) or [])
+                      for v in analyzed["revisions"]],
+    })
+    # Rows whose log never revealed a project cannot join to anything.
+    dropped = int((out["project"] == "").sum())
+    if dropped:
+        log.warning("dropping %d buildlog rows with no project", dropped)
+    return out[out["project"] != ""].reset_index(drop=True)
+
+
+def coverage_table_rows(merged: pd.DataFrame) -> pd.DataFrame:
+    """C5 merged rows -> total_coverage.csv columns; the scrape-side
+    ``exist`` flag is internal."""
+    date = pd.to_datetime(merged["date"], format="%Y%m%d", errors="coerce")
+    return pd.DataFrame({
+        "project": merged["project"],
+        "date": date.dt.strftime("%Y-%m-%d"),
+        "coverage": merged["coverage"],
+        "covered_line": merged["covered_line"],
+        "total_line": merged["total_line"],
+    })
+
+
+def _severity(record: dict):
+    """Prefer tracker metadata Severity; fall back to the description's
+    recommended security severity."""
+    return (record.get("Severity")
+            or record.get("Recommended Security Severity"))
+
+
+def _flatten_revisions(value) -> list[str]:
+    """regressed_revisions is a list of 1- or 2-element ranges
+    (5_…py:113); the DB's regressed_build array stores the endpoints."""
+    out: list[str] = []
+    if isinstance(value, list):
+        for item in value:
+            if isinstance(item, list):
+                out.extend(str(v) for v in item)
+            else:
+                out.append(str(item))
+    elif value:
+        out.append(str(value))
+    return out
+
+
+def issue_table_rows(merged: pd.DataFrame,
+                     requested_ids: dict | None = None) -> pd.DataFrame:
+    """C7 merged records (JSON-encoded cells) -> issues.csv columns.
+
+    ``number`` is the id the study targeted (Monorail numbering where one
+    exists); ``new_id`` the tracker id the page resolved to.
+    ``requested_ids`` optionally maps final id -> originally requested id
+    for redirected fetches."""
+    requested_ids = requested_ids or {}
+    rows = []
+    for _, raw in merged.iterrows():
+        rec = {k: _json_cell(v) for k, v in raw.items()}
+        if rec.get("error"):
+            continue
+        final_id = str(rec.get("id", ""))
+        project = rec.get("Project")
+        rts = rec.get("reported_time") or rec.get("Metadata_Reported_Date")
+        if not project or not rts:
+            continue
+        crash_type = rec.get("Crash Type")
+        if isinstance(crash_type, list):
+            crash_type = crash_type[0] if crash_type else None
+        rows.append({
+            "project": project,
+            "number": str(requested_ids.get(final_id, final_id)),
+            "rts": rts,
+            "status": rec.get("Status"),
+            "crash_type": crash_type,
+            "severity": _severity(rec),
+            "type": rec.get("Type"),
+            "regressed_build": pg_array_literal(
+                _flatten_revisions(rec.get("regressed_revisions"))),
+            "new_id": final_id,
+        })
+    kept = pd.DataFrame(rows, columns=["project", "number", "rts", "status",
+                                       "crash_type", "severity", "type",
+                                       "regressed_build", "new_id"])
+    log.info("normalized %d/%d issue records", len(kept), len(merged))
+    return kept

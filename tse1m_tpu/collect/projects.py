@@ -1,0 +1,127 @@
+"""C3 — project-info collector (reference: ``1_get_projects_infos.py``).
+
+Walks an oss-fuzz checkout's ``projects/`` tree, flattens each project's
+``project.yaml`` into scalar columns, stamps the first commit that touched
+the project directory, and writes ``project_info.csv`` in the layout the
+reference produces (``project, first_commit_datetime`` first, remaining
+yaml keys sorted — ``1_…py:130-133``).
+
+Git access is plain ``subprocess git`` (the reference pulls in GitPython
+for two one-liner queries, ``1_…py:12-23``); parsing is pure and the repo
+path is injected, so tests drive it against a tiny synthetic repo.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+from datetime import datetime
+
+import pandas as pd
+import yaml
+
+from ..utils.logging import get_logger
+
+log = get_logger("collect.projects")
+
+OSS_FUZZ_URL = "https://github.com/google/oss-fuzz.git"
+
+
+def run_git(args: list[str], repo_path: str) -> str | None:
+    """Run git in ``repo_path``; None on failure (missing path/history)."""
+    try:
+        out = subprocess.run(["git", *args], cwd=repo_path, check=True,
+                             capture_output=True, text=True, encoding="utf-8")
+    except (subprocess.CalledProcessError, FileNotFoundError) as e:
+        log.debug("git %s failed in %s: %s", " ".join(args), repo_path, e)
+        return None
+    return out.stdout.strip()
+
+
+def clone_repo(url: str, clone_path: str) -> None:
+    """Clone once; an existing checkout is reused (1_…py:35-44)."""
+    if os.path.exists(clone_path):
+        log.info("repository already present at %s; skipping clone", clone_path)
+        return
+    os.makedirs(os.path.dirname(clone_path) or ".", exist_ok=True)
+    log.info("cloning %s -> %s", url, clone_path)
+    subprocess.run(["git", "clone", url, clone_path], check=True)
+
+
+def first_commit_time(repo_path: str, rel_path: str) -> datetime | None:
+    """Committer datetime of the first commit touching ``rel_path``
+    (1_…py:12-19: ``iter_commits(paths=…, reverse=True)[0]``)."""
+    out = run_git(["log", "--reverse", "--format=%cI", "--", rel_path],
+                  repo_path)
+    if not out:
+        return None
+    first = out.splitlines()[0].strip()
+    try:
+        return datetime.fromisoformat(first)
+    except ValueError:
+        return None
+
+
+def flatten_yaml_value(value):
+    """project.yaml values -> CSV scalars (1_…py:25-33): dicts as JSON,
+    empty sequences as None, lists via str()."""
+    if isinstance(value, dict):
+        return json.dumps(value)
+    if isinstance(value, (list, tuple)) and not value:
+        return None
+    if isinstance(value, list):
+        return str(value)
+    return value
+
+
+def read_project_yaml(path: str) -> dict | None:
+    with open(path, encoding="utf-8") as f:
+        try:
+            data = yaml.safe_load(f)
+        except yaml.YAMLError as e:
+            log.warning("unparseable project.yaml at %s: %s", path, e)
+            return None
+    return data if isinstance(data, dict) else None
+
+
+def collect_project_info(repo_path: str) -> pd.DataFrame:
+    """One row per project directory that carries a project.yaml."""
+    projects_dir = os.path.join(repo_path, "projects")
+    if not os.path.isdir(projects_dir):
+        raise FileNotFoundError(f"no projects/ directory under {repo_path}")
+    names = sorted(d for d in os.listdir(projects_dir)
+                   if os.path.isdir(os.path.join(projects_dir, d)))
+    log.info("found %d project directories", len(names))
+
+    records = []
+    for name in names:
+        yaml_path = os.path.join(projects_dir, name, "project.yaml")
+        if not os.path.exists(yaml_path):
+            log.warning("no project.yaml for %s; skipping", name)
+            continue
+        row: dict = {"project": name}
+        row["first_commit_datetime"] = first_commit_time(
+            repo_path, os.path.join("projects", name))
+        data = read_project_yaml(yaml_path)
+        if data:
+            for key, value in data.items():
+                row[key] = flatten_yaml_value(value)
+        records.append(row)
+
+    df = pd.DataFrame(records)
+    if "first_commit_datetime" in df.columns:
+        lead = ["project", "first_commit_datetime"]
+        df = df[lead + sorted(c for c in df.columns if c not in lead)]
+    return df
+
+
+def run_project_info_collector(repo_path: str, out_csv: str,
+                               clone_url: str | None = None) -> pd.DataFrame:
+    if clone_url:
+        clone_repo(clone_url, repo_path)
+    df = collect_project_info(repo_path)
+    os.makedirs(os.path.dirname(out_csv) or ".", exist_ok=True)
+    df.to_csv(out_csv, index=False, encoding="utf-8")
+    log.info("wrote %d project rows to %s", len(df), out_csv)
+    return df

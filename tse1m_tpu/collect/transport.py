@@ -1,0 +1,150 @@
+"""Injectable fetch transport — the collection layer's failure-detection seat.
+
+The reference configures ``requests`` retry adapters ad hoc per script
+(``2_get_buildlog_metadata.py:106-108``: total=5, backoff 1, on 502/503/504;
+``3_get_coverage_data.py:73-74``: total=3, backoff 0.5, on 5xx) and treats
+404 as "no report today" (``3_get_coverage_data.py:79-80``).  Here that
+policy is one dataclass, and the transport itself is a protocol so every
+collector runs against a directory-backed fake in tests (no network).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.parse
+from dataclasses import dataclass, field
+from typing import Protocol
+
+from ..utils.logging import get_logger
+
+log = get_logger("collect.transport")
+
+
+@dataclass(frozen=True)
+class FetchPolicy:
+    """Retry/backoff/politeness policy applied by real transports."""
+
+    retries: int = 3
+    backoff_factor: float = 0.5
+    retry_statuses: tuple = (500, 502, 503, 504)
+    timeout: float = 10.0
+    # Fixed sleep between *successive* requests — the reference sleeps 0.5 s
+    # per coverage page (3_get_coverage_data.py:135) and 5 s per GCS page
+    # (2_get_buildlog_metadata.py:100,152).
+    politeness_delay: float = 0.0
+
+
+@dataclass
+class Response:
+    url: str
+    status: int
+    content: bytes
+
+    @property
+    def text(self) -> str:
+        return self.content.decode("utf-8", errors="replace")
+
+    def json(self):
+        return json.loads(self.text)
+
+
+class Fetcher(Protocol):
+    def get(self, url: str, params: dict | None = None) -> Response | None:
+        """Fetch a URL.  Returns None for 404 (absent resource — a normal
+        outcome for daily reports); raises on persistent transport failure."""
+        ...
+
+
+class FetchError(RuntimeError):
+    """A request failed after exhausting the retry budget."""
+
+
+def _with_params(url: str, params: dict | None) -> str:
+    if not params:
+        return url
+    sep = "&" if "?" in url else "?"
+    return url + sep + urllib.parse.urlencode(sorted(params.items()))
+
+
+class HttpFetcher:
+    """Real transport over ``requests`` with the shared policy.
+
+    Uses explicit retry loops rather than urllib3's Retry so the same
+    semantics hold for connection errors and status retries alike, and so
+    the policy is visible in one place.
+    """
+
+    def __init__(self, policy: FetchPolicy | None = None, session=None):
+        self.policy = policy or FetchPolicy()
+        if session is None:
+            import requests
+
+            session = requests.Session()
+        self.session = session
+        self._last_request_t = 0.0
+
+    def _politeness_pause(self) -> None:
+        delay = self.policy.politeness_delay
+        if delay > 0:
+            elapsed = time.monotonic() - self._last_request_t
+            if elapsed < delay:
+                time.sleep(delay - elapsed)
+        self._last_request_t = time.monotonic()
+
+    def get(self, url: str, params: dict | None = None) -> Response | None:
+        p = self.policy
+        last_err: Exception | None = None
+        for attempt in range(p.retries + 1):
+            self._politeness_pause()
+            try:
+                r = self.session.get(url, params=params, timeout=p.timeout)
+            except Exception as e:  # connection/timeout errors
+                last_err = e
+                log.warning("fetch error (%s) attempt %d/%d: %s",
+                            url, attempt + 1, p.retries + 1, e)
+            else:
+                if r.status_code == 404:
+                    return None
+                if r.status_code in p.retry_statuses:
+                    last_err = FetchError(f"HTTP {r.status_code} for {url}")
+                    log.warning("retryable HTTP %d (%s) attempt %d/%d",
+                                r.status_code, url, attempt + 1, p.retries + 1)
+                else:
+                    r.raise_for_status()
+                    return Response(url=url, status=r.status_code,
+                                    content=r.content)
+            if attempt < p.retries:
+                time.sleep(p.backoff_factor * (2 ** attempt))
+        raise FetchError(f"giving up on {url} after {p.retries + 1} attempts"
+                         ) from last_err
+
+
+class DirFetcher:
+    """Directory-backed transport for tests and offline replay.
+
+    URL ``scheme://host/path?query`` maps to ``root/host/path`` with the
+    query string (if any) appended as ``#<urlencoded-query>`` — flat, human
+    -readable fixture layouts.  A missing file is a 404 (returns None).
+    """
+
+    def __init__(self, root: str):
+        self.root = root
+        self.requests: list[str] = []  # observability for tests
+
+    def path_for(self, url: str, params: dict | None = None) -> str:
+        full = _with_params(url, params)
+        self.requests.append(full)
+        rest = full.split("://", 1)[-1]
+        if "?" in rest:
+            rest, query = rest.split("?", 1)
+            rest = rest.rstrip("/") + "#" + urllib.parse.quote(query, safe="=&")
+        return os.path.join(self.root, *rest.split("/"))
+
+    def get(self, url: str, params: dict | None = None) -> Response | None:
+        path = self.path_for(url, params)
+        if not os.path.exists(path):
+            return None
+        with open(path, "rb") as f:
+            return Response(url=url, status=200, content=f.read())
