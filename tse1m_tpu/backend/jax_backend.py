@@ -23,6 +23,7 @@ from ..data.columnar import StudyArrays, ns_to_device_pair
 from ..ops.segment import (counts_to_survival, masked_mean, masked_percentile,
                            masked_spearman, segment_searchsorted,
                            unique_pairs_count_per_iteration)
+from ..parallel import rq_mesh
 
 
 def masked_csr(offsets: np.ndarray, mask: np.ndarray):
@@ -62,7 +63,17 @@ def _rq1_kernel(fuzz_s, fuzz_ns, fuzz_offsets, ok_s, ok_ns, ok_offsets, ok_orig_
 
 
 class JaxBackend(Backend):
+    """mesh: "auto" (default) shards the RQ reductions over all visible
+    devices when there is more than one (the north star's psum/pmean mesh
+    collectives); None forces the single-device kernels; a
+    `jax.sharding.Mesh` uses that mesh.  Both paths are bit-identical —
+    sharding axes keep float reductions device-local and only integer
+    partials cross the mesh (see parallel/rq_mesh.py)."""
+
     name = "jax_tpu"
+
+    def __init__(self, mesh="auto"):
+        self._mesh = rq_mesh.auto_mesh() if mesh == "auto" else mesh
 
     def rq1_detection(self, arrays: StudyArrays, limit_date_ns: int,
                       min_projects: int) -> RQ1Result:
@@ -85,17 +96,24 @@ class JaxBackend(Backend):
         issue_seg = np.repeat(np.arange(P), arrays.issues.counts())
         is_, ins = ns_to_device_pair(arrays.issues.columns["time_ns"])
 
-        it, li, totals, detected = _rq1_kernel(
-            jnp.asarray(fs), jnp.asarray(fns),
-            jnp.asarray(arrays.fuzz.offsets, dtype=jnp.int32),
-            jnp.asarray(fs[ok_pos]), jnp.asarray(fns[ok_pos]),
-            jnp.asarray(ok_offsets, dtype=jnp.int32),
-            jnp.asarray(ok_pos, dtype=jnp.int32),
-            jnp.asarray(is_), jnp.asarray(ins),
-            jnp.asarray(issue_seg, dtype=jnp.int32),
-            n_projects=P,
-            max_iter=max_iter,
-        )
+        if self._mesh is not None and n_issues:
+            it, li, detected = rq_mesh.rq1_kernel_mesh(
+                self._mesh, fs, fns, arrays.fuzz.offsets,
+                fs[ok_pos], fns[ok_pos], ok_offsets, ok_pos,
+                is_, ins, issue_seg, n_projects=P, max_iter=max_iter)
+            totals = counts_to_survival(jnp.asarray(n_builds), max_iter)
+        else:
+            it, li, totals, detected = _rq1_kernel(
+                jnp.asarray(fs), jnp.asarray(fns),
+                jnp.asarray(arrays.fuzz.offsets, dtype=jnp.int32),
+                jnp.asarray(fs[ok_pos]), jnp.asarray(fns[ok_pos]),
+                jnp.asarray(ok_offsets, dtype=jnp.int32),
+                jnp.asarray(ok_pos, dtype=jnp.int32),
+                jnp.asarray(is_), jnp.asarray(ins),
+                jnp.asarray(issue_seg, dtype=jnp.int32),
+                n_projects=P,
+                max_iter=max_iter,
+            )
         totals = np.asarray(totals, dtype=np.int64)
         detected = np.asarray(detected, dtype=np.int64)
         keep = totals >= min_projects
@@ -378,14 +396,21 @@ class JaxBackend(Backend):
                 out[key] = (np.full((len(percentiles), S), np.nan),
                             np.zeros(S, dtype=np.int64))
                 continue
-            # Percentiles reduce in float64 on host (like the RQ3 delta
-            # gathers): summarize_trends counts G2>G1 wins on these values,
-            # and a float32 device reduction diverges from the pandas oracle
-            # at ~1e-5 relative — enough to flip near-equal comparisons.
-            with warnings.catch_warnings():
-                warnings.simplefilter("ignore", RuntimeWarning)
-                pcts = np.nanpercentile(matrix[idx], q, axis=0)
-            counts = mask[idx].sum(axis=0)
+            # Percentiles reduce in float64 (advisor contract): a float32
+            # reduction diverges from the pandas oracle at ~1e-5 relative —
+            # enough to flip summarize_trends' G2>G1 win counts.  On a mesh
+            # the float64 sort + order-statistic selection shards the
+            # session axis on device and the host applies numpy's _lerp, so
+            # values stay bit-identical to np.nanpercentile.
+            if self._mesh is not None:
+                pcts = rq_mesh.nanpercentile_by_session_mesh(
+                    matrix[idx], q, self._mesh)
+                counts = rq_mesh.counts_by_project_psum(mask[idx], self._mesh)
+            else:
+                with warnings.catch_warnings():
+                    warnings.simplefilter("ignore", RuntimeWarning)
+                    pcts = np.nanpercentile(matrix[idx], q, axis=0)
+                counts = mask[idx].sum(axis=0)
             out[key] = (pcts, counts)
         return RQ4bTrendsResult(
             percentiles=tuple(percentiles), matrix=matrix, mask=mask,
@@ -418,15 +443,25 @@ class JaxBackend(Backend):
                     covered[sel] / total[sel] * 100.0)
             mask[kept_seg, pos_in_proj] = True
 
-        mj = jnp.asarray(matrix, dtype=jnp.float32)
-        kj = jnp.asarray(mask)
-        spear = np.asarray(masked_spearman(mj, kj), dtype=np.float64)
-        cols = mj.T  # [S, P]: percentile/mean per session index
-        colmask = kj.T
-        pcts = np.asarray(masked_percentile(
-            cols, colmask, np.array(RQ2TrendsResult.PCTS, dtype=np.float32)),
-            dtype=np.float64)
-        mean = np.asarray(masked_mean(cols, colmask), dtype=np.float64)
-        counts = mask.sum(axis=0)
+        q = np.array(RQ2TrendsResult.PCTS, dtype=np.float32)
+        if self._mesh is not None and S and P:
+            # Mesh collectives (north star): percentile/mean shard the
+            # session axis (each column reduces on one device — bit-exact),
+            # Spearman shards the project axis, counts psum project shards.
+            spear = rq_mesh.spearman_by_project_mesh(matrix, mask, self._mesh)
+            pcts = rq_mesh.percentile_by_session_mesh(
+                matrix.T, mask.T, q, self._mesh)
+            mean = rq_mesh.mean_by_session_mesh(matrix.T, mask.T, self._mesh)
+            counts = rq_mesh.counts_by_project_psum(mask, self._mesh)
+        else:
+            mj = jnp.asarray(matrix, dtype=jnp.float32)
+            kj = jnp.asarray(mask)
+            spear = np.asarray(masked_spearman(mj, kj), dtype=np.float64)
+            cols = mj.T  # [S, P]: percentile/mean per session index
+            colmask = kj.T
+            pcts = np.asarray(masked_percentile(cols, colmask, q),
+                              dtype=np.float64)
+            mean = np.asarray(masked_mean(cols, colmask), dtype=np.float64)
+            counts = mask.sum(axis=0)
         return RQ2TrendsResult(matrix=matrix, mask=mask, spearman=spear,
                                percentiles=pcts, mean=mean, counts=counts)

@@ -1,3 +1,6 @@
+from . import rq_mesh
 from .mesh import detection_hist_sharded, make_mesh, shard_along
+from .rq_mesh import auto_mesh
 
-__all__ = ["make_mesh", "shard_along", "detection_hist_sharded"]
+__all__ = ["make_mesh", "shard_along", "detection_hist_sharded",
+           "auto_mesh", "rq_mesh"]

@@ -1,0 +1,244 @@
+"""Mesh-native RQ reductions: the north star's "RQ aggregations as
+psum/pmean mesh collectives" (BASELINE.json; SURVEY.md §2.4).
+
+Each helper shards the *data* axis of an RQ kernel over a 1-D device mesh
+with `shard_map` and merges per-device partials with `psum` over ICI — the
+architectural seat NCCL holds in the reference's GPU-world peers.  Sharding
+axes are chosen so every float reduction stays *within* one device and only
+integer merges cross devices, which makes the mesh path bit-identical to the
+single-device path (asserted by tests/test_mesh_rq.py):
+
+- RQ1 (rq1_detection_rate.py:189-268): the issue/event axis is sharded;
+  per-device boolean (project, iteration) detection grids merge with an
+  integer `psum` — set-union is exact under addition+threshold.
+- RQ2 trends (rq2_coverage_count.py:330-435): per-session percentiles/means
+  shard the *session* axis (each column reduces on one device, bit-exact);
+  per-session project counts shard the *project* axis and `psum` int32
+  partial counts; per-project Spearman shards the *project* axis.
+- RQ4b (rq4b_coverage.py:910-1015): per-session group percentiles run the
+  device sort + order-statistic selection in float64 (x64 context) sharded
+  by session; the final two-point interpolation happens on host with
+  numpy's own `_lerp` formula so results are bit-identical to
+  `np.nanpercentile` (the advisor-mandated float64 parity contract).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import enable_x64
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.segment import (masked_mean, masked_percentile, masked_spearman,
+                           segment_searchsorted)
+from .mesh import make_mesh
+
+AXIS = "data"
+
+
+def auto_mesh() -> Mesh | None:
+    """A 1-D data mesh over all visible devices, or None on one device."""
+    return make_mesh() if jax.device_count() > 1 else None
+
+
+def _pad_rows(x: np.ndarray, n_dev: int, fill) -> np.ndarray:
+    pad = (-x.shape[0]) % n_dev
+    if not pad:
+        return x
+    block = np.full((pad,) + x.shape[1:], fill, dtype=x.dtype)
+    return np.concatenate([x, block], axis=0)
+
+
+# ---------------------------------------------------------------------------
+# RQ1: sharded issue axis + psum'd detection grid
+# ---------------------------------------------------------------------------
+
+def rq1_kernel_mesh(mesh: Mesh, fuzz_s, fuzz_ns, fuzz_offsets,
+                    ok_s, ok_ns, ok_offsets, ok_orig_idx,
+                    issue_s, issue_ns, issue_seg,
+                    n_projects: int, max_iter: int):
+    """Sharded twin of `jax_backend._rq1_kernel`: issues are split over the
+    mesh, build arrays ride replicated, and the unique-detected-projects
+    grid merges with a `psum` (integer, hence bit-exact vs single device).
+    Returns host arrays (iteration_of_issue, link_idx, detected)."""
+    n_dev = mesh.devices.size
+    q = int(np.asarray(issue_s).shape[0])
+    issue_s = _pad_rows(np.asarray(issue_s), n_dev, 0)
+    issue_ns = _pad_rows(np.asarray(issue_ns), n_dev, 0)
+    issue_seg = _pad_rows(np.asarray(issue_seg, dtype=np.int32), n_dev, 0)
+    valid = _pad_rows(np.ones(q, dtype=bool), n_dev, False)
+    have_ok = int(np.asarray(ok_orig_idx).shape[0]) > 0
+
+    @jax.jit
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS),
+                       P(), P(), P(), P(), P(), P(), P()),
+             out_specs=(P(AXIS), P(AXIS), P()))
+    def kernel(is_, ins, seg, ok_mask, fs, fns, f_off, oks, okns, ok_off,
+               ok_idx):
+        it = segment_searchsorted(fs, f_off, is_, seg, side="left",
+                                  values_lo=fns, queries_lo=ins)
+        pos = segment_searchsorted(oks, ok_off, is_, seg, side="left",
+                                   values_lo=okns, queries_lo=ins)
+        has_link = pos > 0
+        if have_ok:
+            gather = jnp.clip(ok_off[seg] + pos - 1, 0, ok_idx.shape[0] - 1)
+            link = jnp.where(has_link, ok_idx[gather], -1)
+        else:
+            link = jnp.full(seg.shape, -1, dtype=jnp.int32)
+        det_iter = jnp.where(has_link & ok_mask, it, 0)
+        in_range = det_iter <= max_iter
+        col = jnp.where(in_range, det_iter, 0)
+        grid = jnp.zeros((n_projects, max_iter + 1), dtype=jnp.bool_)
+        grid = grid.at[seg, col].set(True, mode="drop")
+        merged = jax.lax.psum(grid.astype(jnp.int32), AXIS)
+        detected = (merged[:, 1:] > 0).sum(axis=0, dtype=jnp.int32)
+        return it, link, detected
+
+    it, link, detected = kernel(
+        jnp.asarray(issue_s), jnp.asarray(issue_ns), jnp.asarray(issue_seg),
+        jnp.asarray(valid),
+        jnp.asarray(fuzz_s), jnp.asarray(fuzz_ns),
+        jnp.asarray(fuzz_offsets, dtype=jnp.int32),
+        jnp.asarray(ok_s), jnp.asarray(ok_ns),
+        jnp.asarray(ok_offsets, dtype=jnp.int32),
+        jnp.asarray(ok_orig_idx, dtype=jnp.int32))
+    return (np.asarray(it)[:q], np.asarray(link)[:q], np.asarray(detected))
+
+
+# ---------------------------------------------------------------------------
+# RQ2 trends: session-sharded percentiles/means, project-psum counts
+# ---------------------------------------------------------------------------
+
+def percentile_by_session_mesh(cols, colmask, q, mesh: Mesh):
+    """masked_percentile over [S, P] with the session axis sharded.  Each
+    column reduces wholly on one device, so values are bit-identical to the
+    single-device `masked_percentile` (same float32 op sequence)."""
+    n_dev = mesh.devices.size
+    s = cols.shape[0]
+    cols = _pad_rows(np.asarray(cols, dtype=np.float32), n_dev, 0.0)
+    colmask = _pad_rows(np.asarray(colmask, dtype=bool), n_dev, False)
+    qv = np.atleast_1d(np.asarray(q, dtype=np.float32))
+
+    @jax.jit
+    @partial(shard_map, mesh=mesh, in_specs=(P(AXIS, None), P(AXIS, None)),
+             out_specs=P(None, AXIS))
+    def kernel(x, m):
+        return masked_percentile(x, m, qv)
+
+    return np.asarray(kernel(jnp.asarray(cols), jnp.asarray(colmask)),
+                      dtype=np.float64)[:, :s]
+
+
+def mean_by_session_mesh(cols, colmask, mesh: Mesh):
+    """masked_mean over [S, P] sharded on the session axis (bit-exact)."""
+    n_dev = mesh.devices.size
+    s = cols.shape[0]
+    cols = _pad_rows(np.asarray(cols, dtype=np.float32), n_dev, 0.0)
+    colmask = _pad_rows(np.asarray(colmask, dtype=bool), n_dev, False)
+
+    @jax.jit
+    @partial(shard_map, mesh=mesh, in_specs=(P(AXIS, None), P(AXIS, None)),
+             out_specs=P(AXIS))
+    def kernel(x, m):
+        return masked_mean(x, m)
+
+    return np.asarray(kernel(jnp.asarray(cols), jnp.asarray(colmask)),
+                      dtype=np.float64)[:s]
+
+
+def counts_by_project_psum(mask, mesh: Mesh) -> np.ndarray:
+    """Per-session valid-project counts of a [P, S] mask as a `psum` over a
+    project-sharded mesh — the pmean/psum seat of the reference's per-session
+    `len(valid_projects)` loop (rq2_coverage_count.py:390-398).  Integer, so
+    exact."""
+    n_dev = mesh.devices.size
+    mask = _pad_rows(np.asarray(mask, dtype=bool), n_dev, False)
+
+    @jax.jit
+    @partial(shard_map, mesh=mesh, in_specs=(P(AXIS, None),),
+             out_specs=P())
+    def kernel(m):
+        return jax.lax.psum(m.sum(axis=0, dtype=jnp.int32), AXIS)
+
+    return np.asarray(kernel(jnp.asarray(mask)), dtype=np.int64)
+
+
+def spearman_by_project_mesh(matrix, mask, mesh: Mesh):
+    """masked_spearman over [P, S] with the project axis sharded (each row
+    reduces on one device; bit-identical to the single-device path)."""
+    n_dev = mesh.devices.size
+    p = matrix.shape[0]
+    matrix = _pad_rows(np.asarray(matrix, dtype=np.float32), n_dev, 0.0)
+    mask = _pad_rows(np.asarray(mask, dtype=bool), n_dev, False)
+
+    @jax.jit
+    @partial(shard_map, mesh=mesh, in_specs=(P(AXIS, None), P(AXIS, None)),
+             out_specs=P(AXIS))
+    def kernel(x, m):
+        return masked_spearman(x, m)
+
+    return np.asarray(kernel(jnp.asarray(matrix), jnp.asarray(mask)),
+                      dtype=np.float64)[:p]
+
+
+# ---------------------------------------------------------------------------
+# RQ4b: float64 per-session group percentiles, session-sharded
+# ---------------------------------------------------------------------------
+
+def nanpercentile_by_session_mesh(sub: np.ndarray, q, mesh: Mesh) -> np.ndarray:
+    """Bit-exact `np.nanpercentile(sub, q, axis=0)` with the heavy work — the
+    per-session float64 sort and order-statistic selection — sharded over the
+    mesh (x64 context; sessions split across devices).
+
+    The device returns, per (percentile, session), the two bracketing order
+    statistics; the host applies numpy's `_lerp` formula (including its
+    `gamma >= 0.5` re-association fixup) in float64, so the result is
+    bit-identical to the host `np.nanpercentile` the advisor-parity contract
+    requires.  `sub` is [G, S] float64 with NaN = missing (must not contain
+    +inf, which is the sort fill)."""
+    g, s = sub.shape
+    qf = np.atleast_1d(np.asarray(q, dtype=np.float64)) / 100.0
+    if g == 0 or s == 0:
+        return np.full((qf.shape[0], s), np.nan)
+    n_dev = mesh.devices.size
+    cols = _pad_rows(np.ascontiguousarray(sub.T), n_dev, np.nan)  # [S', G]
+
+    with enable_x64():
+
+        @jax.jit
+        @partial(shard_map, mesh=mesh, in_specs=(P(AXIS, None),),
+                 out_specs=(P(None, AXIS), P(None, AXIS), P(AXIS)))
+        def kernel(x):
+            m = ~jnp.isnan(x)
+            n = m.sum(axis=-1)                       # [s_shard]
+            filled = jnp.where(m, x, jnp.inf)
+            srt = jnp.sort(filled, axis=-1)          # valid first
+            # virtual index per numpy's linear method: (n-1) * (q/100)
+            pos = (n - 1).astype(jnp.float64) * jnp.asarray(qf)[:, None]
+            lo = jnp.clip(jnp.floor(pos).astype(jnp.int32), 0,
+                          max(g - 1, 0))
+            hi = jnp.minimum(lo + 1,
+                             jnp.maximum(n - 1, 0).astype(jnp.int32)[None, :])
+            vlo = jnp.take_along_axis(srt, lo.T, axis=-1).T
+            vhi = jnp.take_along_axis(srt, hi.T, axis=-1).T
+            return vlo, vhi, n
+
+        vlo, vhi, n = kernel(jnp.asarray(cols, dtype=jnp.float64))
+
+    vlo = np.asarray(vlo, dtype=np.float64)[:, :s]
+    vhi = np.asarray(vhi, dtype=np.float64)[:, :s]
+    n = np.asarray(n, dtype=np.int64)[:s]
+    pos = (n - 1).astype(np.float64) * qf[:, None]
+    gamma = pos - np.floor(pos)
+    diff = vhi - vlo
+    with np.errstate(invalid="ignore"):
+        out = vlo + diff * gamma
+        fix = gamma >= 0.5
+        out[fix] = (vhi - diff * (1.0 - gamma))[fix]
+    out[:, n == 0] = np.nan
+    return out
