@@ -444,7 +444,16 @@ class JaxBackend(Backend):
             mask[kept_seg, pos_in_proj] = True
 
         q = np.array(RQ2TrendsResult.PCTS, dtype=np.float32)
-        if self._mesh is not None and S and P:
+        if S == 0 or P == 0:
+            # Empty study (e.g. no eligible projects): zero-width device
+            # kernels are ill-formed, so emit the empty result directly.
+            return RQ2TrendsResult(
+                matrix=matrix, mask=mask,
+                spearman=np.full(P, np.nan),
+                percentiles=np.full((len(RQ2TrendsResult.PCTS), S), np.nan),
+                mean=np.full(S, np.nan),
+                counts=np.zeros(S, dtype=np.int64))
+        if self._mesh is not None:
             # Mesh collectives (north star): percentile/mean shard the
             # session axis (each column reduces on one device — bit-exact),
             # Spearman shards the project axis, counts psum project shards.

@@ -136,6 +136,8 @@ def masked_spearman(x, mask):
     x = jnp.asarray(x, dtype=jnp.float32)
     mask = jnp.asarray(mask)
     C = x.shape[-1]
+    if C == 0:
+        return jnp.full(x.shape[:-1], jnp.nan, dtype=jnp.float32)
 
     def one_row(xr, mr):
         big = jnp.float32(np.finfo(np.float32).max)
@@ -180,6 +182,9 @@ def masked_percentile(x, mask, q):
     scalar_q = np.ndim(q) == 0
     x = jnp.asarray(x, dtype=jnp.float32)
     mask = jnp.asarray(mask)
+    if x.shape[-1] == 0:
+        shape = x.shape[:-1] if scalar_q else (np.shape(q)[0],) + x.shape[:-1]
+        return jnp.full(shape, jnp.nan, dtype=jnp.float32)
     big = jnp.float32(np.finfo(np.float32).max)
     filled = jnp.where(mask, x, big)
     s = jnp.sort(filled, axis=-1)  # valid entries first, pads at the end

@@ -29,8 +29,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.experimental import enable_x64
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.segment import (masked_mean, masked_percentile, masked_spearman,
@@ -38,6 +37,20 @@ from ..ops.segment import (masked_mean, masked_percentile, masked_spearman,
 from .mesh import make_mesh
 
 AXIS = "data"
+
+_F64_EXACT: dict = {}
+
+
+def _device_f64_exact(device) -> bool:
+    """True iff a float64 host->device->host roundtrip is lossless on
+    `device` (true on CPU; false on TPU, which has no native f64)."""
+    key = getattr(device, "platform", str(device))
+    if key not in _F64_EXACT:
+        canary = np.array([1.0 + 2.0 ** -50, np.pi, 1e300], dtype=np.float64)
+        with jax.enable_x64(True):
+            back = np.asarray(jax.device_get(jax.device_put(canary, device)))
+        _F64_EXACT[key] = bool(np.array_equal(canary, back))
+    return _F64_EXACT[key]
 
 
 def auto_mesh() -> Mesh | None:
@@ -199,16 +212,29 @@ def nanpercentile_by_session_mesh(sub: np.ndarray, q, mesh: Mesh) -> np.ndarray:
     statistics; the host applies numpy's `_lerp` formula (including its
     `gamma >= 0.5` re-association fixup) in float64, so the result is
     bit-identical to the host `np.nanpercentile` the advisor-parity contract
-    requires.  `sub` is [G, S] float64 with NaN = missing (must not contain
-    +inf, which is the sort fill)."""
+    requires.  `sub` is [G, S] float64 with NaN = missing.  Inputs holding
+    +inf, or meshes on devices without lossless float64 (TPU), are computed
+    on host instead — same values, no device sharding (see guard below)."""
     g, s = sub.shape
     qf = np.atleast_1d(np.asarray(q, dtype=np.float64)) / 100.0
     if g == 0 or s == 0:
         return np.full((qf.shape[0], s), np.nan)
+    # Two cases where the device kernel cannot honor the bit-parity
+    # contract: (a) +inf input collides with the sort fill and breaks the
+    # lerp (inf - inf = nan where numpy yields inf); (b) platforms without
+    # native float64 (TPU) drop low-order bits on a mere device roundtrip.
+    # Percentiles over [G, S] are cheap vs the study kernels, so both route
+    # to host np.nanpercentile, which keeps mesh/non-mesh behavior and
+    # values identical.
+    if np.isposinf(sub).any() or not _device_f64_exact(mesh.devices.flat[0]):
+        import warnings
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            return np.nanpercentile(sub, np.atleast_1d(q), axis=0)
     n_dev = mesh.devices.size
     cols = _pad_rows(np.ascontiguousarray(sub.T), n_dev, np.nan)  # [S', G]
 
-    with enable_x64():
+    with jax.enable_x64(True):
 
         @jax.jit
         @partial(shard_map, mesh=mesh, in_specs=(P(AXIS, None),),
