@@ -1,0 +1,222 @@
+"""Mesh-vs-single-device bit-parity for every parallel/rq_mesh helper.
+
+This is the file rq_mesh.py's docstring promises: each sharded reduction is
+asserted bit-identical to its single-device twin (the design contract —
+float reductions stay device-local, only integer partials cross the mesh),
+and the hand-rolled float64 nanpercentile is checked against
+``np.nanpercentile`` on adversarial NaN/inf/degenerate inputs.  Runs on the
+8 virtual CPU devices conftest.py forces; mesh sizes 8 and 3 cover both the
+even and the padded shard layouts.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tse1m_tpu.backend.jax_backend import JaxBackend
+from tse1m_tpu.backend.pandas_backend import PandasBackend
+from tse1m_tpu.config import Config
+from tse1m_tpu.data.columnar import StudyArrays
+from tse1m_tpu.ops.segment import (masked_mean, masked_percentile,
+                                   masked_spearman)
+from tse1m_tpu.parallel import rq_mesh
+from tse1m_tpu.parallel.mesh import make_mesh
+
+LIMIT = "2026-01-01"
+
+
+@pytest.fixture(scope="module", params=[8, 3])
+def mesh(request):
+    return make_mesh(request.param)
+
+
+@pytest.fixture(scope="module")
+def arrays(study_db):
+    cfg = Config(engine="sqlite", sqlite_path=study_db.config.sqlite_path,
+                 limit_date=LIMIT)
+    return StudyArrays.from_db(study_db, cfg)
+
+
+@pytest.fixture(scope="module")
+def limit_ns():
+    return int(np.datetime64(LIMIT, "ns").astype(np.int64))
+
+
+def ragged(rng, rows, cols, frac_valid=0.7):
+    x = rng.normal(50.0, 20.0, size=(rows, cols)).astype(np.float32)
+    mask = rng.random((rows, cols)) < frac_valid
+    mask[rng.integers(0, rows)] = False          # one fully-empty row
+    if rows > 1:
+        mask[rng.integers(0, rows)] = True       # one fully-dense row
+    return x, mask
+
+
+def test_auto_mesh_spans_all_devices():
+    m = rq_mesh.auto_mesh()
+    assert m is not None and m.devices.size == jax.device_count() == 8
+
+
+def test_percentile_by_session_mesh_bit_parity(mesh):
+    rng = np.random.default_rng(11)
+    cols, colmask = ragged(rng, rows=37, cols=16)   # 37 % 8 != 0: padding
+    q = np.array([5.0, 25.0, 50.0, 75.0, 95.0], dtype=np.float32)
+    got = rq_mesh.percentile_by_session_mesh(cols, colmask, q, mesh)
+    want = np.asarray(masked_percentile(jnp.asarray(cols),
+                                        jnp.asarray(colmask), q),
+                      dtype=np.float64)
+    np.testing.assert_array_equal(got, want)
+    assert got.shape == (5, 37)
+
+
+def test_mean_by_session_mesh_bit_parity(mesh):
+    rng = np.random.default_rng(12)
+    cols, colmask = ragged(rng, rows=41, cols=9)
+    got = rq_mesh.mean_by_session_mesh(cols, colmask, mesh)
+    want = np.asarray(masked_mean(jnp.asarray(cols), jnp.asarray(colmask)),
+                      dtype=np.float64)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_counts_by_project_psum_exact(mesh):
+    rng = np.random.default_rng(13)
+    mask = rng.random((29, 14)) < 0.4
+    got = rq_mesh.counts_by_project_psum(mask, mesh)
+    np.testing.assert_array_equal(got, mask.sum(axis=0))
+
+
+def test_spearman_by_project_mesh_bit_parity(mesh):
+    rng = np.random.default_rng(14)
+    matrix, mask = ragged(rng, rows=27, cols=40)
+    got = rq_mesh.spearman_by_project_mesh(matrix, mask, mesh)
+    want = np.asarray(masked_spearman(jnp.asarray(matrix), jnp.asarray(mask)),
+                      dtype=np.float64)
+    np.testing.assert_array_equal(got, want)
+
+
+# ---------------------------------------------------------------------------
+# nanpercentile_by_session_mesh vs the np.nanpercentile oracle
+# ---------------------------------------------------------------------------
+
+Q_GRID = np.array([0.0, 25.0, 50.0, 75.0, 90.0, 100.0])
+
+
+def _oracle(sub, q):
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        return np.nanpercentile(sub, np.atleast_1d(q), axis=0)
+
+
+def test_nanpercentile_mesh_random_nan_heavy(mesh):
+    rng = np.random.default_rng(15)
+    sub = rng.normal(0.0, 100.0, size=(23, 53))
+    sub[rng.random(sub.shape) < 0.5] = np.nan
+    got = rq_mesh.nanpercentile_by_session_mesh(sub, Q_GRID, mesh)
+    np.testing.assert_array_equal(got, _oracle(sub, Q_GRID))
+
+
+def test_nanpercentile_mesh_adversarial_columns(mesh):
+    """All-NaN columns, n=1 columns, constant columns, denormal-scale
+    values — every column shape the RQ4b trend matrix can produce."""
+    rng = np.random.default_rng(16)
+    sub = rng.normal(0.0, 1.0, size=(7, 19))
+    sub[:, 0] = np.nan                 # all-NaN session
+    sub[1:, 1] = np.nan                # single-value session
+    sub[:, 2] = 3.25                   # constant session
+    sub[:3, 3] = 1e-300                # subnormal-adjacent magnitudes
+    sub[3:, 3] = np.nan
+    got = rq_mesh.nanpercentile_by_session_mesh(sub, Q_GRID, mesh)
+    np.testing.assert_array_equal(got, _oracle(sub, Q_GRID))
+
+
+def test_nanpercentile_mesh_posinf_routes_to_host(mesh):
+    """+inf collides with the device sort fill, so the guard must route to
+    host np.nanpercentile — values still match the oracle exactly."""
+    rng = np.random.default_rng(17)
+    sub = rng.normal(0.0, 1.0, size=(5, 11))
+    sub[2, 4] = np.inf
+    sub[0, 7] = np.nan
+    got = rq_mesh.nanpercentile_by_session_mesh(sub, Q_GRID, mesh)
+    np.testing.assert_array_equal(got, _oracle(sub, Q_GRID))
+
+
+def test_nanpercentile_mesh_neginf_on_device(mesh):
+    """-inf does NOT collide with the +inf sort fill and stays on device."""
+    rng = np.random.default_rng(18)
+    sub = rng.normal(0.0, 1.0, size=(6, 13))
+    sub[1, 3] = -np.inf
+    sub[4, 9] = np.nan
+    got = rq_mesh.nanpercentile_by_session_mesh(sub, Q_GRID, mesh)
+    np.testing.assert_array_equal(got, _oracle(sub, Q_GRID))
+
+
+def test_nanpercentile_mesh_empty_inputs(mesh):
+    got = rq_mesh.nanpercentile_by_session_mesh(
+        np.empty((0, 5)), Q_GRID, mesh)
+    assert got.shape == (Q_GRID.size, 5) and np.isnan(got).all()
+    got = rq_mesh.nanpercentile_by_session_mesh(
+        np.empty((4, 0)), Q_GRID, mesh)
+    assert got.shape == (Q_GRID.size, 0)
+
+
+def test_nanpercentile_mesh_scalar_q(mesh):
+    rng = np.random.default_rng(19)
+    sub = rng.normal(size=(9, 10))
+    sub[rng.random(sub.shape) < 0.3] = np.nan
+    got = rq_mesh.nanpercentile_by_session_mesh(sub, 50.0, mesh)
+    np.testing.assert_array_equal(got, _oracle(sub, 50.0))
+
+
+# ---------------------------------------------------------------------------
+# rq1_kernel_mesh vs the single-device _rq1_kernel through the backend
+# ---------------------------------------------------------------------------
+
+def _assert_rq1_equal(a, b):
+    for f in ("iterations", "total_projects", "detected_counts",
+              "iteration_of_issue", "link_idx"):
+        np.testing.assert_array_equal(getattr(a, f), getattr(b, f), err_msg=f)
+
+
+def test_rq1_mesh_vs_single_device(arrays, limit_ns, mesh):
+    """The issue axis rarely divides the device count — the synth study's
+    issue total exercises the padded-shard path of rq1_kernel_mesh."""
+    res_mesh = JaxBackend(mesh=mesh).rq1_detection(arrays, limit_ns,
+                                                   min_projects=2)
+    res_one = JaxBackend(mesh=None).rq1_detection(arrays, limit_ns,
+                                                  min_projects=2)
+    _assert_rq1_equal(res_mesh, res_one)
+
+
+def test_rq2_trends_mesh_vs_single_device(arrays, limit_ns, mesh):
+    res_mesh = JaxBackend(mesh=mesh).rq2_trends(arrays, limit_ns)
+    res_one = JaxBackend(mesh=None).rq2_trends(arrays, limit_ns)
+    for f in ("matrix", "mask", "spearman", "percentiles", "mean", "counts"):
+        np.testing.assert_array_equal(getattr(res_mesh, f),
+                                      getattr(res_one, f), err_msg=f)
+
+
+def test_rq4b_trends_mesh_vs_single_device(arrays, limit_ns, mesh):
+    rng = np.random.default_rng(20)
+    perm = rng.permutation(arrays.n_projects)
+    g1, g2 = np.sort(perm[:6]), np.sort(perm[6:12])
+    res_mesh = JaxBackend(mesh=mesh).rq4b_group_trends(
+        arrays, limit_ns, g1, g2)
+    res_one = JaxBackend(mesh=None).rq4b_group_trends(
+        arrays, limit_ns, g1, g2)
+    for f in ("matrix", "mask", "g1_percentiles", "g1_counts",
+              "g2_percentiles", "g2_counts"):
+        np.testing.assert_array_equal(getattr(res_mesh, f),
+                                      getattr(res_one, f), err_msg=f)
+
+
+def test_mesh_parity_vs_pandas_oracle(arrays, limit_ns):
+    """Transitive closure: the mesh path equals the pandas reference
+    semantics directly, not just the other jax branch."""
+    m = rq_mesh.auto_mesh()
+    assert m is not None
+    res_mesh = JaxBackend(mesh=m).rq1_detection(arrays, limit_ns,
+                                                min_projects=2)
+    res_pd = PandasBackend().rq1_detection(arrays, limit_ns, min_projects=2)
+    _assert_rq1_equal(res_mesh, res_pd)

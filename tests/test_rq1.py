@@ -28,9 +28,12 @@ def limit_ns():
     return int(np.datetime64(LIMIT, "ns").astype(np.int64))
 
 
-def test_backend_parity(arrays, limit_ns):
+@pytest.mark.parametrize("mesh", [None, "auto"],
+                         ids=["single-device", "mesh"])
+def test_backend_parity(arrays, limit_ns, mesh):
     res_pd = PandasBackend().rq1_detection(arrays, limit_ns, min_projects=2)
-    res_jx = JaxBackend().rq1_detection(arrays, limit_ns, min_projects=2)
+    res_jx = JaxBackend(mesh=mesh).rq1_detection(arrays, limit_ns,
+                                                 min_projects=2)
     np.testing.assert_array_equal(res_pd.iterations, res_jx.iterations)
     np.testing.assert_array_equal(res_pd.total_projects, res_jx.total_projects)
     np.testing.assert_array_equal(res_pd.detected_counts, res_jx.detected_counts)

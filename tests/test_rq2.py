@@ -77,9 +77,11 @@ def test_change_points_oracle(arrays, limit_ns, study_db):
         assert got.get(project, []) == expect, project
 
 
-def test_trends_backend_parity(arrays, limit_ns):
+@pytest.mark.parametrize("mesh", [None, "auto"],
+                         ids=["single-device", "mesh"])
+def test_trends_backend_parity(arrays, limit_ns, mesh):
     pd_res = PandasBackend().rq2_trends(arrays, limit_ns)
-    jx_res = JaxBackend().rq2_trends(arrays, limit_ns)
+    jx_res = JaxBackend(mesh=mesh).rq2_trends(arrays, limit_ns)
     np.testing.assert_array_equal(pd_res.mask, jx_res.mask)
     np.testing.assert_allclose(pd_res.matrix, jx_res.matrix, equal_nan=True)
     np.testing.assert_array_equal(pd_res.counts, jx_res.counts)

@@ -49,14 +49,16 @@ def group_indices(groups, arrays):
     return groups.indices("group1", pidx), groups.indices("group2", pidx)
 
 
-def test_trends_backend_parity(arrays, limit_ns, group_indices):
+@pytest.mark.parametrize("mesh", [None, "auto"],
+                         ids=["single-device", "mesh"])
+def test_trends_backend_parity(arrays, limit_ns, group_indices, mesh):
     """Bit-exact parity — the percentile values feed summarize_trends' G2>G1
     win counts, which flip on any rounding divergence (ADVICE r1)."""
     g1, g2 = group_indices
     res_pd = PandasBackend().rq4b_group_trends(arrays, limit_ns, g1, g2,
                                                PERCENTILES)
-    res_jx = JaxBackend().rq4b_group_trends(arrays, limit_ns, g1, g2,
-                                            PERCENTILES)
+    res_jx = JaxBackend(mesh=mesh).rq4b_group_trends(arrays, limit_ns, g1, g2,
+                                                     PERCENTILES)
     assert res_pd.matrix.shape == res_jx.matrix.shape
     assert res_pd.matrix.shape[1] > 0
     for f in ("matrix", "mask", "g1_percentiles", "g1_counts",

@@ -32,8 +32,7 @@ import numpy as np
 from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..ops.segment import (masked_mean, masked_percentile, masked_spearman,
-                           segment_searchsorted)
+from ..ops.segment import masked_mean, masked_spearman, segment_searchsorted
 from .mesh import make_mesh
 
 AXIS = "data"
@@ -128,23 +127,52 @@ def rq1_kernel_mesh(mesh: Mesh, fuzz_s, fuzz_ns, fuzz_offsets,
 # ---------------------------------------------------------------------------
 
 def percentile_by_session_mesh(cols, colmask, q, mesh: Mesh):
-    """masked_percentile over [S, P] with the session axis sharded.  Each
-    column reduces wholly on one device, so values are bit-identical to the
-    single-device `masked_percentile` (same float32 op sequence)."""
+    """masked_percentile over [S, P] with the session axis sharded.
+
+    Bit-parity note: the single-device path runs `masked_percentile`
+    *eagerly* — every float32 op IEEE-rounded separately — while a fused
+    `jit(shard_map(...))` kernel lets XLA contract the final interpolation
+    into an fma, drifting 1-2 ulps.  So the device does only the
+    rounding-free work (the per-session sort and the two order-statistic
+    gathers, sharded over the mesh) and the host replays the eager kernel's
+    float32 index/lerp sequence op-for-op, which makes this bit-identical
+    to `masked_percentile` (asserted by tests/test_mesh_rq.py)."""
     n_dev = mesh.devices.size
     s = cols.shape[0]
     cols = _pad_rows(np.asarray(cols, dtype=np.float32), n_dev, 0.0)
     colmask = _pad_rows(np.asarray(colmask, dtype=bool), n_dev, False)
     qv = np.atleast_1d(np.asarray(q, dtype=np.float32))
+    width = cols.shape[1]
+    if width == 0:
+        return np.full((qv.shape[0], s), np.nan)
+    # Host-side float32 index math, same op order as masked_percentile.
+    n_valid = colmask.sum(axis=1).astype(np.int32)                # [S']
+    pos = (n_valid.astype(np.float32) - np.float32(1.0)) \
+        * qv[:, None] / np.float32(100.0)                         # [K, S']
+    lo = np.clip(np.floor(pos).astype(np.int32), 0, width - 1)
+    hi = np.clip(lo + 1, 0, width - 1)
+    frac = pos - lo.astype(np.float32)
 
     @jax.jit
-    @partial(shard_map, mesh=mesh, in_specs=(P(AXIS, None), P(AXIS, None)),
-             out_specs=P(None, AXIS))
-    def kernel(x, m):
-        return masked_percentile(x, m, qv)
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P(AXIS, None), P(AXIS, None), P(None, AXIS),
+                       P(None, AXIS)),
+             out_specs=(P(None, AXIS), P(None, AXIS)))
+    def kernel(x, m, lo_, hi_):
+        big = jnp.float32(np.finfo(np.float32).max)
+        srt = jnp.sort(jnp.where(m, x, big), axis=-1)  # valid entries first
+        vlo = jnp.take_along_axis(srt, lo_.T, axis=-1).T
+        vhi = jnp.take_along_axis(srt, hi_.T, axis=-1).T
+        return vlo, vhi
 
-    return np.asarray(kernel(jnp.asarray(cols), jnp.asarray(colmask)),
-                      dtype=np.float64)[:, :s]
+    vlo, vhi = kernel(jnp.asarray(cols), jnp.asarray(colmask),
+                      jnp.asarray(lo), jnp.asarray(hi))
+    vlo = np.asarray(vlo, dtype=np.float32)
+    vhi = np.asarray(vhi, dtype=np.float32)
+    hi_valid = (lo + 1) <= (n_valid[None, :] - 1)
+    out = vlo + np.where(hi_valid, frac * (vhi - vlo), np.float32(0.0))
+    out = np.where(n_valid[None, :] > 0, out, np.float32(np.nan))
+    return out.astype(np.float64)[:, :s]
 
 
 def mean_by_session_mesh(cols, colmask, mesh: Mesh):
@@ -261,8 +289,8 @@ def nanpercentile_by_session_mesh(sub: np.ndarray, q, mesh: Mesh) -> np.ndarray:
     n = np.asarray(n, dtype=np.int64)[:s]
     pos = (n - 1).astype(np.float64) * qf[:, None]
     gamma = pos - np.floor(pos)
-    diff = vhi - vlo
     with np.errstate(invalid="ignore"):
+        diff = vhi - vlo
         out = vlo + diff * gamma
         fix = gamma >= 0.5
         out[fix] = (vhi - diff * (1.0 - gamma))[fix]
