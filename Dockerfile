@@ -1,0 +1,28 @@
+# Research container for the TPU rebuild (reference Dockerfile:1-35: a
+# python-slim + uv image whose CMD idles so `docker compose run research`
+# can exec the analysis).  CPU jax runs everything here — including the
+# 8-virtual-device mesh tests; on a TPU VM swap in the jax[tpu] wheel
+# (see requirements.txt) and run outside docker-compose's db harness.
+FROM python:3.12-slim
+
+COPY --from=ghcr.io/astral-sh/uv:latest /uv /uvx /bin/
+
+WORKDIR /app
+
+# git is needed by the collection layer (project first-commit archaeology,
+# corpus `git log -S` analysis); build tools cover sdist fallbacks.
+RUN apt-get update && apt-get install -y --no-install-recommends \
+    git \
+    build-essential \
+    && rm -rf /var/lib/apt/lists/*
+
+# Dependencies first so code edits don't bust the layer cache.
+COPY requirements.txt /app/
+RUN uv pip install --system -r requirements.txt psycopg2-binary pytest
+
+COPY ./tse1m_tpu /app/tse1m_tpu
+COPY ./program /app/program
+COPY ./tests /app/tests
+COPY ./run_all_analysis.sh ./bench.py ./__graft_entry__.py ./pyproject.toml /app/
+
+CMD ["sleep", "infinity"]

@@ -1,0 +1,65 @@
+#!/bin/bash
+# Orchestrator: the TPU rebuild of the reference's run_all_analysis.sh
+# (reference run_all_analysis.sh:1-53) — the same six sequential steps over
+# the same entry-point paths, `set -e` fail-fast.  The engine behind each
+# step is chosen by program/envFile.ini [FRAMEWORK] backend (pandas |
+# jax_tpu); TSE1M_BACKEND overrides per run.
+#
+# The reference assumes a Postgres restored from backup_clean.sql
+# (README.md:55).  That dump is not redistributable, so on a clean checkout
+# with the sqlite engine this script bootstraps a synthetic study of the
+# same shape first (disable with TSE1M_NO_SYNTH=1).
+
+set -e
+
+INI=program/envFile.ini
+ENGINE=$(awk -F' *= *' '/^engine/ {print $2}' "$INI")
+ENGINE=${TSE1M_ENGINE:-${ENGINE:-sqlite}}
+DB_PATH=$(awk -F' *= *' '/^sqlite_path/ {print $2}' "$INI")
+DB_PATH=${TSE1M_SQLITE_PATH:-${DB_PATH:-data/database/tse1m.sqlite}}
+
+if [ "$ENGINE" = "sqlite" ] && [ ! -f "$DB_PATH" ] && [ -z "$TSE1M_NO_SYNTH" ]; then
+    echo "No study database at $DB_PATH - generating a synthetic study"
+    echo "(the reference restores backup_clean.sql here; see README)."
+    python3 -m tse1m_tpu.cli synth --db "$DB_PATH"
+fi
+
+echo "========================================================"
+echo "Starting Reproduction of All Research Questions (RQ1-RQ4)"
+echo "========================================================"
+
+echo ""
+echo "[1/6] Running RQ1: Detection Rate Analysis..."
+echo "Executing: python3 program/research_questions/rq1_detection_rate.py"
+python3 program/research_questions/rq1_detection_rate.py
+
+echo ""
+echo "[2/6] Running RQ2: Coverage and Added Analysis..."
+echo "Executing: python3 program/research_questions/rq2_coverage_and_added.py"
+python3 program/research_questions/rq2_coverage_and_added.py
+
+echo ""
+echo "[3/6] Running RQ2: Coverage Count Analysis..."
+echo "Executing: python3 program/research_questions/rq2_coverage_count.py"
+python3 program/research_questions/rq2_coverage_count.py
+
+echo ""
+echo "[4/6] Running RQ3: Diff Coverage at Detection..."
+echo "Executing: python3 program/research_questions/rq3_diff_coverage_at_detection.py"
+python3 program/research_questions/rq3_diff_coverage_at_detection.py
+
+echo ""
+echo "[5/6] Running RQ4a: Bug Analysis..."
+echo "Executing: python3 program/research_questions/rq4a_bug.py"
+python3 program/research_questions/rq4a_bug.py
+
+echo ""
+echo "[6/6] Running RQ4b: Coverage Analysis..."
+echo "Executing: python3 program/research_questions/rq4b_coverage.py"
+python3 program/research_questions/rq4b_coverage.py
+
+echo ""
+echo "========================================================"
+echo "All Research Questions have been reproduced successfully!"
+echo "Results are saved in the 'data/result_data' directory."
+echo "========================================================"
