@@ -5,30 +5,91 @@ ARI >= 0.98 vs the host baseline.  Prints ONE JSON line:
   {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
 vs_baseline = 60 / wall_s, i.e. >1.0 beats the published target.
 
-Runs on whatever jax.devices() offers (the driver provides one real chip);
-first invocation pays the XLA compile, the timed run is steady-state.
+`value` is the MEDIAN of --iters (>=3) timed steady-state runs; `best_s`
+and `runs_s` are also recorded so round-over-round artifacts are comparable
+(a single-iteration bench produced 12.5 s vs 37.5 s round-to-round noise on
+the same chip).  A second stage times the columnar extraction layer — the
+host stage that feeds the device kernels — over a synthetic study at the
+reference's ~1.19M-build scale (rq1_detection_rate.py:362), as
+`extract_*` keys.
+
+Env overrides (also flags): BENCH_N sessions, BENCH_ITERS timed iters,
+BENCH_EXTRACT_BUILDS extraction scale (0 disables the extraction stage).
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import statistics
 import sys
+import tempfile
 import time
+
+
+def bench_extraction(target_builds: int, seed: int = 0) -> dict:
+    """Synth study at ~target_builds fuzzing builds -> sqlite -> timed
+    StudyArrays.from_db (the bulk columnar decode; SURVEY §7.2 step 2)."""
+    from tse1m_tpu.config import Config
+    from tse1m_tpu.data.columnar import StudyArrays
+    from tse1m_tpu.data.synth import SynthSpec, generate_study
+    from tse1m_tpu.db.connection import DB
+
+    # builds ~= n_projects * days * fuzz_rate
+    days = 1600
+    rate = 1.4
+    n_projects = max(8, round(target_builds / (days * rate)))
+    # ineligible_fraction=0: every project passes the 365-day eligibility
+    # gate, so the extracted build count actually hits target_builds.
+    spec = SynthSpec(n_projects=n_projects, days=days, seed=seed,
+                     fuzz_rate=rate, ineligible_fraction=0.0)
+    study = generate_study(spec)
+    with tempfile.TemporaryDirectory() as d:
+        cfg = Config(engine="sqlite",
+                     sqlite_path=os.path.join(d, "bench.sqlite"),
+                     limit_date="2026-01-01")
+        db = DB(config=cfg).connect()
+        study.to_db(db)
+        StudyArrays.from_db(db, cfg)  # warm sqlite page cache
+        t0 = time.perf_counter()
+        arrays = StudyArrays.from_db(db, cfg)
+        wall = time.perf_counter() - t0
+        db.closeConnection()
+    n_builds = len(arrays.fuzz)
+    return {
+        "extract_builds": n_builds,
+        "extract_rows_total": (len(arrays.fuzz) + len(arrays.covb)
+                               + len(arrays.issues) + len(arrays.cov)),
+        "extract_wall_s": round(wall, 4),
+        "extract_builds_per_s": round(n_builds / wall),
+    }
 
 
 def main() -> int:
     p = argparse.ArgumentParser()
-    p.add_argument("--n", type=int, default=1_000_000)
+    p.add_argument("--n", type=int,
+                   default=int(os.environ.get("BENCH_N", 1_000_000)))
+    p.add_argument("--iters", type=int,
+                   default=int(os.environ.get("BENCH_ITERS", 3)),
+                   help="timed steady-state iterations; median reported "
+                        "(default 3 — the driver artifact needs >=3 for "
+                        "round-over-round comparability)")
     p.add_argument("--set-size", type=int, default=64)
     p.add_argument("--hashes", type=int, default=128)
     p.add_argument("--bands", type=int, default=16)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--extract-builds", type=int,
+                   default=int(os.environ.get("BENCH_EXTRACT_BUILDS",
+                                              1_000_000)),
+                   help="extraction-stage scale in fuzzing builds "
+                        "(0 disables)")
     p.add_argument("--ari-sample", type=int, default=100_000,
                    help="if >0, also ARI-check a host-clustered subsample "
                         "(the BASELINE.json acceptance gate: >= 0.98 vs the "
                         "CPU/pandas baseline)")
     args = p.parse_args()
+    iters = max(1, args.iters)
 
     import jax
 
@@ -41,25 +102,26 @@ def main() -> int:
     dev = jax.devices()[0]
     params = ClusterParams(n_hashes=args.hashes, n_bands=args.bands)
 
-    def run(prm):
-        labels = cluster_sessions(items, prm)
-        return labels
+    def timed(prm):
+        runs = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            labels = cluster_sessions(items, prm)
+            runs.append(time.perf_counter() - t0)
+        return labels, runs
 
     try:
-        run(params)  # compile + warm
-        t0 = time.perf_counter()
-        labels = run(params)
-        wall = time.perf_counter() - t0
+        cluster_sessions(items, params)  # compile + warm
+        labels, runs = timed(params)
     except Exception as e:  # pallas path unavailable on this backend
         print(f"# pallas path failed ({type(e).__name__}: {e}); "
               "falling back to fused-jax", file=sys.stderr)
         params = ClusterParams(n_hashes=args.hashes, n_bands=args.bands,
                                use_pallas="never")
-        run(params)
-        t0 = time.perf_counter()
-        labels = run(params)
-        wall = time.perf_counter() - t0
+        cluster_sessions(items, params)
+        labels, runs = timed(params)
 
+    wall = statistics.median(runs)
     ari = adjusted_rand_index(labels, truth)
     ari_host = None
     if args.ari_sample > 0:
@@ -79,6 +141,8 @@ def main() -> int:
         "value": round(wall, 4),
         "unit": "s",
         "vs_baseline": round(60.0 / wall, 2),
+        "best_s": round(min(runs), 4),
+        "runs_s": [round(r, 4) for r in runs],
         "ari_vs_planted": round(ari, 5),
         "n_sessions": args.n,
         "n_hashes": args.hashes,
@@ -88,6 +152,8 @@ def main() -> int:
     }
     if ari_host is not None:
         result["ari_vs_host_sample"] = ari_host
+    if args.extract_builds > 0:
+        result.update(bench_extraction(args.extract_builds, seed=args.seed))
     print(json.dumps(result))
     return 0
 

@@ -1,0 +1,164 @@
+"""Golden-format regression vs the reference's committed artifacts.
+
+The reference ships its full-run outputs under ``data/result_data/**``
+(SURVEY.md §4(2)) — those CSVs are the format contract a drop-in rebuild
+must honor.  Each test runs the repo's writer on the synthetic study and
+asserts the emitted header, column order, and value formats are identical
+to the same-named reference artifact, so any writer drift fails CI.
+
+Goldens covered (everything CSV the snapshot retains — four files are
+stripped, ``/root/reference/.MISSING_LARGE_BLOBS:1-5``):
+
+- rq1/rq1_detection_rate_stats.csv        (int,int,int rows)
+- rq3/change_analysis/<project>.csv       (per-project change schema)
+- rq3/detected_coverage_changes.csv       (float,int,int rows)
+- rq4/bug/rq4_g1_g2_detection_trend.csv   (iteration + per-group rates)
+- rq4/bug/rq4_gc_introduction_iteration.csv
+"""
+
+import csv
+import os
+import re
+
+import pytest
+
+from tse1m_tpu.analysis.rq1 import run_rq1
+from tse1m_tpu.analysis.rq2_changepoints import run_rq2_changepoints
+from tse1m_tpu.analysis.rq3 import run_rq3
+from tse1m_tpu.analysis.rq4a import run_rq4a
+from tse1m_tpu.config import Config
+
+REF = "/root/reference/data/result_data"
+LIMIT = "2026-01-01"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(REF), reason="reference snapshot not available")
+
+TS_RE = re.compile(r"^\d{4}-\d{2}-\d{2} \d{2}:\d{2}:\d{2}(\.\d+)?$")
+PG_ARRAY_RE = re.compile(r"^\{[^{}]*\}$")
+INT_RE = re.compile(r"^-?\d+$")
+FLOAT_RE = re.compile(r"^-?\d+(\.\d+)?([eE]-?\d+)?$")
+
+
+def read_csv(path):
+    with open(path, newline="") as f:
+        rows = list(csv.reader(f))
+    return rows[0], rows[1:]
+
+
+@pytest.fixture(scope="module")
+def artifacts(study_db, synth_study, tmp_path_factory):
+    """Run every writer once against the synth study."""
+    out = tmp_path_factory.mktemp("golden")
+    corpus = out / "project_corpus_analysis.csv"
+    synth_study.corpus_analysis.to_csv(corpus, index=False)
+    cfg = Config(engine="sqlite", sqlite_path=study_db.config.sqlite_path,
+                 limit_date=LIMIT, backend="jax_tpu",
+                 result_dir=str(out), corpus_csv=str(corpus))
+    cfg.min_projects_per_iteration = 2
+    run_rq1(cfg, db=study_db)
+    run_rq2_changepoints(cfg, db=study_db)
+    run_rq3(cfg, db=study_db)
+    run_rq4a(cfg, db=study_db)
+    return str(out)
+
+
+def assert_row_formats(rows, patterns, label):
+    assert rows, f"{label}: writer emitted no data rows"
+    for row in rows[:50]:
+        assert len(row) == len(patterns), f"{label}: width {len(row)}"
+        for val, pat in zip(row, patterns):
+            if pat is not None:
+                assert pat.match(val), f"{label}: {val!r} !~ {pat.pattern}"
+
+
+def formats_of(path, patterns, limit=50):
+    """Assert the reference's own rows match `patterns` too — guards the
+    test itself against drifting from the artifact it encodes."""
+    _, rows = read_csv(path)
+    for row in rows[:limit]:
+        for val, pat in zip(row, patterns):
+            if pat is not None:
+                assert pat.match(val), f"reference {path}: {val!r}"
+
+
+def test_rq1_stats_format(artifacts):
+    ref_header, ref_rows = read_csv(f"{REF}/rq1/rq1_detection_rate_stats.csv")
+    got_header, got_rows = read_csv(
+        os.path.join(artifacts, "rq1", "rq1_detection_rate_stats.csv"))
+    assert got_header == ref_header == [
+        "Iteration", "Total_Projects", "Detected_Projects_Count"]
+    assert ref_rows[0] == ["1", "878", "297"]  # SURVEY §4(2) anchor
+    pats = [INT_RE, INT_RE, INT_RE]
+    formats_of(f"{REF}/rq1/rq1_detection_rate_stats.csv", pats)
+    assert_row_formats(got_rows, pats, "rq1 stats")
+    # Iterations ascend from 1 in both.
+    assert [r[0] for r in got_rows[:3]] == ["1", "2", "3"]
+
+
+def test_rq3_change_analysis_per_project_format(artifacts):
+    ref_path = f"{REF}/rq3/change_analysis/abseil-cpp.csv"
+    ref_header, _ = read_csv(ref_path)
+    change_dir = os.path.join(artifacts, "rq3", "change_analysis")
+    ours = sorted(os.listdir(change_dir))
+    assert ours, "no per-project change CSVs emitted"
+    got_header, got_rows = read_csv(os.path.join(change_dir, ours[0]))
+    assert got_header == ref_header
+    # project, ts, {mods}, {revs}, ts, {mods}, {revs}, 4x float, int-or-float
+    pats = [None, TS_RE, PG_ARRAY_RE, PG_ARRAY_RE, TS_RE, PG_ARRAY_RE,
+            PG_ARRAY_RE, FLOAT_RE, FLOAT_RE, FLOAT_RE, FLOAT_RE,
+            FLOAT_RE, FLOAT_RE]
+    formats_of(ref_path, pats)
+    assert_row_formats(got_rows, pats, "rq3 change_analysis")
+
+
+def test_rq3_merged_change_analysis_format(artifacts):
+    ref_header, _ = read_csv(f"{REF}/rq3/change_analysis/abseil-cpp.csv")
+    got_header, got_rows = read_csv(
+        os.path.join(artifacts, "rq3", "all_coverage_change_analysis.csv"))
+    # The merged file shares the per-project schema (rq2:222-238).
+    assert got_header == ref_header
+    assert got_rows
+
+
+def test_rq3_detected_changes_format(artifacts):
+    ref_path = f"{REF}/rq3/detected_coverage_changes.csv"
+    ref_header, _ = read_csv(ref_path)
+    got_header, got_rows = read_csv(
+        os.path.join(artifacts, "rq3", "detected_coverage_changes.csv"))
+    assert got_header == ref_header == [
+        "CoverageChangePercent", "CoveredLinesChange", "TotalLinesChange"]
+    pats = [FLOAT_RE, INT_RE, INT_RE]
+    formats_of(ref_path, pats)
+    assert_row_formats(got_rows, pats, "rq3 detected")
+
+
+def test_rq4a_trend_format(artifacts):
+    ref_path = f"{REF}/rq4/bug/rq4_g1_g2_detection_trend.csv"
+    ref_header, ref_rows = read_csv(ref_path)
+    got_header, got_rows = read_csv(
+        os.path.join(artifacts, "rq4", "bug",
+                     "rq4_g1_g2_detection_trend.csv"))
+    assert got_header == ref_header == [
+        "Iteration", "G1_Total_Projects", "G1_Detected_Count",
+        "G1_Detection_Rate_pct", "G2_Total_Projects", "G2_Detected_Count",
+        "G2_Detection_Rate_pct"]
+    pats = [INT_RE, INT_RE, INT_RE, FLOAT_RE, INT_RE, INT_RE, FLOAT_RE]
+    formats_of(ref_path, pats)
+    assert_row_formats(got_rows, pats, "rq4a trend")
+    # Rates are full-precision repr floats in the reference (e.g.
+    # 33.33333333333333) — ours must not round/format-truncate.
+    assert any("." in r[3] and len(r[3].split(".")[1]) > 6
+               for r in ref_rows[:5])
+
+
+def test_rq4a_introduction_iteration_format(artifacts):
+    ref_path = f"{REF}/rq4/bug/rq4_gc_introduction_iteration.csv"
+    ref_header, _ = read_csv(ref_path)
+    got_header, got_rows = read_csv(
+        os.path.join(artifacts, "rq4", "bug",
+                     "rq4_gc_introduction_iteration.csv"))
+    assert got_header == ref_header == ["Project", "Introduction_Iteration"]
+    pats = [None, INT_RE]
+    formats_of(ref_path, pats)
+    assert_row_formats(got_rows, pats, "rq4a introduction")

@@ -18,7 +18,7 @@ import numpy as np
 
 from .common import StudyContext, fmt_ts_ns, limit_date_ns
 from ..config import Config
-from ..db.ingest import pg_array_literal
+from ..db.ingest import parse_array, pg_array_literal
 from ..utils.logging import get_logger
 from ..utils.manifest import RunManifest
 from ..utils.timing import PhaseTimer
@@ -38,8 +38,10 @@ def change_rows(ctx: StudyContext, result) -> dict[str, list[list]]:
     """Per-project lists of CSV rows in reference column order."""
     covb = ctx.arrays.covb
     t = covb.columns["time_ns"]
-    mods = covb.columns["modules"]
-    revs = covb.columns["revisions"]
+    # Raw DB text, parsed per boundary row only — the change set is tiny
+    # next to the full coverage-build table (from_db keeps columns raw).
+    mods_raw = covb.columns["modules_raw"]
+    revs_raw = covb.columns["revisions_raw"]
     diff_total = result.diff_total_line
     diff_cov = result.diff_coverage
     per_project: dict[str, list[list]] = {}
@@ -49,11 +51,11 @@ def change_rows(ctx: StudyContext, result) -> dict[str, list[list]]:
         row = [
             ctx.projects[p],
             fmt_ts_ns(int(t[e])),
-            pg_array_literal(mods[e]),
-            pg_array_literal(revs[e]),
+            pg_array_literal(parse_array(mods_raw[e])),
+            pg_array_literal(parse_array(revs_raw[e])),
             fmt_ts_ns(int(t[s1])),
-            pg_array_literal(mods[s1]),
-            pg_array_literal(revs[s1]),
+            pg_array_literal(parse_array(mods_raw[s1])),
+            pg_array_literal(parse_array(revs_raw[s1])),
             result.covered_i[k], result.total_i[k],
             result.covered_ip1[k], result.total_ip1[k],
             diff_total[k], diff_cov[k],

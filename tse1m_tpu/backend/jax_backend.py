@@ -260,7 +260,7 @@ class JaxBackend(Backend):
                 rev_eq = np.zeros(n_issues, dtype=bool)
                 ci = np.flatnonzero(cand)
                 rev_eq[ci] = (arrays.fuzz_revhash_at(f_pos[k_glob[ci]])
-                              == arrays.covb.columns["revhash"][c_pos[m_glob[ci]]])
+                              == arrays.covb_revhash_at(c_pos[m_glob[ci]]))
                 cand &= rev_eq
             i_glob = np.where(cand, v_off[issue_seg] + pos_d, 0)
             in_seg = pos_d < np.diff(v_off)[issue_seg]

@@ -166,7 +166,6 @@ class PandasBackend(Backend):
         fuzz_ok = arrays.fuzz.columns["ok"]
         covb_t = arrays.covb.columns["time_ns"]
         covb_ok = arrays.covb.columns["ok"]
-        covb_rev = arrays.covb.columns["revhash"]
         issue_t = arrays.issues.columns["time_ns"]
         cutoff_plus1 = limit_date_ns + DAY_NS
 
@@ -200,7 +199,8 @@ class PandasBackend(Backend):
                     continue  # rq3:273-274
                 if ctimes[m] - ftimes[k] > 24 * HOUR_NS:
                     continue  # rq3:277
-                if arrays.fuzz_revhash_at([fsel[k]])[0] != covb_rev[csel[m]]:
+                if (arrays.fuzz_revhash_at([fsel[k]])[0]
+                        != arrays.covb_revhash_at([csel[m]])[0]):
                     continue  # rq3:280
                 target = floor_day_ns(rts) + DAY_NS
                 i = int(np.searchsorted(days, target, side="left"))

@@ -33,7 +33,19 @@ STUDY_EPOCH = np.datetime64("2015-01-01T00:00:00", "ns")
 
 
 def to_epoch_ns(values) -> np.ndarray:
-    return pd.to_datetime(list(values), format="mixed").values.astype("datetime64[ns]").astype(np.int64)
+    """Vectorised timestamp decode.  The ISO8601 fast path covers sqlite's
+    text timestamps and synth CSVs in one C pass; `mixed` (per-element
+    format inference) is only the fallback for heterogeneous or
+    driver-native datetime rows (e.g. psycopg2)."""
+    ser = values if isinstance(values, pd.Series) else pd.Series(
+        list(values), dtype=object)
+    if ser.empty:
+        return np.empty(0, np.int64)
+    try:
+        ts = pd.to_datetime(ser, format="ISO8601")
+    except (ValueError, TypeError):
+        ts = pd.to_datetime(ser, format="mixed")
+    return ts.to_numpy().astype("datetime64[ns]").astype(np.int64)
 
 
 def ns_to_device_s(ns: np.ndarray) -> np.ndarray:
@@ -51,23 +63,36 @@ def ns_to_device_pair(ns: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
 def rev_hash(revisions: list[str]) -> np.int64:
     """Deterministic 63-bit hash of a revision list — set equality in RQ3
     (reference compares sets, rq3_diff_coverage_at_detection.py:280) becomes
-    an integer comparison precomputed at extraction."""
+    an integer comparison.  Computed lazily over the issue-linked subset
+    only (see `StudyArrays.fuzz_revhash_at` / `covb_revhash_at`); at the
+    reference's 1.19M-build scale an eager per-row hash would dominate the
+    extraction stage."""
     digest = hashlib.blake2b(
         ("\x1f".join(sorted(revisions))).encode(), digest_size=8
     ).digest()
     return np.int64(int.from_bytes(digest, "little") >> 1)
 
 
-def group_hash(modules_raw, revisions_raw) -> np.int64:
-    """63-bit hash of the exact (modules, revisions) string combination —
-    the RQ2 change-point group key (the reference concatenates the two
-    column strings, rq2_coverage_and_added.py:129); consecutive-equality
-    checks become integer compares."""
-    digest = hashlib.blake2b(
-        (str(modules_raw) + "\x1e" + str(revisions_raw)).encode(),
-        digest_size=8,
-    ).digest()
-    return np.int64(int.from_bytes(digest, "little") >> 1)
+def _revhash_at(raw: np.ndarray, idx, memo: dict | None = None) -> np.ndarray:
+    """rev_hash of `parse_array(raw[i])` for each i in idx, deduplicated
+    through np.unique; `memo` (row index -> hash) persists the work across
+    calls — the pandas RQ3 loop asks one row at a time, so without it the
+    same coverage build would re-parse for every issue that reaches the
+    revision-equality check."""
+    idx = np.asarray(idx, dtype=np.int64)
+    if not idx.size:
+        return np.empty(0, np.int64)
+    uniq, inv = np.unique(idx, return_inverse=True)
+    if memo is None:
+        memo = {}
+    hashes = np.empty(uniq.size, dtype=np.int64)
+    for k, i in enumerate(uniq):
+        key = int(i)
+        h = memo.get(key)
+        if h is None:
+            h = memo[key] = rev_hash(parse_array(raw[key]))
+        hashes[k] = h
+    return hashes[inv]
 
 
 def _offsets_from_sorted_codes(codes: np.ndarray, n_segments: int) -> np.ndarray:
@@ -96,9 +121,14 @@ class Segmented:
 @dataclass
 class StudyArrays:
     projects: list[str]
-    fuzz: Segmented       # columns: time_ns, name
-    covb: Segmented       # columns: time_ns, revhash, name, modules, revisions
-    issues: Segmented     # columns: time_ns, number, crash_type, status
+    # fuzz/covb keep modules/revisions as raw DB text — parsed and hashed
+    # lazily over the small subsets that need them (fuzz_revhash_at /
+    # covb_revhash_at, artifact writers).
+    fuzz: Segmented       # columns: time_ns, name, result, ok,
+    #                                modules_raw, revisions_raw
+    covb: Segmented       # columns: time_ns, name, result, ok,
+    #                                modules_raw, revisions_raw, grouphash
+    issues: Segmented     # columns: time_ns, number, status, crash_type
     cov: Segmented        # columns: date_ns, coverage, covered, total
 
     @property
@@ -118,69 +148,84 @@ class StudyArrays:
         projects = sorted(projects)
         log.info("extracting %d eligible projects", len(projects))
         pidx = {p: i for i, p in enumerate(projects)}
-
-        def order_rows(rows):
-            """SQL ORDER BY project uses the engine's collation, which may
-            disagree with Python's code-point sort (e.g. glibc locale
-            collations ignore '-' at primary weight) — re-sort stably by our
-            project codes so CSR offsets are always correct; within-project
-            time order from SQL is preserved by the stable sort."""
-            if not rows:
-                return rows, np.empty(0, dtype=np.int64)
-            codes = np.array([pidx[r[0]] for r in rows], dtype=np.int64)
-            order = np.argsort(codes, kind="stable")
-            return [rows[i] for i in order], codes[order]
-
-        # Fuzzing builds (bulk; replaces ALL_FUZZING_BUILD per-project loop).
-        sql, params = queries.all_fuzzing_builds_bulk(projects)
-        rows, fcodes = order_rows(db.query(sql, params))
         from ..config import RESULT_OK
 
+        def fetch(query, cols):
+            """One bulk query -> DataFrame sorted by our project codes.
+
+            Everything from here is column-wise (C loops in pandas/numpy) —
+            no per-row Python at the 1.19M-build scale.  The stable re-sort
+            exists because SQL ORDER BY project uses the engine's collation,
+            which may disagree with Python's code-point sort (e.g. glibc
+            locale collations ignore '-' at primary weight); within-project
+            time order from SQL is preserved by the stable sort."""
+            sql, params = query
+            rows = db.query(sql, params)
+            df = pd.DataFrame(rows, columns=cols, dtype=object)
+            if not len(df):
+                return df, np.empty(0, dtype=np.int64)
+            codes = df[cols[0]].map(pidx).to_numpy(dtype=np.int64)
+            order = np.argsort(codes, kind="stable")
+            return df.take(order), codes[order]
+
+        # Fuzzing builds (bulk; replaces ALL_FUZZING_BUILD per-project loop).
+        fdf, fcodes = fetch(queries.all_fuzzing_builds_bulk(projects),
+                            ["project", "name", "timecreated", "result",
+                             "modules", "revisions"])
         fuzz = Segmented(
             offsets=_offsets_from_sorted_codes(fcodes, len(projects)),
             columns={
-                "time_ns": to_epoch_ns([r[2] for r in rows]) if rows else np.empty(0, np.int64),
-                "name": np.array([r[1] for r in rows], dtype=object),
-                "result": np.array([r[3] for r in rows], dtype=object),
-                "ok": np.array([r[3] in RESULT_OK for r in rows], dtype=bool),
-                # Raw DB values; only the small linked subset is ever parsed
-                # (at artifact-write time) — avoid eagerly parsing ~1M rows.
-                "modules_raw": np.array([r[4] for r in rows], dtype=object),
-                "revisions_raw": np.array([r[5] for r in rows], dtype=object),
+                "time_ns": to_epoch_ns(fdf["timecreated"]),
+                "name": fdf["name"].to_numpy(dtype=object),
+                "result": fdf["result"].to_numpy(dtype=object),
+                "ok": fdf["result"].isin(RESULT_OK).to_numpy(dtype=bool),
+                # Raw DB values; only the small issue-linked subset is ever
+                # parsed/hashed (fuzz_revhash_at, artifact writers).
+                "modules_raw": fdf["modules"].to_numpy(dtype=object),
+                "revisions_raw": fdf["revisions"].to_numpy(dtype=object),
             },
         )
 
-        # Coverage builds (all results) with precomputed revision-set hashes.
-        sql, params = queries.coverage_builds_bulk(projects)
-        rows, ccodes = order_rows(db.query(sql, params))
-        revs = [parse_array(r[4]) for r in rows]
+        # Coverage builds (all results).  The RQ2 group key — equality of
+        # the exact (modules, revisions) string pair, the reference's
+        # shift/cumsum key rq2_coverage_and_added.py:129 — is a factorize
+        # over the concatenated raw columns: one C pass, and integer code
+        # equality IS string equality (no hash collisions at all).
+        cdf, ccodes = fetch(queries.coverage_builds_bulk(projects),
+                            ["project", "name", "timecreated", "modules",
+                             "revisions", "result"])
+        if len(cdf):
+            gkey = cdf["modules"].astype(str).str.cat(
+                cdf["revisions"].astype(str), sep="\x1e")
+            ghash = pd.factorize(gkey, use_na_sentinel=False)[0].astype(np.int64)
+        else:
+            ghash = np.empty(0, np.int64)
         covb = Segmented(
             offsets=_offsets_from_sorted_codes(ccodes, len(projects)),
             columns={
-                "time_ns": to_epoch_ns([r[2] for r in rows]) if rows else np.empty(0, np.int64),
-                "name": np.array([r[1] for r in rows], dtype=object),
-                "modules": np.array([parse_array(r[3]) for r in rows], dtype=object),
-                "revisions": np.array(revs, dtype=object),
-                "result": np.array([r[5] for r in rows], dtype=object),
-                "ok": np.array([r[5] in RESULT_OK for r in rows], dtype=bool),
-                "revhash": np.array([rev_hash(r) for r in revs], dtype=np.int64)
-                if rows else np.empty(0, np.int64),
-                "grouphash": np.array([group_hash(r[3], r[4]) for r in rows],
-                                      dtype=np.int64)
-                if rows else np.empty(0, np.int64),
+                "time_ns": to_epoch_ns(cdf["timecreated"]),
+                "name": cdf["name"].to_numpy(dtype=object),
+                "result": cdf["result"].to_numpy(dtype=object),
+                "ok": cdf["result"].isin(RESULT_OK).to_numpy(dtype=bool),
+                # Raw, like fuzz: RQ3 hashes only detection candidates
+                # (covb_revhash_at); RQ2 artifacts parse only boundary rows.
+                "modules_raw": cdf["modules"].to_numpy(dtype=object),
+                "revisions_raw": cdf["revisions"].to_numpy(dtype=object),
+                "grouphash": ghash,
             },
         )
 
         # Fixed issues before the cutoff.
-        sql, params = queries.issues_bulk(projects, cfg.limit_date, fixed_only=True)
-        rows, icodes = order_rows(db.query(sql, params))
+        idf, icodes = fetch(
+            queries.issues_bulk(projects, cfg.limit_date, fixed_only=True),
+            ["project", "number", "rts", "status", "crash_type", "severity"])
         issues = Segmented(
             offsets=_offsets_from_sorted_codes(icodes, len(projects)),
             columns={
-                "time_ns": to_epoch_ns([r[2] for r in rows]) if rows else np.empty(0, np.int64),
-                "number": np.array([r[1] for r in rows], dtype=object),
-                "status": np.array([r[3] for r in rows], dtype=object),
-                "crash_type": np.array([r[4] for r in rows], dtype=object),
+                "time_ns": to_epoch_ns(idf["rts"]),
+                "number": idf["number"].to_numpy(dtype=object),
+                "status": idf["status"].to_numpy(dtype=object),
+                "crash_type": idf["crash_type"].to_numpy(dtype=object),
             },
         )
 
@@ -188,18 +233,23 @@ class StudyArrays:
         # boundary day (rq3:263 fetches DATE(date) < limit + 1); every other
         # consumer masks date_ns < limit back down to the study cutoff.
         plus1 = str(np.datetime64(cfg.limit_date) + np.timedelta64(1, "D"))
-        sql, params = queries.total_coverage_bulk(projects, plus1)
-        rows, vcodes = order_rows(db.query(sql, params))
+        vdf, vcodes = fetch(queries.total_coverage_bulk(projects, plus1),
+                            ["project", "date", "coverage", "covered",
+                             "total"])
+
+        def fnum(col):
+            # .astype (not to_numeric(errors="coerce")): None -> NaN but a
+            # malformed value still raises, so ingest corruption fails
+            # loudly instead of leaking NaNs into the RQ results.
+            return vdf[col].astype(np.float64).to_numpy()
+
         cov = Segmented(
             offsets=_offsets_from_sorted_codes(vcodes, len(projects)),
             columns={
-                "date_ns": to_epoch_ns([r[1] for r in rows]) if rows else np.empty(0, np.int64),
-                "coverage": np.array([r[2] if r[2] is not None else np.nan
-                                      for r in rows], dtype=np.float64),
-                "covered": np.array([r[3] if r[3] is not None else np.nan for r in rows],
-                                    dtype=np.float64),
-                "total": np.array([r[4] if r[4] is not None else np.nan for r in rows],
-                                  dtype=np.float64),
+                "date_ns": to_epoch_ns(vdf["date"]),
+                "coverage": fnum("coverage"),
+                "covered": fnum("covered"),
+                "total": fnum("total"),
             },
         )
 
@@ -210,16 +260,24 @@ class StudyArrays:
     def fuzz_revhash_at(self, idx: np.ndarray) -> np.ndarray:
         """Revision-set hashes for the given fuzz-row indices.
 
-        Fuzz revisions are kept raw (columnar comment above); RQ3 compares
+        Fuzz revisions are kept raw (from_db comment); RQ3 compares
         revision sets only for the handful of issue-linked builds
         (rq3_diff_coverage_at_detection.py:280), so hashing on demand over
-        the gathered rows avoids a ~1M-row parse at extraction."""
-        idx = np.asarray(idx, dtype=np.int64)
-        raw = self.fuzz.columns["revisions_raw"]
-        uniq, inv = np.unique(idx, return_inverse=True)
-        hashes = np.array([rev_hash(parse_array(raw[i])) for i in uniq],
-                          dtype=np.int64)
-        return hashes[inv] if idx.size else np.empty(0, np.int64)
+        the gathered rows avoids a ~1M-row parse at extraction.  Results
+        are memoized per row index."""
+        if not hasattr(self, "_fuzz_revhash_memo"):
+            self._fuzz_revhash_memo: dict = {}
+        return _revhash_at(self.fuzz.columns["revisions_raw"], idx,
+                           self._fuzz_revhash_memo)
+
+    def covb_revhash_at(self, idx: np.ndarray) -> np.ndarray:
+        """Revision-set hashes for the given coverage-build rows — the
+        other side of RQ3's set-equality check, same lazy/memoized contract
+        as `fuzz_revhash_at`."""
+        if not hasattr(self, "_covb_revhash_memo"):
+            self._covb_revhash_memo: dict = {}
+        return _revhash_at(self.covb.columns["revisions_raw"], idx,
+                           self._covb_revhash_memo)
 
     # -- device views ------------------------------------------------------
 
