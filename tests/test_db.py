@@ -95,3 +95,131 @@ def test_device_seconds_strictness():
     ns = np.array([1_700_000_000_000_000_000, 1_700_000_003_000_000_000])
     s = ns_to_device_s(ns)
     assert s[1] > s[0]
+
+
+LIMIT = "2026-01-01"
+
+
+def _eligible(study_db):
+    sql, params = queries.eligible_projects(365, LIMIT)
+    return sorted(r[0] for r in study_db.query(sql, params))
+
+
+def test_per_project_fuzzing_builders_match_bulk(study_db):
+    """The per-project reference-parity builders (ALL_FUZZING_BUILD
+    queries1.py:267, SUCCESSED_FUZZING_BUILD queries1.py:61) must agree
+    with the bulk variants the engine actually uses."""
+    from tse1m_tpu.config import RESULT_OK
+
+    targets = _eligible(study_db)
+    sql, params = queries.all_fuzzing_builds_bulk(targets)
+    bulk = study_db.query(sql, params)
+    checked = 0
+    for project in targets[:4]:
+        sql, params = queries.all_fuzzing_build(project)
+        per = study_db.query(sql, params)
+        assert per == [(r[1], r[2]) for r in bulk if r[0] == project]
+        sql, params = queries.successful_fuzzing_build(project)
+        per_ok = study_db.query(sql, params)
+        assert per_ok == [(r[1], r[2]) for r in bulk
+                          if r[0] == project and r[3] in RESULT_OK]
+        checked += len(per)
+    assert checked > 0
+
+
+def test_per_project_coverage_builders_match_bulk(study_db):
+    """GET_COVERAGE_BUILDS (queries1.py:94, the live non-shadowed variant:
+    result='Finish' only) and GET_TOTAL_COVERAGE_EACH_PROJECT
+    (queries1.py:120) vs the unfiltered bulk fetches."""
+    targets = _eligible(study_db)
+    sql, params = queries.coverage_builds_bulk(targets)
+    bulk = study_db.query(sql, params)
+    sql, params = queries.total_coverage_bulk(targets, LIMIT)
+    cov_bulk = study_db.query(sql, params)
+    for project in targets[:4]:
+        sql, params = queries.coverage_builds(project)
+        per = study_db.query(sql, params)
+        expect = [(r[1], r[0], r[2], "Coverage", r[5], r[3], r[4])
+                  for r in bulk if r[0] == project and r[5] == "Finish"]
+        assert per == expect
+        sql, params = queries.total_coverage_each_project(
+            project, "coverage", LIMIT)
+        per_cov = study_db.query(sql, params)
+        expect_cov = [(r[3], r[4]) for r in cov_bulk
+                      if r[0] == project and r[2] not in (None, 0)]
+        assert per_cov == expect_cov
+
+
+def test_total_coverage_each_project_whitelists_columns(study_db):
+    import pytest
+
+    with pytest.raises(ValueError):
+        queries.total_coverage_each_project("p", "coverage; DROP TABLE x")
+
+
+def test_count_projects_frequency(study_db):
+    sql, params = queries.count_projects()
+    freq = dict(study_db.query(sql, params))
+    oracle = dict(study_db.query(
+        "SELECT project, COUNT(*) FROM buildlog_data GROUP BY project"))
+    assert freq == oracle and freq
+
+
+def test_severity_issues_oracle(study_db, synth_study):
+    """severity_issues (queries1.py:104-118) vs a pandas re-derivation:
+    issues of that severity with a non-empty regressed_build array."""
+    targets = _eligible(study_db)
+    df = synth_study.issues
+    df = df[df["project"].isin(targets)]
+    found_any = 0
+    for severity in ("High", "Medium", "Low"):
+        sql, params = queries.severity_issues(
+            severity, targets, study_db.dialect, LIMIT)
+        rows = study_db.query(sql, params)
+        sub = df[(df["severity"] == severity)
+                 & (df["rts"] < LIMIT)
+                 & df["regressed_build"].map(
+                     lambda v: len(parse_array(v)) > 0)]
+        assert len(rows) == len(sub), severity
+        assert all(r[3] == severity for r in rows)
+        found_any += len(rows)
+    assert found_any > 0
+
+
+def test_issues_without_matching_build_oracle(study_db, synth_study):
+    """GET_ISSUES_WITHOUT_MATCHING_BUILD (queries1.py:280-314; consumed by
+    run_rq1's diagnostic, reference rq1:161-163) vs a pandas re-derivation
+    of the NOT EXISTS predicate."""
+    import pandas as pd
+
+    from tse1m_tpu.config import FIXED_STATUSES, RESULT_OK
+
+    targets = _eligible(study_db)
+    sql, params = queries.issues_without_matching_build(targets, LIMIT)
+    rows = study_db.query(sql, params)
+
+    builds = synth_study.buildlog_data
+    builds = builds[(builds["build_type"] == "Fuzzing")
+                    & builds["result"].isin(RESULT_OK)
+                    & (builds["timecreated"] < LIMIT)]
+    by_proj = {p: sorted(g["timecreated"]) for p, g in
+               builds.groupby("project")}
+    issues = synth_study.issues
+    issues = issues[issues["project"].isin(targets)
+                    & issues["status"].isin(FIXED_STATUSES)]
+    expect = set()
+    for _, row in issues.iterrows():
+        blds = by_proj.get(row["project"], [])
+        if not any(bt < row["rts"] for bt in blds):
+            expect.add((row["project"], str(row["number"])))
+    assert {(r[0], str(r[1])) for r in rows} == expect
+
+
+def test_cli_stats_smoke(study_db, capsys):
+    from tse1m_tpu.cli import main
+
+    rc = main(["stats", "--db", study_db.config.sqlite_path])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "buildlog_data" in out and "severity High" in out
+    assert "eligible" in out

@@ -1,0 +1,416 @@
+"""Offline test of SeleniumIssueClient against a fake webdriver.
+
+selenium is an optional dependency and is not installed in CI, so the test
+injects a miniature stand-in for the handful of selenium modules the
+client imports lazily, plus a small DOM tree + CSS/XPath matcher shaped
+like the tracker pages (reference selectors 5_get_issue_reports.py:59-290).
+Every code path of the client runs for real: happy-path scrape (title,
+metadata labels, person fields, events, revision links, description,
+hotlists), throttle-detect-and-retry, load failure, and the shadow-DOM
+revision table with its failed-page branch.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+import types
+
+import pytest
+
+# ---------------------------------------------------------------------------
+# Fake DOM
+# ---------------------------------------------------------------------------
+
+
+class NoSuchElementException(Exception):
+    pass
+
+
+class TimeoutException(Exception):
+    pass
+
+
+class FakeElement:
+    def __init__(self, tag, classes=(), text="", attrs=None, children=(),
+                 displayed=True, shadow=None):
+        self.tag = tag
+        self.classes = set(classes)
+        self.own_text = text
+        self.attrs = dict(attrs or {})
+        self.children = list(children)
+        self.displayed = displayed
+        self._shadow = shadow
+
+    # -- selenium surface --
+    @property
+    def text(self):
+        parts = [self.own_text] + [c.text for c in self.children]
+        return " ".join(p for p in parts if p).strip()
+
+    def get_attribute(self, name):
+        return self.attrs.get(name)
+
+    def is_displayed(self):
+        return self.displayed
+
+    @property
+    def shadow_root(self):
+        if self._shadow is None:
+            raise NoSuchElementException("no shadow root")
+        return self._shadow
+
+    def find_elements(self, by, sel):
+        return _find(self, by, sel)
+
+    def find_element(self, by, sel):
+        found = _find(self, by, sel)
+        if not found:
+            raise NoSuchElementException(f"{by}: {sel}")
+        return found[0]
+
+    # -- internals --
+    def walk(self):
+        """(node, ancestors-from-outermost) over the subtree, self excluded."""
+        stack = [(c, [self]) for c in self.children]
+        while stack:
+            node, anc = stack.pop(0)
+            yield node, anc
+            stack = [(c, anc + [node]) for c in node.children] + stack
+
+
+_SIMPLE = re.compile(r"^([a-zA-Z][\w-]*)?((?:\.[\w-]+)*)((?:\[[^\]]+\])*)$")
+_ATTR = re.compile(r'\[([\w-]+)\*="([^"]+)"\]')
+
+
+def _match_simple(el, part):
+    m = _SIMPLE.match(part)
+    if not m:
+        raise ValueError(f"unsupported selector: {part!r}")
+    tag, classes, attrs = m.groups()
+    if tag and el.tag != tag:
+        return False
+    if not {c for c in classes.split(".") if c} <= el.classes:
+        return False
+    return all(sub in (el.attrs.get(a) or "")
+               for a, sub in _ATTR.findall(attrs))
+
+
+def _css_select(root, selector):
+    out = []
+    for alt in selector.split(","):
+        parts = alt.strip().split()
+        for node, anc in root.walk():
+            if not _match_simple(node, parts[-1]):
+                continue
+            chain, need = list(anc), parts[:-1]
+            while need:
+                want = need[-1]
+                while chain and not _match_simple(chain[-1], want):
+                    chain.pop()
+                if not chain:
+                    break
+                chain.pop()
+                need.pop()
+            if not need and node not in out:
+                out.append(node)
+    return out
+
+
+def _find(root, by, sel):
+    if by == "css selector":
+        return _css_select(root, sel)
+    if by == "tag name":
+        return [n for n, _ in root.walk() if n.tag == sel]
+    if by == "xpath":
+        # Only the two contains() probes the client uses.
+        if "snackbar-content" in sel:
+            return [n for n, _ in root.walk()
+                    if "snackbar-content" in n.classes
+                    and "Request throttled" in n.text]
+        text = re.search(r"contains\(text\(\), '([^']+)'\)", sel)
+        if text:
+            return [n for n, _ in root.walk() if text.group(1) in n.own_text]
+    raise ValueError(f"unsupported locator {by}: {sel}")
+
+
+class FakeDriver:
+    def __init__(self):
+        self.routes = {}          # url -> [(final_url, root), ...]
+        self.current_url = "about:blank"
+        self.root = FakeElement("html")
+        self.navigations = []
+        self.quit_called = False
+
+    def add_route(self, url, root, final_url=None, once=False):
+        self.routes.setdefault(url, []).append(
+            (final_url or url, root, once))
+
+    def get(self, url):
+        self.navigations.append(url)
+        entries = self.routes.get(url)
+        if not entries:
+            self.current_url = url
+            self.root = FakeElement("html")
+            return
+        final_url, root, once = entries[0]
+        if once and len(entries) > 1:
+            entries.pop(0)
+        self.current_url = final_url
+        self.root = root
+
+    def find_element(self, by, sel):
+        return self.root.find_element(by, sel)
+
+    def find_elements(self, by, sel):
+        return self.root.find_elements(by, sel)
+
+    def quit(self):
+        self.quit_called = True
+
+
+# ---------------------------------------------------------------------------
+# Fake selenium package
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def fake_selenium(monkeypatch):
+    class ChromeOptions:
+        def __init__(self):
+            self.args = []
+
+        def add_argument(self, a):
+            self.args.append(a)
+
+    holder = {"driver": None}
+
+    def chrome(options=None):
+        assert options is not None and "--headless" in options.args
+        return holder["driver"]
+
+    class WebDriverWait:
+        def __init__(self, driver, timeout):
+            self.driver = driver
+
+        def until(self, cond):
+            for _ in range(5):
+                try:
+                    v = cond(self.driver)
+                    if v:
+                        return v
+                except NoSuchElementException:
+                    pass
+            raise TimeoutException()
+
+    class By:
+        CSS_SELECTOR = "css selector"
+        TAG_NAME = "tag name"
+        XPATH = "xpath"
+
+    ec = types.ModuleType("selenium.webdriver.support.expected_conditions")
+    ec.presence_of_element_located = (
+        lambda locator: lambda d: d.find_element(*locator))
+
+    mods = {}
+
+    def mod(name, **attrs):
+        m = types.ModuleType(name)
+        for k, v in attrs.items():
+            setattr(m, k, v)
+        mods[name] = m
+        return m
+
+    webdriver = mod("selenium.webdriver", ChromeOptions=ChromeOptions,
+                    Chrome=chrome)
+    mod("selenium", webdriver=webdriver)
+    mod("selenium.common")
+    mod("selenium.common.exceptions",
+        NoSuchElementException=NoSuchElementException,
+        TimeoutException=TimeoutException)
+    mod("selenium.webdriver.common")
+    mod("selenium.webdriver.common.by", By=By)
+    support = mod("selenium.webdriver.support", expected_conditions=ec)
+    mod("selenium.webdriver.support.ui", WebDriverWait=WebDriverWait)
+    mods["selenium.webdriver.support.expected_conditions"] = ec
+    support.ui = mods["selenium.webdriver.support.ui"]
+    for name, m in mods.items():
+        monkeypatch.setitem(sys.modules, name, m)
+    monkeypatch.setattr("time.sleep", lambda s: None)
+    return holder
+
+
+# ---------------------------------------------------------------------------
+# Page builders
+# ---------------------------------------------------------------------------
+
+
+def meta_field(label, value):
+    return FakeElement("b-edit-field", children=[
+        FakeElement("label", text=label),
+        FakeElement("div", classes={"bv2-metadata-field-value"}, text=value),
+    ])
+
+
+def user_field(label, people):
+    return FakeElement("b-multi-user-control", children=[
+        FakeElement("label", text=label),
+        *[FakeElement("b-person-hovercard", text=p) for p in people],
+    ])
+
+
+def event_div(text, time_iso=None, links=()):
+    children = [FakeElement("b-plain-format-unquoted-section", text=text)]
+    if time_iso:
+        children.append(FakeElement("h4", children=[
+            FakeElement("b-formatted-date-time", children=[
+                FakeElement("time", attrs={"datetime": time_iso})])]))
+    children += [FakeElement("a", attrs={"href": u}) for u in links]
+    return FakeElement("div", classes={"bv2-event"}, children=children)
+
+
+REV_URL = "https://issues.oss-fuzz.com/action/revisions?range=1700:1800"
+
+
+def loaded_issue_page():
+    return FakeElement("html", children=[
+        FakeElement("b-issue-details"),
+        FakeElement("h3", classes={"heading-m", "ng-star-inserted"},
+                    text="zlib: Heap-buffer-overflow in inflate"),
+        FakeElement("b-hotlist-chip-smart", children=[
+            FakeElement("span", classes={"name"}, children=[
+                FakeElement("a", text="OSS-Fuzz")])]),
+        FakeElement("b-formatted-date-time", children=[
+            FakeElement("time", attrs={"datetime": "2024-04-01T00:00:00Z"})]),
+        FakeElement("edit-issue-metadata", children=[
+            meta_field("Status", "Fixed"),
+            meta_field("Type", "Vulnerability"),
+            meta_field("Priority", "--"),
+            meta_field("Unknown Label", "dropped"),
+            user_field("Reporter", ["ClusterFuzz"]),
+            user_field("CC", ["a@chromium.org", "b@chromium.org"]),
+            user_field("Assignee", ["--"]),
+        ]),
+        FakeElement("issue-event-list", children=[
+            event_div("ClusterFuzz testcase 123 is verified as fixed in "
+                      f"{REV_URL}", time_iso="2024-05-01T10:00:00Z",
+                      links=[REV_URL]),
+            event_div("unrelated comment"),
+        ]),
+        FakeElement("b-issue-description",
+                    text="Detailed Report: crash in inflate"),
+    ])
+
+
+def throttled_page():
+    return FakeElement("html", children=[
+        FakeElement("div", classes={"snackbar-content"},
+                    text="Request throttled. Please try again later.")])
+
+
+# ---------------------------------------------------------------------------
+# Tests
+# ---------------------------------------------------------------------------
+
+
+def make_client(fake_selenium, **kw):
+    from tse1m_tpu.collect.issues_selenium import SeleniumIssueClient
+
+    driver = FakeDriver()
+    fake_selenium["driver"] = driver
+    kw.setdefault("page_delay", (0, 0))
+    return SeleniumIssueClient(**kw), driver
+
+
+def test_fetch_issue_happy_path(fake_selenium):
+    from tse1m_tpu.collect.issues import issue_url
+
+    client, driver = make_client(fake_selenium)
+    url = issue_url(42_000_000)
+    driver.add_route(url, loaded_issue_page(),
+                     final_url="https://issues.oss-fuzz.com/issues/42000001")
+    page = client.fetch_issue(42_000_000)
+
+    assert not page.load_error
+    assert page.final_id == "42000001"          # redirect target id
+    assert page.title == "zlib: Heap-buffer-overflow in inflate"
+    assert page.hotlists == ["OSS-Fuzz"]
+    assert page.reported_time_iso == "2024-04-01T00:00:00Z"
+    assert page.metadata == {
+        "Status": "Fixed",
+        "Type": "Vulnerability",
+        "Priority": None,                        # "--" -> None
+        "Reporter": "ClusterFuzz",
+        "CC": ["a@chromium.org", "b@chromium.org"],
+        "Assignee": None,
+    }
+    assert "Unknown Label" not in page.metadata
+    assert len(page.events) == 2
+    assert page.events[0].time_iso == "2024-05-01T10:00:00Z"
+    assert page.events[0].revision_links == [REV_URL]
+    assert page.events[1].revision_links == []
+    assert page.description.startswith("Detailed Report")
+    client.close()
+    assert driver.quit_called
+
+
+def test_fetch_issue_throttled_then_recovers(fake_selenium):
+    from tse1m_tpu.collect.issues import issue_url
+
+    client, driver = make_client(fake_selenium, throttle_wait=0.0,
+                                 max_retries=3)
+    url = issue_url(42_000_000)
+    driver.add_route(url, throttled_page(), once=True)
+    driver.add_route(url, loaded_issue_page())
+    page = client.fetch_issue(42_000_000)
+    assert not page.load_error
+    assert driver.navigations.count(url) == 2   # one throttle + one success
+
+
+def test_fetch_issue_load_failure(fake_selenium):
+    client, driver = make_client(fake_selenium, max_retries=2)
+    page = client.fetch_issue(42_000_000)       # no route: perpetual blank
+    assert page.load_error
+    assert page.final_id == "42000000"
+    assert len(driver.navigations) == 2         # honors max_retries
+
+
+def test_fetch_revisions_shadow_table(fake_selenium):
+    client, driver = make_client(fake_selenium)
+    origin = "https://issues.oss-fuzz.com/issues/42000001"
+    driver.add_route(origin, loaded_issue_page())
+    driver.get(origin)
+
+    long_a = "a" * 40
+    long_b = "b" * 40
+    shadow = FakeElement("shadow", children=[
+        FakeElement("table", children=[
+            FakeElement("tr", classes={"body"}, children=[
+                FakeElement("td", text="zlib"),
+                FakeElement("td", text=f"{long_a}:{long_b}")]),
+            FakeElement("tr", classes={"body"}, children=[
+                FakeElement("td", text="afl"),
+                FakeElement("td", text="v1.2")]),
+            FakeElement("tr", classes={"body"}, children=[
+                FakeElement("td", text="short-row")]),      # skipped
+        ])])
+    rev_page = FakeElement("html", children=[
+        FakeElement("revisions-info", shadow=shadow)])
+    driver.add_route(REV_URL, rev_page)
+
+    table = client.fetch_revisions(REV_URL)
+    assert table is not None
+    assert table.components == ["zlib", "afl"]
+    assert table.revisions == [[long_a, long_b], ["v1.2"]]  # range split
+    assert table.buildtime == ["1700", "1800"]              # from ?range=
+    assert driver.current_url == origin                      # navigated back
+
+
+def test_fetch_revisions_failed_page(fake_selenium):
+    client, driver = make_client(fake_selenium)
+    origin = "https://issues.oss-fuzz.com/issues/42000001"
+    driver.add_route(origin, loaded_issue_page())
+    driver.get(origin)
+    driver.add_route(REV_URL, FakeElement("html", children=[
+        FakeElement("div", text="Failed to get component revisions.")]))
+    assert client.fetch_revisions(REV_URL) is None

@@ -50,14 +50,7 @@ class StudyContext:
         own_db = db is None
         if own_db:
             db = DB(config=cfg).connect()
-        try:
-            db.query("SELECT 1 FROM issues LIMIT 1")
-        except Exception as e:
-            raise SystemExit(
-                f"study database not initialised ({e}). Populate it first: "
-                "`python -m tse1m_tpu.cli synth` for a synthetic study or "
-                "`python -m tse1m_tpu.cli ingest --csv-dir ...` for collector CSVs."
-            ) from e
+        db.require_study_tables()
 
         if announce:
             n_all, p_all = _issue_counts(db, cfg, fixed=False)

@@ -20,6 +20,7 @@ import numpy as np
 
 from .common import StudyContext, fmt_ts_ns, limit_date_ns
 from ..config import Config
+from ..db import queries
 from ..db.ingest import parse_array, pg_array_literal
 from ..utils.logging import get_logger
 from ..utils.manifest import RunManifest
@@ -123,6 +124,14 @@ def run_rq1(cfg: Config | None = None, db=None) -> dict:
         ctx = StudyContext.open(cfg, db=db)
     manifest = RunManifest("rq1", ctx.backend.name)
 
+    # Unlinked-issue diagnostic (reference rq1:161-163): fixed issues of
+    # eligible projects with no successful pre-cutoff fuzzing build before
+    # their report time.
+    sql, params = queries.issues_without_matching_build(
+        ctx.projects, ctx.cfg.limit_date)
+    n_unmatched = ctx.db.count(sql, params)
+    print(f"Found {n_unmatched:,} issues without matching build.")
+
     with timer.phase("detect_kernel"):
         result = ctx.backend.rq1_detection(
             ctx.arrays, limit_date_ns(ctx.cfg), ctx.min_projects)
@@ -163,6 +172,7 @@ def run_rq1(cfg: Config | None = None, db=None) -> dict:
         n_fuzz_builds=total_builds,
         n_issues=n_issues,
         n_linked=n_linked,
+        n_unmatched=n_unmatched,
         n_iterations=len(result.iterations),
         late_stage=late,
     )

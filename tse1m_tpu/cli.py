@@ -50,6 +50,42 @@ def _cmd_synth(args) -> int:
     return 0
 
 
+def _cmd_stats(args) -> int:
+    """Study inventory: table sizes, the reference's project-frequency
+    query (queries1.py:6-11), and the severity breakdown of regression-
+    tracked issues over eligible projects (queries1.py:104-118)."""
+    from .db import queries
+
+    cfg = load_config()
+    if args.db:
+        cfg.sqlite_path = args.db
+    db = DB(config=cfg).connect()
+    try:
+        db.require_study_tables()
+        for table in ("project_info", "buildlog_data", "total_coverage",
+                      "issues"):
+            n = db.query(f"SELECT COUNT(*) FROM {table}")[0][0]
+            print(f"{table:16s} {n:>12,} rows")
+        sql, params = queries.count_projects()
+        freq = db.query(sql, params)
+        print(f"projects         {len(freq):>12,} distinct "
+              f"(top: {freq[0][0]} x{freq[0][1]})" if freq else
+              "projects                    0 distinct")
+        sql, params = queries.eligible_projects(cfg.min_coverage_days,
+                                                cfg.limit_date)
+        eligible = [r[0] for r in db.query(sql, params)]
+        print(f"eligible         {len(eligible):>12,} projects "
+              f"(>= {cfg.min_coverage_days} coverage days)")
+        for severity in ("High", "Medium", "Low"):
+            sql, params = queries.severity_issues(
+                severity, eligible, db.dialect, cfg.limit_date)
+            n = db.count(sql, params)
+            print(f"severity {severity:7s} {n:>12,} regression-tracked issues")
+    finally:
+        db.closeConnection()
+    return 0
+
+
 def _cmd_ingest(args) -> int:
     from .db.ingest import ingest_csv_dir
 
@@ -228,6 +264,10 @@ def main(argv=None) -> int:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--csv-dir", default=None)
     p.set_defaults(fn=_cmd_synth)
+
+    p = sub.add_parser("stats", help="study inventory + severity breakdown")
+    p.add_argument("--db")
+    p.set_defaults(fn=_cmd_stats)
 
     p = sub.add_parser("ingest", help="load collector CSVs into the DB")
     p.add_argument("--db", default=None)

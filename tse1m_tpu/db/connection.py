@@ -125,6 +125,25 @@ class DB:
         self.cursor.execute(self._adapt(sql), tuple(params))
         return self.cursor.fetchall()
 
+    def count(self, sql: str, params: Sequence[Any] = ()) -> int:
+        """Row count of an arbitrary query without shipping its rows —
+        diagnostics at the 1.19M-row scale only need the number."""
+        (n,) = self.query(f"SELECT COUNT(*) FROM ({sql}) AS t", params)[0]
+        return int(n)
+
+    def require_study_tables(self) -> None:
+        """Fail with actionable guidance when the study schema is absent
+        (shared by StudyContext.open and the CLI)."""
+        try:
+            self.query("SELECT 1 FROM issues LIMIT 1")
+        except Exception as e:
+            raise SystemExit(
+                f"study database not initialised ({e}). Populate it first: "
+                "`python -m tse1m_tpu.cli synth` for a synthetic study or "
+                "`python -m tse1m_tpu.cli ingest --csv-dir ...` for "
+                "collector CSVs."
+            ) from e
+
     def commit(self) -> None:
         self.connection.commit()
 

@@ -126,17 +126,6 @@ def coverage_builds_bulk(targets: Sequence[str]) -> Query:
     )
 
 
-def fixed_issues(targets: Sequence[str], limit_date: str = DEFAULT_LIMIT_DATE) -> Query:
-    """Fixed issues for target projects before the study cutoff
-    (rq1_detection_rate.py:172-183)."""
-    return (
-        "SELECT project, number, rts, crash_type FROM issues "
-        f"WHERE status IN {_in(FIXED_STATUSES)} AND project IN {_in(targets)} "
-        "AND rts < ? ORDER BY project, rts, number",
-        (*FIXED_STATUSES, *targets, limit_date),
-    )
-
-
 def same_date_build_issue(targets: Sequence[str], limit_date: str = DEFAULT_LIMIT_DATE) -> Query:
     """For each fixed issue, the latest successful Fuzzing build strictly
     before its report time (window-function join, queries1.py:15-58)."""
