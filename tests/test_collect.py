@@ -371,6 +371,34 @@ def test_buildlog_analyzer_resume_and_output(tmp_path):
     assert json.loads(df[df["id"] == "b1"]["modules"].iloc[0])[0] == "Zlib"
 
 
+def test_buildlog_analyzer_threaded_matches_serial(tmp_path):
+    """workers > 1 (the 1.19M-log throughput path) must produce the exact
+    batch CSV the serial path does — order included, since resume state is
+    derived from the written ids."""
+    logs = tmp_path / "oss-fuzz-build-logs.storage.googleapis.com"
+    logs.mkdir(parents=True)
+    names = [f"b{i}" for i in range(12)]
+    for i, name in enumerate(names):
+        (logs / f"log-{name}.txt").write_text(
+            FUZZ_LOG if i % 2 else COVERAGE_LOG)
+    meta = pd.DataFrame({
+        "name": names,
+        "mediaLink": ["https://oss-fuzz-build-logs.storage.googleapis.com/"
+                      f"log-{n}.txt" for n in names],
+        "size": list(range(12)),
+        "timeCreated": ["2024-05-01T10:00:00Z"] * 12,
+    })
+    outputs = {}
+    for workers in (1, 4):
+        f = DirFetcher(str(tmp_path))
+        out = tmp_path / f"analyzed_w{workers}"
+        an = BuildLogAnalyzer(f, str(out), batch_size=100, workers=workers)
+        assert an.analyze(meta) == 12
+        (batch,) = out.glob("*.csv")
+        outputs[workers] = batch.read_text()
+    assert outputs[1] == outputs[4]
+
+
 # -- C3: project info (oss_fuzz_repo fixture lives in conftest) ---------------
 
 def test_first_commit_time(oss_fuzz_repo):

@@ -183,8 +183,12 @@ def _cmd_collect(args) -> int:
 
         meta = pd.read_csv(os.path.join(data_dir, "buildlog_metadata.csv"))
         batch_dir = os.path.join(data_dir, "buildlog_analyzed_batches")
-        an = BuildLogAnalyzer(HttpFetcher(FetchPolicy()), batch_dir,
-                              limit=args.limit)
+        # Nonzero aggregate politeness delay: with workers > 1 the fetcher's
+        # rate lock serializes request starts, so this bounds the *total*
+        # request rate against public GCS (~10 req/s), not per-worker.
+        an = BuildLogAnalyzer(HttpFetcher(FetchPolicy(politeness_delay=0.1)),
+                              batch_dir, limit=args.limit,
+                              workers=args.workers)
         an.analyze(meta)
         import glob
 

@@ -92,13 +92,20 @@ def cluster_sessions(items, params: ClusterParams | None = None,
                                   params.threshold, params.n_iters)
         return np.asarray(labels)[:n]
 
+    # Explicit H2D placement up front: the ~256MB items transfer is the
+    # dominant cost on a remote/tunneled PJRT backend, so put it on device
+    # once here rather than letting each kernel re-stage the host array.
+    # No device argument — keeps the array uncommitted so callers can still
+    # steer placement with jax.default_device.
+    items_d = jax.device_put(items)
+
     if params.use_pallas != "never":
-        sig, keys = minhash_and_keys(jnp.asarray(items), a, b, params.n_bands,
+        sig, keys = minhash_and_keys(items_d, a, b, params.n_bands,
                                      use_pallas=params.use_pallas,
                                      block_n=params.block_n)
         labels = _cluster_from_sig_jit(sig, keys, params.threshold,
                                        params.n_iters)
         return np.asarray(labels)
 
-    return np.asarray(_cluster_jax(jnp.asarray(items), a, b, params.n_bands,
+    return np.asarray(_cluster_jax(items_d, a, b, params.n_bands,
                                    params.threshold, params.n_iters))

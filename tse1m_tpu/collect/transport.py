@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 import urllib.parse
 from dataclasses import dataclass, field
@@ -84,14 +85,19 @@ class HttpFetcher:
             session = requests.Session()
         self.session = session
         self._last_request_t = 0.0
+        self._pause_lock = threading.Lock()
 
     def _politeness_pause(self) -> None:
+        # Serialized so concurrent callers (BuildLogAnalyzer workers>1)
+        # still honor the promised aggregate request rate instead of each
+        # racing past a stale _last_request_t.
         delay = self.policy.politeness_delay
-        if delay > 0:
-            elapsed = time.monotonic() - self._last_request_t
-            if elapsed < delay:
-                time.sleep(delay - elapsed)
-        self._last_request_t = time.monotonic()
+        with self._pause_lock:
+            if delay > 0:
+                elapsed = time.monotonic() - self._last_request_t
+                if elapsed < delay:
+                    time.sleep(delay - elapsed)
+            self._last_request_t = time.monotonic()
 
     def get(self, url: str, params: dict | None = None) -> Response | None:
         p = self.policy
