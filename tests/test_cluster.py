@@ -93,6 +93,39 @@ def test_pallas_interpret_matches_jax(small_sets):
     np.testing.assert_array_equal(np.asarray(keys_p), keys_j)
 
 
+def test_h2d_chunked_minhash_matches_unchunked(small_sets):
+    """The streamed (chunked-transfer) MinHash path must be bit-identical
+    to the single-put path — including a short final chunk (N chosen so
+    4 chunks don't divide evenly on block_n boundaries)."""
+    items, _ = small_sets
+    items = items[:700]
+    base = ClusterParams(use_pallas="interpret", block_n=128, h2d_chunks=1)
+    chunked = ClusterParams(use_pallas="interpret", block_n=128,
+                            h2d_chunks=4)
+    np.testing.assert_array_equal(
+        cluster_sessions(items, chunked), cluster_sessions(items, base))
+
+
+def test_packed24_transfer_roundtrip_and_parity(small_sets, monkeypatch):
+    """3-byte packed H2D transfer must reconstruct ids exactly and yield
+    the same labels as the raw uint32 path."""
+    from tse1m_tpu.cluster import pipeline
+
+    rng = np.random.default_rng(7)
+    x = rng.integers(0, 1 << 24, size=(33, 5), dtype=np.uint32)
+    np.testing.assert_array_equal(
+        np.asarray(pipeline._unpack24(pipeline._pack24_host(x))), x)
+
+    items, _ = small_sets
+    items = items[:700]
+    assert items.max() < (1 << 24)
+    prm = ClusterParams(use_pallas="interpret", block_n=128, h2d_chunks=4)
+    packed = cluster_sessions(items, prm)
+    monkeypatch.setattr(pipeline, "_PACK_LIMIT", 0)  # force raw uint32 path
+    raw = cluster_sessions(items, prm)
+    np.testing.assert_array_equal(packed, raw)
+
+
 def test_mesh_sharded_cluster_matches_single(small_sets):
     items, truth = small_sets
     devices = np.array(jax.devices()[:8]).reshape(8)
