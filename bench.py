@@ -63,6 +63,10 @@ def bench_extraction(target_builds: int, seed: int = 0) -> dict:
                                + len(arrays.issues) + len(arrays.cov)),
         "extract_wall_s": round(wall, 4),
         "extract_builds_per_s": round(n_builds / wall),
+        # Whether the C++ sqlite decoder (native/decode.cc) actually carried
+        # every timed fetch — False means the pandas fallback (~2x slower)
+        # produced extract_wall_s.
+        "extract_native": bool(getattr(arrays, "native_decode", False)),
     }
 
 

@@ -1,0 +1,173 @@
+"""Native sqlite decoder vs pandas fallback — byte-for-byte parity.
+
+The C++ decoder (tse1m_tpu/native/decode.cc) replaces the per-cell Python
+object churn of Cursor.fetchall for the 1.19M-build extraction stage
+(reference hot path rq1_detection_rate.py:192-203).  Its contract is that
+StudyArrays built through it are indistinguishable from the pandas path —
+asserted here over every table and column, plus oracle tests for the
+strict ISO8601 parser and its fall-back-on-anything-else behavior.
+"""
+
+from __future__ import annotations
+
+import os
+import sqlite3
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tse1m_tpu.config import Config
+from tse1m_tpu.data import columnar
+from tse1m_tpu.data.columnar import StudyArrays
+from tse1m_tpu.data.synth import SynthSpec, generate_study
+from tse1m_tpu.db.connection import DB
+from tse1m_tpu.native import fetch_table
+
+
+def _native_available() -> bool:
+    try:
+        from tse1m_tpu import native
+
+        return native._load() is not None
+    except Exception:
+        return False
+
+
+needs_native = pytest.mark.skipif(not _native_available(),
+                                  reason="native decoder unavailable")
+
+
+@pytest.fixture(scope="module")
+def synth_db(tmp_path_factory):
+    d = tmp_path_factory.mktemp("native_db")
+    cfg = Config(engine="sqlite", sqlite_path=str(d / "t.sqlite"),
+                 limit_date="2026-01-01")
+    db = DB(config=cfg).connect()
+    study = generate_study(SynthSpec(n_projects=6, days=400, seed=11,
+                                     ineligible_fraction=0.0))
+    study.to_db(db)
+    yield db, cfg
+    db.closeConnection()
+
+
+def _assert_arrays_equal(a: StudyArrays, b: StudyArrays):
+    assert a.projects == b.projects
+    for table in ("fuzz", "covb", "issues", "cov"):
+        sa, sb = getattr(a, table), getattr(b, table)
+        np.testing.assert_array_equal(sa.offsets, sb.offsets, err_msg=table)
+        assert sa.columns.keys() == sb.columns.keys()
+        for col, va in sa.columns.items():
+            vb = sb.columns[col]
+            assert va.dtype == vb.dtype, (table, col)
+            np.testing.assert_array_equal(va, vb, err_msg=f"{table}.{col}")
+
+
+@needs_native
+def test_from_db_native_matches_pandas(synth_db, monkeypatch):
+    db, cfg = synth_db
+    native = StudyArrays.from_db(db, cfg)
+    assert native.native_decode  # the flag bench.py reports must be honest
+    monkeypatch.setattr(columnar, "_native_db_path", lambda _db: None)
+    fallback = StudyArrays.from_db(db, cfg)
+    assert not fallback.native_decode
+    _assert_arrays_equal(native, fallback)
+
+
+@needs_native
+def test_iso_parser_matches_pandas_ns(tmp_path):
+    p = str(tmp_path / "ts.sqlite")
+    con = sqlite3.connect(p)
+    con.execute("CREATE TABLE t (ts TEXT)")
+    vals = [
+        "2023-06-01T04:12:33", "2023-06-02 23:59:59", "2020-02-29T00:00:00",
+        "1999-12-31T12:00:00.5", "2023-01-01T01:02:03.123456789",
+        "2023-01-01", "1969-07-20T20:17:40", "2038-01-19T03:14:08",
+        "2024-12-31T23:59:59.999999",
+    ]
+    con.executemany("INSERT INTO t VALUES (?)", [(v,) for v in vals])
+    con.commit()
+    con.close()
+    (got,) = fetch_table(p, "SELECT ts FROM t", (), "t", [])
+    exp = (pd.to_datetime(pd.Series(vals), format="ISO8601").to_numpy()
+           .astype("datetime64[ns]").astype(np.int64))
+    np.testing.assert_array_equal(got, exp)
+
+
+@needs_native
+@pytest.mark.parametrize("bad", [
+    "2024-01-01T00:00:00+00:00",  # timezone suffix
+    "2024-01-01T00:00:00Z",
+    "01/02/2024",                 # non-ISO
+    "2024-13-01",                 # month out of range
+    "2023-02-29T00:00:00",        # day invalid for month (non-leap year)
+    "2024-04-31",                 # day invalid for month
+    "not a date",
+])
+def test_iso_parser_rejects_rather_than_guesses(tmp_path, bad):
+    p = str(tmp_path / "bad.sqlite")
+    con = sqlite3.connect(p)
+    con.execute("CREATE TABLE t (ts TEXT)")
+    con.execute("INSERT INTO t VALUES (?)", (bad,))
+    con.commit()
+    con.close()
+    with pytest.raises(RuntimeError):
+        fetch_table(p, "SELECT ts FROM t", (), "t", [])
+
+
+@needs_native
+def test_from_db_falls_back_on_unparseable_data(synth_db, monkeypatch):
+    """A timezone-suffixed timestamp must route the whole fetch through the
+    pandas path (which handles it), not crash or mis-parse."""
+    db, cfg = synth_db
+    baseline = StudyArrays.from_db(db, cfg)
+    proj = baseline.projects[0]
+    db.execute(
+        "INSERT INTO issues (project, number, rts, status, crash_type, "
+        "severity, regressed_build, new_id, type) "
+        "VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)",
+        (proj, 999999, "2024-01-01T00:00:00+00:00", "Fixed",
+         "Heap-buffer-overflow", "High", "{}", None, "Bug"),
+    )
+    db.connection.commit()
+    try:
+        arrays = StudyArrays.from_db(db, cfg)
+        # The tz row itself is present and parsed by pandas semantics.
+        assert len(arrays.issues) == len(baseline.issues) + 1
+        monkeypatch.setattr(columnar, "_native_db_path", lambda _db: None)
+        fallback = StudyArrays.from_db(db, cfg)
+        _assert_arrays_equal(arrays, fallback)
+    finally:
+        db.execute("DELETE FROM issues WHERE number = 999999", ())
+        db.connection.commit()
+
+
+@needs_native
+def test_float_column_with_nulls(tmp_path):
+    p = str(tmp_path / "f.sqlite")
+    con = sqlite3.connect(p)
+    con.execute("CREATE TABLE t (k TEXT, v REAL)")
+    con.executemany("INSERT INTO t VALUES (?,?)",
+                    [("a", 1.5), ("a", None), ("b", 3)])
+    con.commit()
+    con.close()
+    codes, vals = fetch_table(p, "SELECT k, v FROM t", (), "pf", ["a", "b"])
+    np.testing.assert_array_equal(codes, np.array([0, 0, 1], np.int32))
+    assert vals[0] == 1.5 and np.isnan(vals[1]) and vals[2] == 3.0
+
+
+@needs_native
+def test_interned_and_object_columns(tmp_path):
+    p = str(tmp_path / "s.sqlite")
+    con = sqlite3.connect(p)
+    con.execute("CREATE TABLE t (tag TEXT, num)")
+    con.executemany("INSERT INTO t VALUES (?,?)",
+                    [("x", 1), ("y", 2.5), ("x", "txt"), (None, None)])
+    con.commit()
+    con.close()
+    tags, nums = fetch_table(p, "SELECT tag, num FROM t", (), "so", [])
+    assert tags[0] is tags[2]  # interned: one PyUnicode per distinct value
+    assert tags[3] is None
+    assert nums[0] == 1 and isinstance(nums[0], int)
+    assert nums[1] == 2.5 and isinstance(nums[1], float)
+    assert nums[2] == "txt" and nums[3] is None

@@ -16,6 +16,7 @@ builds/project over ~6 years) and int32 avoids x64-mode penalties on TPU.
 from __future__ import annotations
 
 import hashlib
+import os
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -44,7 +45,15 @@ def to_epoch_ns(values) -> np.ndarray:
     try:
         ts = pd.to_datetime(ser, format="ISO8601")
     except (ValueError, TypeError):
-        ts = pd.to_datetime(ser, format="mixed")
+        try:
+            ts = pd.to_datetime(ser, format="mixed")
+        except (ValueError, TypeError):
+            # Mixed naive/aware rows: the study's timestamps are all UTC
+            # (OSS-Fuzz GCB/issue-tracker times), so interpreting naive
+            # rows as UTC is exact, not a guess.
+            ts = pd.to_datetime(ser, format="mixed", utc=True)
+    if getattr(ts.dt, "tz", None) is not None:
+        ts = ts.dt.tz_convert("UTC").dt.tz_localize(None)
     return ts.to_numpy().astype("datetime64[ns]").astype(np.int64)
 
 
@@ -100,6 +109,18 @@ def _offsets_from_sorted_codes(codes: np.ndarray, n_segments: int) -> np.ndarray
     return np.searchsorted(codes, np.arange(n_segments + 1)).astype(np.int64)
 
 
+def _native_db_path(db: DB) -> str | None:
+    """File path for the native sqlite decoder, or None when the fast path
+    does not apply (Postgres, in-memory DBs).  The decoder opens its own
+    read-only connection, so the path must be a real on-disk database."""
+    if getattr(db, "dialect", None) != "sqlite":
+        return None
+    path = getattr(db.config, "sqlite_path", None)
+    if not path or path == ":memory:" or not os.path.exists(path):
+        return None
+    return path
+
+
 @dataclass
 class Segmented:
     """One table's per-project CSR view."""
@@ -150,39 +171,82 @@ class StudyArrays:
         pidx = {p: i for i, p in enumerate(projects)}
         from ..config import RESULT_OK
 
-        def fetch(query, cols):
-            """One bulk query -> DataFrame sorted by our project codes.
+        native_path = _native_db_path(db)
+        native_fetches = 0
 
-            Everything from here is column-wise (C loops in pandas/numpy) —
-            no per-row Python at the 1.19M-build scale.  The stable re-sort
-            exists because SQL ORDER BY project uses the engine's collation,
-            which may disagree with Python's code-point sort (e.g. glibc
-            locale collations ignore '-' at primary weight); within-project
-            time order from SQL is preserved by the stable sort."""
+        def fetch(query, cols, spec):
+            """One bulk query -> {col: array} sorted by our project codes.
+
+            ``spec`` is one char per column (see native/decode.cc): 'p'
+            project->code, 't' ISO8601 text->int64 ns, 'f' float64, 's'
+            interned text, 'u' text, 'o' as-stored.  The native sqlite
+            decoder handles the whole row loop in C++ when available; the
+            pandas fallback below produces byte-identical arrays (asserted
+            by tests/test_native_decode.py).  Everything after this is
+            column-wise — no per-row Python at the 1.19M-build scale.
+
+            The stable re-sort exists because SQL ORDER BY project uses the
+            engine's collation, which may disagree with Python's code-point
+            sort (e.g. glibc locale collations ignore '-' at primary
+            weight); within-project time order from SQL is preserved by the
+            stable sort."""
+            nonlocal native_fetches
             sql, params = query
-            rows = db.query(sql, params)
-            df = pd.DataFrame(rows, columns=cols, dtype=object)
-            if not len(df):
-                return df, np.empty(0, dtype=np.int64)
-            codes = df[cols[0]].map(pidx).to_numpy(dtype=np.int64)
+            out = None
+            if native_path is not None:
+                try:
+                    from ..native import fetch_table
+
+                    raw = fetch_table(native_path, sql, params, spec,
+                                      projects)
+                    if raw is not None:
+                        out = dict(zip(cols, raw))
+                        native_fetches += 1
+                except RuntimeError as e:
+                    # Strict native parsers reject rather than guess
+                    # (timezone suffixes, non-text timestamps, ...).
+                    log.info("native decode fell back: %s", e)
+            if out is None:
+                rows = db.query(sql, params)
+                df = pd.DataFrame(rows, columns=cols, dtype=object)
+                out = {}
+                for c, sp in zip(cols, spec):
+                    if sp == "p":
+                        out[c] = (df[c].map(pidx).to_numpy(dtype=np.int64)
+                                  if len(df) else np.empty(0, np.int64))
+                    elif sp == "t":
+                        out[c] = to_epoch_ns(df[c])
+                    elif sp == "f":
+                        out[c] = df[c].astype(np.float64).to_numpy()
+                    else:
+                        out[c] = df[c].to_numpy(dtype=object)
+            codes = out.pop(cols[0]).astype(np.int64, copy=False)
             order = np.argsort(codes, kind="stable")
-            return df.take(order), codes[order]
+            return ({c: v[order] for c, v in out.items()}, codes[order])
+
+        def ok_mask(result_col: np.ndarray) -> np.ndarray:
+            return pd.Series(result_col, dtype=object).isin(
+                RESULT_OK).to_numpy(dtype=bool)
 
         # Fuzzing builds (bulk; replaces ALL_FUZZING_BUILD per-project loop).
-        fdf, fcodes = fetch(queries.all_fuzzing_builds_bulk(projects),
+        # modules/revisions are 'u' (no interning): fuzz rows carry
+        # near-unique revision text, so an intern map would copy ~every
+        # value into its keys for no dedup (covb's repeated group keys are
+        # where 's' pays).
+        ftb, fcodes = fetch(queries.all_fuzzing_builds_bulk(projects),
                             ["project", "name", "timecreated", "result",
-                             "modules", "revisions"])
+                             "modules", "revisions"], "putsuu")
         fuzz = Segmented(
             offsets=_offsets_from_sorted_codes(fcodes, len(projects)),
             columns={
-                "time_ns": to_epoch_ns(fdf["timecreated"]),
-                "name": fdf["name"].to_numpy(dtype=object),
-                "result": fdf["result"].to_numpy(dtype=object),
-                "ok": fdf["result"].isin(RESULT_OK).to_numpy(dtype=bool),
+                "time_ns": ftb["timecreated"],
+                "name": ftb["name"],
+                "result": ftb["result"],
+                "ok": ok_mask(ftb["result"]),
                 # Raw DB values; only the small issue-linked subset is ever
                 # parsed/hashed (fuzz_revhash_at, artifact writers).
-                "modules_raw": fdf["modules"].to_numpy(dtype=object),
-                "revisions_raw": fdf["revisions"].to_numpy(dtype=object),
+                "modules_raw": ftb["modules"],
+                "revisions_raw": ftb["revisions"],
             },
         )
 
@@ -191,71 +255,75 @@ class StudyArrays:
         # shift/cumsum key rq2_coverage_and_added.py:129 — is a factorize
         # over the concatenated raw columns: one C pass, and integer code
         # equality IS string equality (no hash collisions at all).
-        cdf, ccodes = fetch(queries.coverage_builds_bulk(projects),
+        ctb, ccodes = fetch(queries.coverage_builds_bulk(projects),
                             ["project", "name", "timecreated", "modules",
-                             "revisions", "result"])
-        if len(cdf):
-            gkey = cdf["modules"].astype(str).str.cat(
-                cdf["revisions"].astype(str), sep="\x1e")
+                             "revisions", "result"], "putsss")
+        if len(ccodes):
+            gkey = pd.Series(ctb["modules"], dtype=object).astype(str).str.cat(
+                pd.Series(ctb["revisions"], dtype=object).astype(str),
+                sep="\x1e")
             ghash = pd.factorize(gkey, use_na_sentinel=False)[0].astype(np.int64)
         else:
             ghash = np.empty(0, np.int64)
         covb = Segmented(
             offsets=_offsets_from_sorted_codes(ccodes, len(projects)),
             columns={
-                "time_ns": to_epoch_ns(cdf["timecreated"]),
-                "name": cdf["name"].to_numpy(dtype=object),
-                "result": cdf["result"].to_numpy(dtype=object),
-                "ok": cdf["result"].isin(RESULT_OK).to_numpy(dtype=bool),
+                "time_ns": ctb["timecreated"],
+                "name": ctb["name"],
+                "result": ctb["result"],
+                "ok": ok_mask(ctb["result"]),
                 # Raw, like fuzz: RQ3 hashes only detection candidates
                 # (covb_revhash_at); RQ2 artifacts parse only boundary rows.
-                "modules_raw": cdf["modules"].to_numpy(dtype=object),
-                "revisions_raw": cdf["revisions"].to_numpy(dtype=object),
+                "modules_raw": ctb["modules"],
+                "revisions_raw": ctb["revisions"],
                 "grouphash": ghash,
             },
         )
 
         # Fixed issues before the cutoff.
-        idf, icodes = fetch(
+        itb, icodes = fetch(
             queries.issues_bulk(projects, cfg.limit_date, fixed_only=True),
-            ["project", "number", "rts", "status", "crash_type", "severity"])
+            ["project", "number", "rts", "status", "crash_type", "severity"],
+            "potsss")
         issues = Segmented(
             offsets=_offsets_from_sorted_codes(icodes, len(projects)),
             columns={
-                "time_ns": to_epoch_ns(idf["rts"]),
-                "number": idf["number"].to_numpy(dtype=object),
-                "status": idf["status"].to_numpy(dtype=object),
-                "crash_type": idf["crash_type"].to_numpy(dtype=object),
+                "time_ns": itb["rts"],
+                "number": itb["number"],
+                "status": itb["status"],
+                "crash_type": itb["crash_type"],
             },
         )
 
         # Daily coverage rows up to limit_date + 1 day: RQ3 reads the
         # boundary day (rq3:263 fetches DATE(date) < limit + 1); every other
         # consumer masks date_ns < limit back down to the study cutoff.
+        # 'f' decode parity note: .astype/float64 (not errors="coerce") —
+        # None -> NaN but a malformed value still raises, so ingest
+        # corruption fails loudly instead of leaking NaNs into RQ results;
+        # the native decoder types these columns REAL at the sqlite level.
         plus1 = str(np.datetime64(cfg.limit_date) + np.timedelta64(1, "D"))
-        vdf, vcodes = fetch(queries.total_coverage_bulk(projects, plus1),
+        vtb, vcodes = fetch(queries.total_coverage_bulk(projects, plus1),
                             ["project", "date", "coverage", "covered",
-                             "total"])
-
-        def fnum(col):
-            # .astype (not to_numeric(errors="coerce")): None -> NaN but a
-            # malformed value still raises, so ingest corruption fails
-            # loudly instead of leaking NaNs into the RQ results.
-            return vdf[col].astype(np.float64).to_numpy()
-
+                             "total"], "ptfff")
         cov = Segmented(
             offsets=_offsets_from_sorted_codes(vcodes, len(projects)),
             columns={
-                "date_ns": to_epoch_ns(vdf["date"]),
-                "coverage": fnum("coverage"),
-                "covered": fnum("covered"),
-                "total": fnum("total"),
+                "date_ns": vtb["date"],
+                "coverage": vtb["coverage"],
+                "covered": vtb["covered"],
+                "total": vtb["total"],
             },
         )
 
         log.info("columnar: %d fuzz builds, %d coverage builds, %d issues, %d coverage days",
                  len(fuzz), len(covb), len(issues), len(cov))
-        return cls(projects=projects, fuzz=fuzz, covb=covb, issues=issues, cov=cov)
+        arrays = cls(projects=projects, fuzz=fuzz, covb=covb, issues=issues,
+                     cov=cov)
+        # True only when every fetch actually went through the C++ decoder
+        # — consumers (bench.py) report which path produced their timings.
+        arrays.native_decode = native_fetches == 4
+        return arrays
 
     def fuzz_revhash_at(self, idx: np.ndarray) -> np.ndarray:
         """Revision-set hashes for the given fuzz-row indices.

@@ -1,0 +1,95 @@
+"""Native host-decode layer (SURVEY §2.4's C++ seat).
+
+``fetch_table()`` — when available — streams a sqlite query into typed
+numpy columns in one C++ pass (see ``decode.cc``).  The extension is
+compiled on first use with the system ``g++`` and cached next to the
+source; every failure mode (no compiler, no libsqlite3, unparseable data)
+degrades to ``None`` so callers fall back to the pandas path.  The rebuild
+therefore never *requires* native code — it is a throughput lever for the
+1.19M-build extraction stage, not a correctness dependency.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sysconfig
+import tempfile
+
+from ..utils.logging import get_logger
+
+log = get_logger("native")
+
+_SRC = os.path.join(os.path.dirname(__file__), "decode.cc")
+_SO = os.path.join(os.path.dirname(__file__), "_tse1m_decode.so")
+
+_module = None
+_tried = False
+
+
+def _compile() -> bool:
+    import numpy as np
+
+    cmd = [
+        "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+        "-I" + sysconfig.get_paths()["include"],
+        "-I" + np.get_include(),
+        _SRC,
+        "-l:libsqlite3.so.0",
+    ]
+    # Atomic replace so concurrent first-callers never import a half-written
+    # object; the temp file must live on the same filesystem for rename.
+    fd, tmp = tempfile.mkstemp(suffix=".so", dir=os.path.dirname(_SO))
+    os.close(fd)
+    try:
+        proc = subprocess.run(cmd + ["-o", tmp], capture_output=True,
+                              text=True, timeout=300)
+        if proc.returncode != 0:
+            log.info("native decode build failed (falling back to pandas "
+                     "path): %s", proc.stderr.strip().splitlines()[-1]
+                     if proc.stderr.strip() else proc.returncode)
+            return False
+        os.replace(tmp, _SO)
+        return True
+    except Exception as e:  # no g++, sandboxed exec, ...
+        log.info("native decode unavailable (%s); using pandas path", e)
+        return False
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def _load():
+    global _module, _tried
+    if _tried:
+        return _module
+    _tried = True
+    try:
+        stale = (not os.path.exists(_SO)
+                 or os.path.getmtime(_SO) < os.path.getmtime(_SRC))
+        if stale and not _compile():
+            return None
+        spec = importlib.util.spec_from_file_location("_tse1m_decode", _SO)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _module = mod
+        log.info("native sqlite decoder loaded (%s)", _SO)
+    except Exception as e:
+        log.info("native decode import failed (%s); using pandas path", e)
+        _module = None
+    return _module
+
+
+def fetch_table(db_path: str, sql: str, params, spec: str, key_values):
+    """Run ``sql`` against ``db_path`` and decode per ``spec`` (see
+    decode.cc).  Returns a tuple of numpy arrays, or None when the native
+    path is unavailable — callers must treat None as "use the fallback".
+    Raises RuntimeError for data the strict native parsers reject (e.g.
+    timezone-suffixed timestamps); callers catch and fall back.
+    """
+    mod = _load()
+    if mod is None:
+        return None
+    return mod.fetch_table(db_path, sql, tuple(params), spec,
+                           list(key_values))

@@ -1,0 +1,429 @@
+// Native bulk decoder: sqlite rows -> typed numpy columns in one C++ pass.
+//
+// This is the TPU-rebuild's answer to the reference's hot host boundary
+// (SURVEY §2.4): the reference pays the per-cell Python-object cost once
+// per row x column over ~1.19M builds (rq1_detection_rate.py:192-203 via
+// psycopg2 fetchall; our sqlite twin showed the same profile — ~60% of
+// extraction wall time inside Cursor.fetchall).  Here the sqlite3 C API
+// streams straight into preallocated C++ vectors:
+//   - ISO8601 timestamps parse to int64 epoch-nanoseconds in C (bit-parity
+//     with pandas.to_datetime(format="ISO8601") asserted in
+//     tests/test_native_decode.py; anything the strict parser cannot prove
+//     it parses identically — timezones, junk — raises, and the caller
+//     falls back to the pandas path),
+//   - repeated TEXT cells (result enums, modules/revisions arrays) intern
+//     through a hash map so each distinct value allocates ONE PyUnicode,
+//   - numerics land in numpy buffers with no intermediate tuples.
+//
+// The sqlite3 prototypes are declared inline because this image ships
+// libsqlite3.so.0 without its header; the declarations below are the
+// documented, ABI-stable public C API (sqlite.org/c3ref).
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#define NPY_NO_DEPRECATED_API NPY_1_7_API_VERSION
+#include <numpy/arrayobject.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+extern "C" {
+typedef struct sqlite3 sqlite3;
+typedef struct sqlite3_stmt sqlite3_stmt;
+int sqlite3_open_v2(const char *, sqlite3 **, int, const char *);
+int sqlite3_prepare_v2(sqlite3 *, const char *, int, sqlite3_stmt **,
+                       const char **);
+int sqlite3_bind_text(sqlite3_stmt *, int, const char *, int, void (*)(void *));
+int sqlite3_bind_int64(sqlite3_stmt *, int, long long);
+int sqlite3_bind_double(sqlite3_stmt *, int, double);
+int sqlite3_step(sqlite3_stmt *);
+int sqlite3_column_count(sqlite3_stmt *);
+int sqlite3_column_type(sqlite3_stmt *, int);
+const unsigned char *sqlite3_column_text(sqlite3_stmt *, int);
+int sqlite3_column_bytes(sqlite3_stmt *, int);
+long long sqlite3_column_int64(sqlite3_stmt *, int);
+double sqlite3_column_double(sqlite3_stmt *, int);
+int sqlite3_finalize(sqlite3_stmt *);
+int sqlite3_close(sqlite3 *);
+const char *sqlite3_errmsg(sqlite3 *);
+}
+
+#define SQLITE_OK 0
+#define SQLITE_ROW 100
+#define SQLITE_DONE 101
+#define SQLITE_OPEN_READONLY 0x01
+#define SQLITE_INTEGER 1
+#define SQLITE_FLOAT 2
+#define SQLITE_TEXT 3
+#define SQLITE_NULL 5
+#define SQLITE_TRANSIENT ((void (*)(void *))(intptr_t)-1)
+
+namespace {
+
+// ---- ISO8601 -> epoch ns ---------------------------------------------------
+
+inline bool all_digits(const char *s, int n) {
+  for (int i = 0; i < n; i++)
+    if (s[i] < '0' || s[i] > '9') return false;
+  return true;
+}
+
+inline long long to_int(const char *s, int n) {
+  long long v = 0;
+  for (int i = 0; i < n; i++) v = v * 10 + (s[i] - '0');
+  return v;
+}
+
+// Howard Hinnant's days_from_civil (public-domain algorithm).
+inline int64_t days_from_civil(int y, unsigned m, unsigned d) {
+  y -= m <= 2;
+  const int era = (y >= 0 ? y : y - 399) / 400;
+  const unsigned yoe = static_cast<unsigned>(y - era * 400);
+  const unsigned doy = (153 * (m + (m > 2 ? -3 : 9)) + 2) / 5 + d - 1;
+  const unsigned doe = yoe * 365 + yoe / 4 - yoe / 100 + doy;
+  return static_cast<int64_t>(era) * 146097 + static_cast<int64_t>(doe) -
+         719468;
+}
+
+// Strict parse of "YYYY-MM-DD", "YYYY-MM-DD[ T]HH:MM[:SS[.frac]]".
+// Returns false on anything else (timezone suffixes included) — the caller
+// then falls back to the pandas parser rather than guessing.
+bool parse_iso_ns(const char *s, int len, int64_t *out) {
+  if (len < 10) return false;
+  if (!all_digits(s, 4) || s[4] != '-' || !all_digits(s + 5, 2) ||
+      s[7] != '-' || !all_digits(s + 8, 2))
+    return false;
+  const int y = static_cast<int>(to_int(s, 4));
+  const unsigned mo = static_cast<unsigned>(to_int(s + 5, 2));
+  const unsigned d = static_cast<unsigned>(to_int(s + 8, 2));
+  if (mo < 1 || mo > 12 || d < 1) return false;
+  // Real month lengths (leap-aware): days_from_civil would silently
+  // normalize e.g. Feb 30 -> Mar 1, where pandas raises — and a raise is
+  // what routes the fetch to the fallback.
+  static const unsigned mdays[] = {31, 28, 31, 30, 31, 30,
+                                   31, 31, 30, 31, 30, 31};
+  const bool leap = (y % 4 == 0 && y % 100 != 0) || y % 400 == 0;
+  if (d > (mo == 2 && leap ? 29u : mdays[mo - 1])) return false;
+  int64_t secs = days_from_civil(y, mo, d) * 86400;
+  int64_t frac_ns = 0;
+  if (len > 10) {
+    if ((s[10] != ' ' && s[10] != 'T') || len < 16) return false;
+    if (!all_digits(s + 11, 2) || s[13] != ':' || !all_digits(s + 14, 2))
+      return false;
+    const long long hh = to_int(s + 11, 2), mi = to_int(s + 14, 2);
+    if (hh > 23 || mi > 59) return false;
+    secs += hh * 3600 + mi * 60;
+    int pos = 16;
+    if (len > 16) {
+      if (s[16] != ':' || len < 19 || !all_digits(s + 17, 2)) return false;
+      const long long ss = to_int(s + 17, 2);
+      if (ss > 59) return false;
+      secs += ss;
+      pos = 19;
+      if (len > 19) {
+        if (s[19] != '.') return false;
+        int nd = len - 20;
+        if (nd < 1 || nd > 9 || !all_digits(s + 20, nd)) return false;
+        long long f = to_int(s + 20, nd);
+        for (int i = nd; i < 9; i++) f *= 10;
+        frac_ns = f;
+        pos = len;
+      }
+    }
+    if (pos != len) return false;
+  }
+  *out = secs * 1000000000LL + frac_ns;
+  return true;
+}
+
+// ---- column accumulators ---------------------------------------------------
+
+struct Col {
+  char spec;                       // p/t/f/s/u/o
+  std::vector<int32_t> i32;        // 'p'
+  std::vector<int64_t> i64;        // 't'
+  std::vector<double> f64;         // 'f'
+  std::vector<PyObject *> obj;     // 's'/'u'/'o' (owned refs)
+  std::unordered_map<std::string, PyObject *> intern;  // 's' (borrowed into obj)
+};
+
+struct Closer {
+  sqlite3 *db = nullptr;
+  sqlite3_stmt *stmt = nullptr;
+  std::vector<Col> *cols = nullptr;
+  ~Closer() {
+    if (stmt) sqlite3_finalize(stmt);
+    if (db) sqlite3_close(db);
+    if (cols)
+      for (auto &c : *cols) {
+        for (auto *o : c.obj) Py_XDECREF(o);
+        // Error-path cleanup: each interned value still holds the map's
+        // extra ref (the success path clears intern before building the
+        // output arrays, making this a no-op there).
+        for (auto &kv : c.intern) Py_DECREF(kv.second);
+      }
+  }
+};
+
+PyObject *err(const char *msg, sqlite3 *db = nullptr) {
+  PyErr_Format(PyExc_RuntimeError, "native decode: %s%s%s", msg,
+               db ? ": " : "", db ? sqlite3_errmsg(db) : "");
+  return nullptr;
+}
+
+// fetch_table(db_path, sql, params, spec, key_values) -> tuple of arrays
+//
+// spec: one char per selected column —
+//   p  TEXT key -> int32 code via the key_values list (error if unseen)
+//   t  TEXT ISO8601 -> int64 epoch-ns
+//   f  numeric -> float64 (NULL -> NaN)
+//   s  TEXT -> object array, values interned per column
+//   u  TEXT -> object array, no interning (high-cardinality, e.g. names)
+//   o  object array preserving sqlite's native type (int/float/text/None)
+PyObject *fetch_table(PyObject *, PyObject *args) {
+  const char *db_path, *sql, *spec;
+  PyObject *params, *keys;
+  if (!PyArg_ParseTuple(args, "ssOsO", &db_path, &sql, &params, &spec, &keys))
+    return nullptr;
+  if (!PySequence_Check(params) || !PySequence_Check(keys))
+    return err("params and key_values must be sequences");
+
+  const Py_ssize_t ncol = static_cast<Py_ssize_t>(strlen(spec));
+  std::vector<Col> cols(ncol);
+  for (Py_ssize_t i = 0; i < ncol; i++) {
+    cols[i].spec = spec[i];
+    if (!strchr("ptfsuo", spec[i])) return err("unknown spec char");
+  }
+
+  std::unordered_map<std::string, int32_t> keymap;
+  {
+    PyObject *fast = PySequence_Fast(keys, "key_values");
+    if (!fast) return nullptr;
+    const Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+    for (Py_ssize_t i = 0; i < n; i++) {
+      Py_ssize_t sl;
+      const char *sp =
+          PyUnicode_AsUTF8AndSize(PySequence_Fast_GET_ITEM(fast, i), &sl);
+      if (!sp) {
+        Py_DECREF(fast);
+        return nullptr;
+      }
+      keymap.emplace(std::string(sp, sl), static_cast<int32_t>(i));
+    }
+    Py_DECREF(fast);
+  }
+
+  Closer guard;
+  guard.cols = &cols;
+  if (sqlite3_open_v2(db_path, &guard.db, SQLITE_OPEN_READONLY, nullptr) !=
+      SQLITE_OK)
+    return err("cannot open database", guard.db);
+  if (sqlite3_prepare_v2(guard.db, sql, -1, &guard.stmt, nullptr) != SQLITE_OK)
+    return err("prepare failed", guard.db);
+
+  {
+    PyObject *fast = PySequence_Fast(params, "params");
+    if (!fast) return nullptr;
+    const Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+    for (Py_ssize_t i = 0; i < n; i++) {
+      PyObject *p = PySequence_Fast_GET_ITEM(fast, i);
+      int rc;
+      if (PyUnicode_Check(p)) {
+        Py_ssize_t sl;
+        const char *sp = PyUnicode_AsUTF8AndSize(p, &sl);
+        if (!sp) {
+          Py_DECREF(fast);
+          return nullptr;
+        }
+        rc = sqlite3_bind_text(guard.stmt, static_cast<int>(i + 1), sp,
+                               static_cast<int>(sl), SQLITE_TRANSIENT);
+      } else if (PyLong_Check(p)) {
+        rc = sqlite3_bind_int64(guard.stmt, static_cast<int>(i + 1),
+                                PyLong_AsLongLong(p));
+      } else if (PyFloat_Check(p)) {
+        rc = sqlite3_bind_double(guard.stmt, static_cast<int>(i + 1),
+                                 PyFloat_AsDouble(p));
+      } else {
+        Py_DECREF(fast);
+        return err("unsupported parameter type");
+      }
+      if (rc != SQLITE_OK) {
+        Py_DECREF(fast);
+        return err("bind failed", guard.db);
+      }
+    }
+    Py_DECREF(fast);
+  }
+
+  if (sqlite3_column_count(guard.stmt) != static_cast<int>(ncol))
+    return err("spec length != selected column count");
+
+  int rc;
+  while ((rc = sqlite3_step(guard.stmt)) == SQLITE_ROW) {
+    for (Py_ssize_t i = 0; i < ncol; i++) {
+      Col &c = cols[i];
+      const int ci = static_cast<int>(i);
+      const int ty = sqlite3_column_type(guard.stmt, ci);
+      switch (c.spec) {
+        case 'p': {
+          if (ty != SQLITE_TEXT) return err("key column must be TEXT");
+          const char *sp = reinterpret_cast<const char *>(
+              sqlite3_column_text(guard.stmt, ci));
+          auto it = keymap.find(
+              std::string(sp, sqlite3_column_bytes(guard.stmt, ci)));
+          if (it == keymap.end()) return err("key value not in key_values");
+          c.i32.push_back(it->second);
+          break;
+        }
+        case 't': {
+          if (ty != SQLITE_TEXT) return err("timestamp column must be TEXT");
+          int64_t ns;
+          if (!parse_iso_ns(reinterpret_cast<const char *>(
+                                sqlite3_column_text(guard.stmt, ci)),
+                            sqlite3_column_bytes(guard.stmt, ci), &ns))
+            return err("unparseable timestamp (caller should fall back)");
+          c.i64.push_back(ns);
+          break;
+        }
+        case 'f': {
+          // TEXT is rejected rather than coerced: sqlite3_column_double
+          // turns junk text into 0.0 silently, while the pandas fallback
+          // raises on malformed numerics — falling back keeps that
+          // fail-loudly contract.
+          if (ty == SQLITE_NULL)
+            c.f64.push_back(Py_NAN);
+          else if (ty == SQLITE_INTEGER || ty == SQLITE_FLOAT)
+            c.f64.push_back(sqlite3_column_double(guard.stmt, ci));
+          else
+            return err("non-numeric cell in float column "
+                       "(caller should fall back)");
+          break;
+        }
+        case 's':
+        case 'u': {
+          if (ty == SQLITE_NULL) {
+            Py_INCREF(Py_None);
+            c.obj.push_back(Py_None);
+            break;
+          }
+          const char *sp = reinterpret_cast<const char *>(
+              sqlite3_column_text(guard.stmt, ci));
+          const int sl = sqlite3_column_bytes(guard.stmt, ci);
+          if (c.spec == 's') {
+            std::string key(sp, sl);
+            auto it = c.intern.find(key);
+            if (it != c.intern.end()) {
+              Py_INCREF(it->second);
+              c.obj.push_back(it->second);
+            } else {
+              PyObject *o = PyUnicode_DecodeUTF8(sp, sl, nullptr);
+              if (!o) return nullptr;
+              c.intern.emplace(std::move(key), o);
+              Py_INCREF(o);  // one ref held via obj, one via intern map
+              c.obj.push_back(o);
+            }
+          } else {
+            PyObject *o = PyUnicode_DecodeUTF8(sp, sl, nullptr);
+            if (!o) return nullptr;
+            c.obj.push_back(o);
+          }
+          break;
+        }
+        case 'o': {
+          PyObject *o;
+          if (ty == SQLITE_NULL) {
+            o = Py_None;
+            Py_INCREF(o);
+          } else if (ty == SQLITE_INTEGER) {
+            o = PyLong_FromLongLong(sqlite3_column_int64(guard.stmt, ci));
+          } else if (ty == SQLITE_FLOAT) {
+            o = PyFloat_FromDouble(sqlite3_column_double(guard.stmt, ci));
+          } else {
+            o = PyUnicode_DecodeUTF8(reinterpret_cast<const char *>(
+                                         sqlite3_column_text(guard.stmt, ci)),
+                                     sqlite3_column_bytes(guard.stmt, ci),
+                                     nullptr);
+          }
+          if (!o) return nullptr;
+          c.obj.push_back(o);
+          break;
+        }
+      }
+    }
+  }
+  if (rc != SQLITE_DONE) return err("step failed", guard.db);
+  // Intern maps hold one extra ref per distinct value; release those now.
+  for (auto &c : cols)
+    for (auto &kv : c.intern) Py_DECREF(kv.second);
+  for (auto &c : cols) c.intern.clear();
+
+  PyObject *out = PyTuple_New(ncol);
+  if (!out) return nullptr;
+  for (Py_ssize_t i = 0; i < ncol; i++) {
+    Col &c = cols[i];
+    npy_intp n;
+    PyObject *arr = nullptr;
+    switch (c.spec) {
+      case 'p':
+        n = static_cast<npy_intp>(c.i32.size());
+        arr = PyArray_SimpleNew(1, &n, NPY_INT32);
+        if (arr)
+          memcpy(PyArray_DATA(reinterpret_cast<PyArrayObject *>(arr)),
+                 c.i32.data(), c.i32.size() * sizeof(int32_t));
+        break;
+      case 't':
+        n = static_cast<npy_intp>(c.i64.size());
+        arr = PyArray_SimpleNew(1, &n, NPY_INT64);
+        if (arr)
+          memcpy(PyArray_DATA(reinterpret_cast<PyArrayObject *>(arr)),
+                 c.i64.data(), c.i64.size() * sizeof(int64_t));
+        break;
+      case 'f':
+        n = static_cast<npy_intp>(c.f64.size());
+        arr = PyArray_SimpleNew(1, &n, NPY_FLOAT64);
+        if (arr)
+          memcpy(PyArray_DATA(reinterpret_cast<PyArrayObject *>(arr)),
+                 c.f64.data(), c.f64.size() * sizeof(double));
+        break;
+      default: {
+        n = static_cast<npy_intp>(c.obj.size());
+        arr = PyArray_SimpleNew(1, &n, NPY_OBJECT);
+        if (arr) {
+          PyObject **data = reinterpret_cast<PyObject **>(
+              PyArray_DATA(reinterpret_cast<PyArrayObject *>(arr)));
+          // Transfer ownership of each ref into the (NULL-initialised)
+          // object array.
+          memcpy(data, c.obj.data(), c.obj.size() * sizeof(PyObject *));
+          c.obj.clear();  // refs now owned by the array
+        }
+        break;
+      }
+    }
+    if (!arr) {
+      Py_DECREF(out);
+      return nullptr;
+    }
+    PyTuple_SET_ITEM(out, i, arr);
+  }
+  return out;
+}
+
+PyMethodDef methods[] = {
+    {"fetch_table", fetch_table, METH_VARARGS,
+     "fetch_table(db_path, sql, params, spec, key_values) -> tuple of numpy "
+     "arrays"},
+    {nullptr, nullptr, 0, nullptr}};
+
+struct PyModuleDef moddef = {PyModuleDef_HEAD_INIT, "_tse1m_decode",
+                             "sqlite -> numpy bulk decoder", -1, methods,
+                             nullptr, nullptr, nullptr, nullptr};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__tse1m_decode(void) {
+  import_array();
+  return PyModule_Create(&moddef);
+}
