@@ -1,0 +1,72 @@
+"""Multi-host layer (parallel/multihost.py) in its single-process
+degenerate form — the same contract the driver's virtual-device dryrun
+exercises.  True multi-process runs can't be simulated in one pytest
+process (jax.distributed wants one controller per process), so these tests
+pin the invariants that make single- and multi-process behavior coincide:
+contiguous process row-dealing, sharded global assembly, and collective
+parity with the unsharded oracle.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tse1m_tpu.parallel import multihost
+from tse1m_tpu.parallel.mesh import detection_hist_sharded, pad_to_devices
+
+
+def test_initialize_from_env_noop_without_config(monkeypatch):
+    monkeypatch.delenv("TSE1M_COORDINATOR", raising=False)
+    monkeypatch.delenv("TSE1M_NUM_PROCESSES", raising=False)
+    assert multihost.initialize_from_env() is False
+    assert jax.process_count() == 1
+
+
+def test_local_row_range_partitions_exactly():
+    # Single-process: the full range.
+    assert multihost.local_row_range(101) == (0, 101)
+    # The dealing rule itself (what each process would compute): contiguous,
+    # disjoint, covering, remainder on the last process.
+    for n_rows, nproc in [(101, 4), (8, 8), (5, 8), (0, 3), (1000, 7)]:
+        per = -(-n_rows // nproc) if n_rows else 0
+        spans = []
+        for pid in range(nproc):
+            start = min(pid * per, n_rows)
+            spans.append((start, min(start + per, n_rows)))
+        assert spans[0][0] == 0
+        assert spans[-1][1] == n_rows
+        for (a, b), (c, d) in zip(spans, spans[1:]):
+            assert b == c  # contiguous and disjoint
+
+
+def test_put_process_local_roundtrip():
+    mesh = multihost.global_mesh()
+    n = 8 * 5
+    lo, hi = multihost.local_row_range(n)
+    data = np.arange(n * 3, dtype=np.int32).reshape(n, 3)
+    arr = multihost.put_process_local(data[lo:hi], n, mesh)
+    assert arr.shape == (n, 3)
+    np.testing.assert_array_equal(np.asarray(arr), data)
+    # Actually sharded over the mesh, not replicated.
+    assert len(arr.sharding.device_set) == mesh.devices.size
+
+
+def test_sharded_hist_on_process_local_array_matches_oracle():
+    mesh = multihost.global_mesh()
+    rng = np.random.default_rng(5)
+    n = 8 * 123
+    iters = rng.integers(0, 50, size=n).astype(np.int32)
+    lo, hi = multihost.local_row_range(n)
+    arr = multihost.put_process_local(iters[lo:hi], n, mesh)
+    got = np.asarray(detection_hist_sharded(arr, 40, mesh))
+    exp = np.bincount(iters[(iters >= 1) & (iters <= 40)],
+                      minlength=41)[1:]
+    np.testing.assert_array_equal(got, exp)
+
+
+def test_all_processes_ready_noop_single_process():
+    multihost.all_processes_ready("test")  # must not raise or block

@@ -1,0 +1,113 @@
+"""Multi-host distribution: the DCN half of the communication backend.
+
+SURVEY §5 places `jax.distributed` + the mesh collectives in the
+architectural seat NCCL/MPI hold in GPU frameworks: intra-slice reductions
+ride ICI (see `mesh.py` / `rq_mesh.py`), and *this* module supplies the
+cross-host layer — process bring-up, a global mesh spanning every host's
+devices, and process-local data feeding so each host loads only its slice
+of the ~1M-session study (the reference's closest analogue is one process
+per Chrome instance with disjoint output dirs, 5_get_issue_reports.py:486-497;
+it has no device-compute distribution at all).
+
+Design rules (scaling-book recipe):
+- One global 1-D ``data`` mesh over *all* processes' devices; shardings are
+  declared, XLA inserts the collectives, and a `psum` crossing host
+  boundaries rides DCN automatically — kernels in `rq_mesh.py` and
+  `cluster/pipeline.py` need no changes to scale out.
+- Data is fed process-locally: each host materialises only
+  ``local_row_range(n)`` of the global array and
+  ``put_process_local`` assembles the global jax.Array from those shards
+  (`jax.make_array_from_process_local_data`), so no host ever holds the
+  full 1M x S items matrix.
+
+Everything degrades to a no-op in the (tested) single-process case, which
+is also how the driver's virtual-device dryrun exercises the code path.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+
+from ..utils.logging import get_logger
+from .mesh import make_mesh, shard_along
+
+log = get_logger("multihost")
+
+_ENV_COORD = "TSE1M_COORDINATOR"      # host:port of process 0
+_ENV_NPROC = "TSE1M_NUM_PROCESSES"
+_ENV_PID = "TSE1M_PROCESS_ID"
+
+
+def initialize_from_env() -> bool:
+    """Bring up `jax.distributed` when multi-host env vars are present.
+
+    Reads ``TSE1M_COORDINATOR`` / ``TSE1M_NUM_PROCESSES`` /
+    ``TSE1M_PROCESS_ID`` (explicit, scheduler-agnostic); with none set —
+    or on TPU pod slices where JAX self-discovers via the metadata server —
+    falls through to single-process or automatic initialization.  Returns
+    True when a multi-process runtime is (already or newly) active.
+    Idempotent: a second call is a no-op.
+    """
+    if jax.process_count() > 1:
+        return True
+    coord = os.environ.get(_ENV_COORD)
+    nproc = os.environ.get(_ENV_NPROC)
+    if not coord or not nproc or int(nproc) <= 1:
+        return False
+    pid = int(os.environ.get(_ENV_PID, "0"))
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=int(nproc), process_id=pid)
+    log.info("jax.distributed up: process %d/%d, %d global devices",
+             jax.process_index(), jax.process_count(), jax.device_count())
+    return True
+
+
+def global_mesh(axis: str = "data") -> jax.sharding.Mesh:
+    """1-D mesh over every device of every process (== `make_mesh` on a
+    single host; after `initialize_from_env` it spans the pod/cluster)."""
+    return make_mesh(axis=axis)
+
+
+def local_row_range(n_rows: int) -> tuple[int, int]:
+    """[start, stop) of the global row axis this process must materialise.
+
+    Rows are dealt contiguously per process in process-index order, exactly
+    matching how `put_process_local` lays shards onto the mesh; the last
+    process absorbs the remainder.
+    """
+    nproc = jax.process_count()
+    pid = jax.process_index()
+    per = -(-n_rows // nproc)  # ceil division: contiguous, last may be short
+    start = min(pid * per, n_rows)
+    return start, min(start + per, n_rows)
+
+
+def put_process_local(local_rows: np.ndarray, n_global_rows: int,
+                      mesh: jax.sharding.Mesh,
+                      axis: str = "data") -> jax.Array:
+    """Assemble a row-sharded global jax.Array from this process's slice.
+
+    ``local_rows`` must be exactly the ``local_row_range(n_global_rows)``
+    slice.  Single-process this is an ordinary sharded device_put; multi-
+    process it builds the global array without any host ever seeing
+    non-local rows.
+    """
+    sharding = shard_along(mesh, axis=axis, rank=local_rows.ndim)
+    if jax.process_count() == 1:
+        return jax.device_put(local_rows, sharding)
+    global_shape = (n_global_rows,) + local_rows.shape[1:]
+    return jax.make_array_from_process_local_data(sharding, local_rows,
+                                                  global_shape)
+
+
+def all_processes_ready(tag: str = "barrier") -> None:
+    """Cross-host barrier (no-op single-process): collective phases —
+    e.g. 'every host finished ingest, start the sharded RQ pass' — must
+    not race ahead of slow hosts."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(tag)
