@@ -57,7 +57,7 @@ def bench_extraction(target_builds: int, seed: int = 0) -> dict:
         wall = time.perf_counter() - t0
         db.closeConnection()
     n_builds = len(arrays.fuzz)
-    return {
+    result = {
         "extract_builds": n_builds,
         "extract_rows_total": (len(arrays.fuzz) + len(arrays.covb)
                                + len(arrays.issues) + len(arrays.cov)),
@@ -67,6 +67,57 @@ def bench_extraction(target_builds: int, seed: int = 0) -> dict:
         # every timed fetch — False means the pandas fallback (~2x slower)
         # produced extract_wall_s.
         "extract_native": bool(getattr(arrays, "native_decode", False)),
+    }
+    result.update(bench_rq1(arrays, cfg, wall))
+    return result
+
+
+# The reference's only published wall-clock numbers: RQ1 Phase 1 (10m51s,
+# 878 projects) + Phase 2 (19m29s, 43,254 issues) on the author's machine
+# with dockerized Postgres — rq1_detection_rate.py:361,367 (SURVEY §6).
+_REFERENCE_RQ1_WALL_S = 10 * 60 + 51 + 19 * 60 + 29
+
+
+def bench_rq1(arrays, cfg, extract_wall_s: float, iters: int = 3) -> dict:
+    """Flagship-analysis stage: RQ1 detection-rate over the extracted study
+    on BOTH backends (reference semantics rq1_detection_rate.py:189-268),
+    parity-checked, with end-to-end (= extraction + analysis) wall compared
+    against the reference's published 30m20s transcript."""
+    import numpy as np
+
+    from tse1m_tpu.backend.jax_backend import JaxBackend
+    from tse1m_tpu.backend.pandas_backend import PandasBackend
+
+    limit_ns = int(np.datetime64(cfg.limit_date, "ns").astype(np.int64))
+    # Reference filter (rq1:233) needs >=100 projects per iteration; small
+    # bench studies drop it to 1 exactly like the reference's TEST_MODE
+    # (rq1_detection_rate.py:20,233) so the parity check is non-vacuous.
+    min_projects = 100 if arrays.n_projects >= 100 else 1
+
+    def timed(backend):
+        backend.rq1_detection(arrays, limit_ns, min_projects)  # warm
+        runs = []
+        res = None
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            res = backend.rq1_detection(arrays, limit_ns, min_projects)
+            runs.append(time.perf_counter() - t0)
+        return res, statistics.median(runs)
+
+    res_jax, jax_s = timed(JaxBackend())
+    res_pd, pd_s = timed(PandasBackend())
+    for f in ("iterations", "total_projects", "detected_counts"):
+        np.testing.assert_array_equal(getattr(res_jax, f),
+                                      getattr(res_pd, f), err_msg=f)
+    end_to_end = extract_wall_s + min(jax_s, pd_s)
+    return {
+        "rq1_iterations": int(len(res_jax.iterations)),
+        "rq1_jax_wall_s": round(jax_s, 4),
+        "rq1_pandas_wall_s": round(pd_s, 4),
+        "rq1_end_to_end_s": round(end_to_end, 4),
+        "rq1_ref_wall_s": _REFERENCE_RQ1_WALL_S,
+        # >1 beats the reference's committed RQ1 transcript wall time.
+        "rq1_vs_reference": round(_REFERENCE_RQ1_WALL_S / end_to_end, 1),
     }
 
 
