@@ -1,0 +1,61 @@
+"""Exercise the data-gated figure writers.
+
+Three reference artifacts are produced only when per-session project
+counts clear the study's >=100 filter (rq2_coverage_count.py:386-435,
+rq2:123-242, rq4b_coverage.py:491-723):
+
+- rq2/session_coverage_boxplot.pdf
+- rq2/session_coverage_distribution_trend.pdf
+- rq4/coverage/g2_g1_boxplot_comparison.pdf
+
+On the small synth studies every other test uses, those filters gate the
+writers off — so without this file no CI run ever executes them.  Here the
+drivers run in test_mode (min_projects -> 1, mirroring the reference's
+TEST_MODE switch rq1_detection_rate.py:20,233) and the full artifact set is
+asserted present and non-trivial.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from tse1m_tpu.analysis.rq2_trends import run_rq2_trends
+from tse1m_tpu.analysis.rq4b import run_rq4b
+from tse1m_tpu.config import Config
+
+
+@pytest.fixture(scope="module")
+def figure_run(study_db, synth_study, tmp_path_factory):
+    out = tmp_path_factory.mktemp("figures")
+    corpus = out / "project_corpus_analysis.csv"
+    synth_study.corpus_analysis.to_csv(corpus, index=False)
+    cfg = Config(engine="sqlite", sqlite_path=study_db.config.sqlite_path,
+                 limit_date="2026-01-01", backend="jax_tpu",
+                 result_dir=str(out), corpus_csv=str(corpus))
+    cfg.test_mode = True  # min_projects -> 1 (reference TEST_MODE semantics)
+    run_rq2_trends(cfg, db=study_db)
+    run_rq4b(cfg, db=study_db)
+    return str(out)
+
+
+def _assert_pdf(path):
+    assert os.path.exists(path), f"missing figure: {path}"
+    assert os.path.getsize(path) > 1024, f"implausibly small PDF: {path}"
+
+
+def test_rq2_gated_figures_written(figure_run):
+    _assert_pdf(os.path.join(figure_run, "rq2",
+                             "session_coverage_boxplot.pdf"))
+    _assert_pdf(os.path.join(figure_run, "rq2",
+                             "session_coverage_distribution_trend.pdf"))
+    # The always-on rq2 figures come out of the same run.
+    _assert_pdf(os.path.join(figure_run, "rq2", "all_project_corr_hist.pdf"))
+    _assert_pdf(os.path.join(figure_run, "rq2",
+                             "average_median_lineplot.pdf"))
+
+
+def test_rq4b_gated_boxplot_written(figure_run):
+    _assert_pdf(os.path.join(figure_run, "rq4", "coverage",
+                             "g2_g1_boxplot_comparison.pdf"))
