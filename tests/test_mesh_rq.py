@@ -19,7 +19,7 @@ from tse1m_tpu.backend.pandas_backend import PandasBackend
 from tse1m_tpu.config import Config
 from tse1m_tpu.data.columnar import StudyArrays
 from tse1m_tpu.ops.segment import (masked_mean, masked_percentile,
-                                   masked_spearman)
+                                   masked_spearman, segment_searchsorted)
 from tse1m_tpu.parallel import rq_mesh
 from tse1m_tpu.parallel.mesh import make_mesh
 
@@ -220,3 +220,64 @@ def test_mesh_parity_vs_pandas_oracle(arrays, limit_ns):
                                                 min_projects=2)
     res_pd = PandasBackend().rq1_detection(arrays, limit_ns, min_projects=2)
     _assert_rq1_equal(res_mesh, res_pd)
+
+
+def test_rq3_mesh_vs_single_device(arrays, limit_ns, mesh):
+    """RQ3's three per-issue scans now run through
+    segment_searchsorted_mesh when a mesh is active — every field of the
+    result must be bit-equal to the single-device path."""
+    res_mesh = JaxBackend(mesh=mesh).rq3_coverage_at_detection(arrays,
+                                                               limit_ns)
+    res_one = JaxBackend(mesh=None).rq3_coverage_at_detection(arrays,
+                                                              limit_ns)
+    for f in ("det_diff_percent", "det_diff_covered", "det_diff_total",
+              "det_project_idx", "det_issue_idx", "det_issue_time_ns",
+              "nondet_diff_percent", "nondet_diff_covered",
+              "nondet_diff_total", "nondet_project_idx"):
+        np.testing.assert_array_equal(getattr(res_mesh, f),
+                                      getattr(res_one, f), err_msg=f)
+
+
+def test_rq4a_mesh_vs_single_device(arrays, limit_ns, mesh):
+    rng = np.random.default_rng(21)
+    perm = rng.permutation(arrays.n_projects)
+    g1, g2 = np.sort(perm[:6]), np.sort(perm[6:12])
+    res_mesh = JaxBackend(mesh=mesh).rq4a_detection_trend(
+        arrays, limit_ns, g1, g2, min_projects=2)
+    res_one = JaxBackend(mesh=None).rq4a_detection_trend(
+        arrays, limit_ns, g1, g2, min_projects=2)
+    for f in ("iterations", "g1_total", "g1_detected", "g2_total",
+              "g2_detected"):
+        np.testing.assert_array_equal(getattr(res_mesh, f),
+                                      getattr(res_one, f), err_msg=f)
+
+
+def test_segment_searchsorted_mesh_direct(mesh):
+    """Direct oracle test incl. a query count that doesn't divide the
+    device count (padded-shard path) and empty inputs."""
+    rng = np.random.default_rng(33)
+    P = 5
+    counts = rng.integers(0, 40, size=P)
+    off = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+    vals = np.sort(rng.integers(0, 1000, size=off[-1]).astype(np.int32))
+    vals = np.concatenate([np.sort(vals[a:b]) for a, b in zip(off, off[1:])])
+    vals_lo = rng.integers(0, 10, size=off[-1]).astype(np.int32)
+    vals_lo = np.concatenate(  # keep (hi, lo) lexicographically sorted
+        [np.sort(vals_lo[a:b]) for a, b in zip(off, off[1:])])
+    q = 101  # does not divide 8
+    seg = rng.integers(0, P, size=q).astype(np.int32)
+    queries = rng.integers(0, 1000, size=q).astype(np.int32)
+    queries_lo = rng.integers(0, 10, size=q).astype(np.int32)
+    for side in ("left", "right"):
+        got = rq_mesh.segment_searchsorted_mesh(
+            mesh, vals, off, queries, seg, side, vals_lo, queries_lo)
+        exp = np.asarray(segment_searchsorted(
+            jnp.asarray(vals), jnp.asarray(off, jnp.int32),
+            jnp.asarray(queries), jnp.asarray(seg), side=side,
+            values_lo=jnp.asarray(vals_lo),
+            queries_lo=jnp.asarray(queries_lo)))
+        np.testing.assert_array_equal(got, exp, err_msg=side)
+    # Empty queries / empty values degrade to zeros.
+    assert rq_mesh.segment_searchsorted_mesh(
+        mesh, vals, off, np.empty(0, np.int32), np.empty(0, np.int32),
+        "left", vals_lo, np.empty(0, np.int32)).size == 0

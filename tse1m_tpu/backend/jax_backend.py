@@ -75,6 +75,21 @@ class JaxBackend(Backend):
     def __init__(self, mesh="auto"):
         self._mesh = rq_mesh.auto_mesh() if mesh == "auto" else mesh
 
+    def _seg_searchsorted(self, values_s, offsets, queries_s, seg,
+                          side, values_lo, queries_lo) -> np.ndarray:
+        """Two-lane per-segment searchsorted, sharded over the query axis
+        when a mesh is active (bit-identical either way — every query's
+        binary search is independent)."""
+        if self._mesh is not None:
+            return rq_mesh.segment_searchsorted_mesh(
+                self._mesh, values_s, offsets, queries_s, seg, side,
+                values_lo, queries_lo)
+        return np.asarray(segment_searchsorted(
+            jnp.asarray(values_s), jnp.asarray(offsets, jnp.int32),
+            jnp.asarray(queries_s), jnp.asarray(seg, jnp.int32), side=side,
+            values_lo=jnp.asarray(values_lo),
+            queries_lo=jnp.asarray(queries_lo)))
+
     def rq1_detection(self, arrays: StudyArrays, limit_date_ns: int,
                       min_projects: int) -> RQ1Result:
         P = arrays.n_projects
@@ -223,29 +238,23 @@ class JaxBackend(Backend):
         has_all = ((np.diff(f_off) > 0) & (np.diff(c_off) > 0)
                    & (np.diff(v_off) > 0))
 
-        def dev(x):
-            return jnp.asarray(x)
-
         can_detect = bool(n_issues and f_pos.size and c_pos.size and v_pos.size)
         seg32 = issue_seg.astype(np.int32)
         is_, ins = ns_to_device_pair(issue_t)
         fts, ftn = ns_to_device_pair(fuzz_t[f_pos])
         cts, ctn = ns_to_device_pair(covb_t[c_pos])
         # Last successful fuzzing build strictly before rts (rq3:269).
-        pos_f = np.asarray(segment_searchsorted(
-            dev(fts), jnp.asarray(f_off, jnp.int32), dev(is_), seg32,
-            side="left", values_lo=dev(ftn), queries_lo=dev(ins)))
+        pos_f = self._seg_searchsorted(fts, f_off, is_, seg32, "left",
+                                       ftn, ins)
         # First coverage build strictly after rts (rq3:273).
-        pos_c = np.asarray(segment_searchsorted(
-            dev(cts), jnp.asarray(c_off, jnp.int32), dev(is_), seg32,
-            side="right", values_lo=dev(ctn), queries_lo=dev(ins)))
+        pos_c = self._seg_searchsorted(cts, c_off, is_, seg32, "right",
+                                       ctn, ins)
         # Day-after coverage row (rq3:287-293).
         target = floor_day_ns(issue_t) + DAY_NS
         dts, dtn = ns_to_device_pair(days)
         qts, qtn = ns_to_device_pair(target)
-        pos_d = np.asarray(segment_searchsorted(
-            dev(dts), jnp.asarray(v_off, jnp.int32), dev(qts), seg32,
-            side="left", values_lo=dev(dtn), queries_lo=dev(qtn)))
+        pos_d = self._seg_searchsorted(dts, v_off, qts, seg32, "left",
+                                       dtn, qtn)
 
         if can_detect:
             cand = (has_all[issue_seg] & (pos_f > 0)
@@ -335,10 +344,9 @@ class JaxBackend(Backend):
         qi = np.flatnonzero(issue_mask)
         is_, ins = ns_to_device_pair(arrays.issues.columns["time_ns"][qi])
         fts, ftn = ns_to_device_pair(fuzz_t[f_pos])
-        ks = np.asarray(segment_searchsorted(
-            jnp.asarray(fts), jnp.asarray(f_off, jnp.int32),
-            jnp.asarray(is_), issue_seg[qi].astype(np.int32), side="left",
-            values_lo=jnp.asarray(ftn), queries_lo=jnp.asarray(ins)))
+        ks = self._seg_searchsorted(fts, f_off, is_,
+                                    issue_seg[qi].astype(np.int32), "left",
+                                    ftn, ins)
 
         for key, gid in (("g1", 1), ("g2", 2)):
             sel = in_g == gid

@@ -296,3 +296,41 @@ def nanpercentile_by_session_mesh(sub: np.ndarray, q, mesh: Mesh) -> np.ndarray:
         out[fix] = (vhi - diff * (1.0 - gamma))[fix]
     out[:, n == 0] = np.nan
     return out
+
+
+# ---------------------------------------------------------------------------
+# RQ3/RQ4a: per-segment searchsorted with the query axis sharded
+# ---------------------------------------------------------------------------
+
+def segment_searchsorted_mesh(mesh: Mesh, values_s, offsets, queries_s,
+                              query_seg, side: str,
+                              values_lo, queries_lo) -> np.ndarray:
+    """Sharded twin of `ops.segment.segment_searchsorted` (two-lane form).
+
+    Queries — the issue axis in RQ3's three per-issue scans
+    (rq3_diff_coverage_at_detection.py:269-293) and RQ4a's iteration mapping
+    (rq4a_bug.py:344-346) — split over the mesh; the CSR build/coverage
+    arrays ride replicated.  Every query's binary search is independent, so
+    no collective is needed and the result is trivially bit-identical to
+    the single-device op (asserted in tests/test_mesh_rq.py).
+    """
+    q = int(np.asarray(queries_s).shape[0])
+    if q == 0 or int(np.asarray(values_s).shape[0]) == 0:
+        return np.zeros(q, dtype=np.int32)
+    n_dev = mesh.devices.size
+    qs = _pad_rows(np.asarray(queries_s), n_dev, 0)
+    qlo = _pad_rows(np.asarray(queries_lo), n_dev, 0)
+    seg = _pad_rows(np.asarray(query_seg, dtype=np.int32), n_dev, 0)
+
+    @jax.jit
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P(AXIS), P(AXIS), P(AXIS), P(), P(), P()),
+             out_specs=P(AXIS))
+    def kernel(queries, queries_lo_, seg_, vals, vals_lo, off):
+        return segment_searchsorted(vals, off, queries, seg_, side=side,
+                                    values_lo=vals_lo, queries_lo=queries_lo_)
+
+    out = kernel(jnp.asarray(qs), jnp.asarray(qlo), jnp.asarray(seg),
+                 jnp.asarray(values_s), jnp.asarray(values_lo),
+                 jnp.asarray(offsets, dtype=jnp.int32))
+    return np.asarray(out)[:q]
