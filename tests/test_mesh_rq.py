@@ -281,3 +281,12 @@ def test_segment_searchsorted_mesh_direct(mesh):
     assert rq_mesh.segment_searchsorted_mesh(
         mesh, vals, off, np.empty(0, np.int32), np.empty(0, np.int32),
         "left", vals_lo, np.empty(0, np.int32)).size == 0
+
+
+def test_rq2_changepoints_mesh_vs_single_device(arrays, limit_ns, mesh):
+    res_mesh = JaxBackend(mesh=mesh).rq2_change_points(arrays, limit_ns)
+    res_one = JaxBackend(mesh=None).rq2_change_points(arrays, limit_ns)
+    for f in ("project_idx", "end_i", "start_ip1", "covered_i", "total_i",
+              "covered_ip1", "total_ip1"):
+        np.testing.assert_array_equal(getattr(res_mesh, f),
+                                      getattr(res_one, f), err_msg=f)

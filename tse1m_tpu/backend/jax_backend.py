@@ -144,8 +144,9 @@ class JaxBackend(Backend):
                           limit_date_ns: int) -> RQ2ChangePointsResult:
         """Group-boundary detection is vectorised numpy (irregular/cheap);
         the date-equality join runs as one device searchsorted over the CSR
-        coverage-date arrays, and the final float64 gathers stay on host so
-        values are bit-exact vs the pandas backend."""
+        coverage-date arrays — sharded over the boundary axis when a mesh
+        is active — and the final float64 gathers stay on host so values
+        are bit-exact vs the pandas backend."""
         covb_t = arrays.covb.columns["time_ns"]
         ghash = arrays.covb.columns["grouphash"]
         seg_all = np.repeat(np.arange(arrays.n_projects), arrays.covb.counts())
@@ -189,10 +190,9 @@ class JaxBackend(Backend):
         q_seg = np.concatenate([proj, proj])
         ds, dns = ns_to_device_pair(cov_days)
         qs, qns = ns_to_device_pair(q_days)
-        pos = np.asarray(segment_searchsorted(
-            ds, jnp.asarray(cov_offsets, dtype=jnp.int32),
-            qs, q_seg.astype(np.int32), side="left",
-            values_lo=dns, queries_lo=qns))
+        pos = self._seg_searchsorted(ds, cov_offsets, qs,
+                                     q_seg.astype(np.int32), "left",
+                                     dns, qns)
         gidx = cov_offsets[q_seg] + pos
         in_seg = gidx < cov_offsets[q_seg + 1]
         safe = np.clip(gidx, 0, max(cov_pos.size - 1, 0))
