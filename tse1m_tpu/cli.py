@@ -105,6 +105,8 @@ def _cmd_rq(args) -> int:
         cfg.sqlite_path = args.db
     if args.backend:
         cfg.backend = args.backend
+    if args.result_dir:
+        cfg.result_dir = args.result_dir
     import importlib
 
     runners = {}
@@ -282,6 +284,9 @@ def main(argv=None) -> int:
         p = sub.add_parser(name, help=f"run {name} analysis")
         p.add_argument("--db", default=None)
         p.add_argument("--backend", choices=("pandas", "jax_tpu"), default=None)
+        p.add_argument("--result-dir", default=None,
+                       help="artifact root (default data/result_data; also "
+                            "settable via TSE1M_RESULT_DIR)")
         p.set_defaults(fn=_cmd_rq)
 
     p = sub.add_parser("collect", help="run an offline collection step")
