@@ -174,16 +174,56 @@ class StudyArrays:
         native_path = _native_db_path(db)
         native_fetches = 0
 
-        def fetch(query, cols, spec):
+        # Table plan: (query, column names, decode spec).  The native
+        # decoder's sqlite scan runs with the GIL released (decode.cc
+        # phase 1), but fetching the four tables from a thread pool was
+        # measured NOT to pay: wall time is dominated by the GIL-held
+        # PyUnicode materialisation (phase 2), so the fetches run serially
+        # and the GIL release simply keeps other Python threads live.
+        # fuzz modules/revisions are 'u' (no interning): fuzz rows carry
+        # near-unique revision text, so an intern map would copy ~every
+        # value into its keys for no dedup (covb's repeated group keys are
+        # where 's' pays).
+        plus1 = str(np.datetime64(cfg.limit_date) + np.timedelta64(1, "D"))
+        plan = {
+            "fuzz": (queries.all_fuzzing_builds_bulk(projects),
+                     ["project", "name", "timecreated", "result",
+                      "modules", "revisions"], "putsuu"),
+            "covb": (queries.coverage_builds_bulk(projects),
+                     ["project", "name", "timecreated", "modules",
+                      "revisions", "result"], "putsss"),
+            "issues": (queries.issues_bulk(projects, cfg.limit_date,
+                                           fixed_only=True),
+                       ["project", "number", "rts", "status", "crash_type",
+                        "severity"], "potsss"),
+            "cov": (queries.total_coverage_bulk(projects, plus1),
+                    ["project", "date", "coverage", "covered", "total"],
+                    "ptfff"),
+        }
+        prefetched: dict = {}
+        if native_path is not None:
+            from ..native import fetch_table
+
+            for k, ((sql, params), _cols, spec) in plan.items():
+                try:
+                    prefetched[k] = fetch_table(native_path, sql, params,
+                                                spec, projects)
+                except RuntimeError as e:
+                    # Strict native parsers reject rather than guess
+                    # (timezone suffixes, non-text timestamps, ...).
+                    log.info("native decode fell back (%s): %s", k, e)
+                    prefetched[k] = None
+
+        def fetch(table):
             """One bulk query -> {col: array} sorted by our project codes.
 
-            ``spec`` is one char per column (see native/decode.cc): 'p'
-            project->code, 't' ISO8601 text->int64 ns, 'f' float64, 's'
-            interned text, 'u' text, 'o' as-stored.  The native sqlite
-            decoder handles the whole row loop in C++ when available; the
-            pandas fallback below produces byte-identical arrays (asserted
-            by tests/test_native_decode.py).  Everything after this is
-            column-wise — no per-row Python at the 1.19M-build scale.
+            Spec chars (see native/decode.cc): 'p' project->code, 't'
+            ISO8601 text->int64 ns, 'f' float64, 's' interned text, 'u'
+            text, 'o' as-stored.  The native decoder handles the whole row
+            loop in C++ when available; the pandas fallback below produces
+            byte-identical arrays (asserted by tests/test_native_decode.py).
+            Everything after this is column-wise — no per-row Python at the
+            1.19M-build scale.
 
             The stable re-sort exists because SQL ORDER BY project uses the
             engine's collation, which may disagree with Python's code-point
@@ -191,21 +231,12 @@ class StudyArrays:
             weight); within-project time order from SQL is preserved by the
             stable sort."""
             nonlocal native_fetches
-            sql, params = query
+            (sql, params), cols, spec = plan[table]
             out = None
-            if native_path is not None:
-                try:
-                    from ..native import fetch_table
-
-                    raw = fetch_table(native_path, sql, params, spec,
-                                      projects)
-                    if raw is not None:
-                        out = dict(zip(cols, raw))
-                        native_fetches += 1
-                except RuntimeError as e:
-                    # Strict native parsers reject rather than guess
-                    # (timezone suffixes, non-text timestamps, ...).
-                    log.info("native decode fell back: %s", e)
+            raw = prefetched.get(table)
+            if raw is not None:
+                out = dict(zip(cols, raw))
+                native_fetches += 1
             if out is None:
                 rows = db.query(sql, params)
                 df = pd.DataFrame(rows, columns=cols, dtype=object)
@@ -229,13 +260,7 @@ class StudyArrays:
                 RESULT_OK).to_numpy(dtype=bool)
 
         # Fuzzing builds (bulk; replaces ALL_FUZZING_BUILD per-project loop).
-        # modules/revisions are 'u' (no interning): fuzz rows carry
-        # near-unique revision text, so an intern map would copy ~every
-        # value into its keys for no dedup (covb's repeated group keys are
-        # where 's' pays).
-        ftb, fcodes = fetch(queries.all_fuzzing_builds_bulk(projects),
-                            ["project", "name", "timecreated", "result",
-                             "modules", "revisions"], "putsuu")
+        ftb, fcodes = fetch("fuzz")
         fuzz = Segmented(
             offsets=_offsets_from_sorted_codes(fcodes, len(projects)),
             columns={
@@ -255,9 +280,7 @@ class StudyArrays:
         # shift/cumsum key rq2_coverage_and_added.py:129 — is a factorize
         # over the concatenated raw columns: one C pass, and integer code
         # equality IS string equality (no hash collisions at all).
-        ctb, ccodes = fetch(queries.coverage_builds_bulk(projects),
-                            ["project", "name", "timecreated", "modules",
-                             "revisions", "result"], "putsss")
+        ctb, ccodes = fetch("covb")
         if len(ccodes):
             gkey = pd.Series(ctb["modules"], dtype=object).astype(str).str.cat(
                 pd.Series(ctb["revisions"], dtype=object).astype(str),
@@ -281,10 +304,7 @@ class StudyArrays:
         )
 
         # Fixed issues before the cutoff.
-        itb, icodes = fetch(
-            queries.issues_bulk(projects, cfg.limit_date, fixed_only=True),
-            ["project", "number", "rts", "status", "crash_type", "severity"],
-            "potsss")
+        itb, icodes = fetch("issues")
         issues = Segmented(
             offsets=_offsets_from_sorted_codes(icodes, len(projects)),
             columns={
@@ -302,10 +322,7 @@ class StudyArrays:
         # None -> NaN but a malformed value still raises, so ingest
         # corruption fails loudly instead of leaking NaNs into RQ results;
         # the native decoder types these columns REAL at the sqlite level.
-        plus1 = str(np.datetime64(cfg.limit_date) + np.timedelta64(1, "D"))
-        vtb, vcodes = fetch(queries.total_coverage_bulk(projects, plus1),
-                            ["project", "date", "coverage", "covered",
-                             "total"], "ptfff")
+        vtb, vcodes = fetch("cov")
         cov = Segmented(
             offsets=_offsets_from_sorted_codes(vcodes, len(projects)),
             columns={

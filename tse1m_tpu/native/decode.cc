@@ -4,16 +4,23 @@
 // (SURVEY §2.4): the reference pays the per-cell Python-object cost once
 // per row x column over ~1.19M builds (rq1_detection_rate.py:192-203 via
 // psycopg2 fetchall; our sqlite twin showed the same profile — ~60% of
-// extraction wall time inside Cursor.fetchall).  Here the sqlite3 C API
-// streams straight into preallocated C++ vectors:
-//   - ISO8601 timestamps parse to int64 epoch-nanoseconds in C (bit-parity
-//     with pandas.to_datetime(format="ISO8601") asserted in
-//     tests/test_native_decode.py; anything the strict parser cannot prove
-//     it parses identically — timezones, junk — raises, and the caller
-//     falls back to the pandas path),
-//   - repeated TEXT cells (result enums, modules/revisions arrays) intern
-//     through a hash map so each distinct value allocates ONE PyUnicode,
-//   - numerics land in numpy buffers with no intermediate tuples.
+// extraction wall time inside Cursor.fetchall).
+//
+// Two phases:
+//   1. GIL-RELEASED scan: sqlite3_step loop entirely in C++ — project-key
+//      lookups, strict ISO8601 -> epoch-ns parsing, numerics into typed
+//      vectors, text into an arena (interned text into a per-column
+//      distinct-string table).  Because the GIL is dropped, the four study
+//      tables can be fetched concurrently from Python threads and the
+//      decoder never stalls other Python work.
+//   2. GIL-HELD materialisation: numpy buffers via memcpy; ONE PyUnicode
+//      per distinct interned value; arena text -> PyUnicode for
+//      high-cardinality columns.
+//
+// Parity contract: anything the strict parsers cannot prove they decode
+// identically to the pandas path (timezone suffixes, junk text in numeric
+// columns, unknown keys) raises, and the caller falls back to pandas —
+// asserted in tests/test_native_decode.py.
 //
 // The sqlite3 prototypes are declared inline because this image ships
 // libsqlite3.so.0 without its header; the declarations below are the
@@ -28,6 +35,7 @@
 #include <cstring>
 #include <string>
 #include <unordered_map>
+#include <variant>
 #include <vector>
 
 extern "C" {
@@ -139,39 +147,247 @@ bool parse_iso_ns(const char *s, int len, int64_t *out) {
   return true;
 }
 
-// ---- column accumulators ---------------------------------------------------
+// ---- GIL-free column accumulators ------------------------------------------
+
+// 'o' cell tags.
+enum : uint8_t { O_NULL = 0, O_INT = 1, O_FLOAT = 2, O_TEXT = 3 };
+
+struct TextRef {
+  size_t off;
+  int32_t len;  // -1 = NULL
+};
 
 struct Col {
-  char spec;                       // p/t/f/s/u/o
-  std::vector<int32_t> i32;        // 'p'
-  std::vector<int64_t> i64;        // 't'
-  std::vector<double> f64;         // 'f'
-  std::vector<PyObject *> obj;     // 's'/'u'/'o' (owned refs)
-  std::unordered_map<std::string, PyObject *> intern;  // 's' (borrowed into obj)
+  char spec;                          // p/t/f/s/u/o
+  std::vector<int32_t> i32;           // 'p', and 's' intern ids
+  std::vector<int64_t> i64;           // 't', and 'o' ints
+  std::vector<double> f64;            // 'f', and 'o' floats
+  std::vector<uint8_t> tag;           // 'o'
+  std::vector<TextRef> text;          // 'u'/'o' arena refs
+  std::string arena;                  // 'u'/'o' raw text bytes
+  std::vector<std::string> distinct;  // 's' intern table
+  std::unordered_map<std::string, int32_t> intern;  // 's'
 };
 
-struct Closer {
+using Param = std::variant<std::string, long long, double>;
+
+// Phase 1: everything between open and finalize runs WITHOUT the GIL.
+// Returns empty string on success, else an error message.
+std::string scan(const std::string &db_path, const std::string &sql,
+                 const std::vector<Param> &params,
+                 const std::unordered_map<std::string, int32_t> &keymap,
+                 std::vector<Col> &cols) {
   sqlite3 *db = nullptr;
   sqlite3_stmt *stmt = nullptr;
-  std::vector<Col> *cols = nullptr;
-  ~Closer() {
+  auto fail = [&](const std::string &msg) {
+    std::string full = msg;
+    if (db) {
+      full += ": ";
+      full += sqlite3_errmsg(db);
+    }
     if (stmt) sqlite3_finalize(stmt);
     if (db) sqlite3_close(db);
-    if (cols)
-      for (auto &c : *cols) {
-        for (auto *o : c.obj) Py_XDECREF(o);
-        // Error-path cleanup: each interned value still holds the map's
-        // extra ref (the success path clears intern before building the
-        // output arrays, making this a no-op there).
-        for (auto &kv : c.intern) Py_DECREF(kv.second);
-      }
+    return full;
+  };
+  if (sqlite3_open_v2(db_path.c_str(), &db, SQLITE_OPEN_READONLY, nullptr) !=
+      SQLITE_OK)
+    return fail("cannot open database");
+  if (sqlite3_prepare_v2(db, sql.c_str(), -1, &stmt, nullptr) != SQLITE_OK)
+    return fail("prepare failed");
+  for (size_t i = 0; i < params.size(); i++) {
+    int rc;
+    const int pi = static_cast<int>(i + 1);
+    if (auto *s = std::get_if<std::string>(&params[i]))
+      rc = sqlite3_bind_text(stmt, pi, s->c_str(),
+                             static_cast<int>(s->size()), SQLITE_TRANSIENT);
+    else if (auto *v = std::get_if<long long>(&params[i]))
+      rc = sqlite3_bind_int64(stmt, pi, *v);
+    else
+      rc = sqlite3_bind_double(stmt, pi, std::get<double>(params[i]));
+    if (rc != SQLITE_OK) return fail("bind failed");
   }
-};
+  const int ncol = static_cast<int>(cols.size());
+  if (sqlite3_column_count(stmt) != ncol)
+    return fail("spec length != selected column count");
 
-PyObject *err(const char *msg, sqlite3 *db = nullptr) {
-  PyErr_Format(PyExc_RuntimeError, "native decode: %s%s%s", msg,
-               db ? ": " : "", db ? sqlite3_errmsg(db) : "");
+  int rc;
+  while ((rc = sqlite3_step(stmt)) == SQLITE_ROW) {
+    for (int ci = 0; ci < ncol; ci++) {
+      Col &c = cols[ci];
+      const int ty = sqlite3_column_type(stmt, ci);
+      switch (c.spec) {
+        case 'p': {
+          if (ty != SQLITE_TEXT) return fail("key column must be TEXT");
+          const char *sp = reinterpret_cast<const char *>(
+              sqlite3_column_text(stmt, ci));
+          auto it = keymap.find(
+              std::string(sp, sqlite3_column_bytes(stmt, ci)));
+          if (it == keymap.end()) return fail("key value not in key_values");
+          c.i32.push_back(it->second);
+          break;
+        }
+        case 't': {
+          if (ty != SQLITE_TEXT)
+            return fail("timestamp column must be TEXT "
+                        "(caller should fall back)");
+          int64_t ns;
+          if (!parse_iso_ns(reinterpret_cast<const char *>(
+                                sqlite3_column_text(stmt, ci)),
+                            sqlite3_column_bytes(stmt, ci), &ns))
+            return fail("unparseable timestamp (caller should fall back)");
+          c.i64.push_back(ns);
+          break;
+        }
+        case 'f': {
+          // TEXT is rejected rather than coerced: sqlite3_column_double
+          // turns junk text into 0.0 silently, while the pandas fallback
+          // raises on malformed numerics — falling back keeps that
+          // fail-loudly contract.
+          if (ty == SQLITE_NULL)
+            c.f64.push_back(Py_NAN);
+          else if (ty == SQLITE_INTEGER || ty == SQLITE_FLOAT)
+            c.f64.push_back(sqlite3_column_double(stmt, ci));
+          else
+            return fail("non-numeric cell in float column "
+                        "(caller should fall back)");
+          break;
+        }
+        case 's': {
+          if (ty == SQLITE_NULL) {
+            c.i32.push_back(-1);
+            break;
+          }
+          const char *sp = reinterpret_cast<const char *>(
+              sqlite3_column_text(stmt, ci));
+          std::string key(sp, sqlite3_column_bytes(stmt, ci));
+          auto [it, inserted] = c.intern.try_emplace(
+              std::move(key), static_cast<int32_t>(c.distinct.size()));
+          if (inserted) c.distinct.push_back(it->first);
+          c.i32.push_back(it->second);
+          break;
+        }
+        case 'u': {
+          if (ty == SQLITE_NULL) {
+            c.text.push_back({0, -1});
+            break;
+          }
+          const char *sp = reinterpret_cast<const char *>(
+              sqlite3_column_text(stmt, ci));
+          const int sl = sqlite3_column_bytes(stmt, ci);
+          c.text.push_back({c.arena.size(), sl});
+          c.arena.append(sp, sl);
+          break;
+        }
+        case 'o': {
+          if (ty == SQLITE_NULL) {
+            c.tag.push_back(O_NULL);
+            c.i64.push_back(0);
+            c.f64.push_back(0.0);
+            c.text.push_back({0, -1});
+          } else if (ty == SQLITE_INTEGER) {
+            c.tag.push_back(O_INT);
+            c.i64.push_back(sqlite3_column_int64(stmt, ci));
+            c.f64.push_back(0.0);
+            c.text.push_back({0, -1});
+          } else if (ty == SQLITE_FLOAT) {
+            c.tag.push_back(O_FLOAT);
+            c.i64.push_back(0);
+            c.f64.push_back(sqlite3_column_double(stmt, ci));
+            c.text.push_back({0, -1});
+          } else {
+            const char *sp = reinterpret_cast<const char *>(
+                sqlite3_column_text(stmt, ci));
+            const int sl = sqlite3_column_bytes(stmt, ci);
+            c.tag.push_back(O_TEXT);
+            c.i64.push_back(0);
+            c.f64.push_back(0.0);
+            c.text.push_back({c.arena.size(), sl});
+            c.arena.append(sp, sl);
+          }
+          break;
+        }
+      }
+    }
+  }
+  if (rc != SQLITE_DONE) return fail("step failed");
+  sqlite3_finalize(stmt);
+  sqlite3_close(db);
+  return "";
+}
+
+PyObject *err(const std::string &msg) {
+  PyErr_Format(PyExc_RuntimeError, "native decode: %s", msg.c_str());
   return nullptr;
+}
+
+template <typename T>
+PyObject *numeric_array(const std::vector<T> &v, int npy_type) {
+  npy_intp n = static_cast<npy_intp>(v.size());
+  PyObject *arr = PyArray_SimpleNew(1, &n, npy_type);
+  if (arr)
+    memcpy(PyArray_DATA(reinterpret_cast<PyArrayObject *>(arr)), v.data(),
+           v.size() * sizeof(T));
+  return arr;
+}
+
+// Phase 2 (GIL held): one column -> numpy array, or NULL with an exception.
+PyObject *materialize(Col &c) {
+  switch (c.spec) {
+    case 'p':
+      return numeric_array(c.i32, NPY_INT32);
+    case 't':
+      return numeric_array(c.i64, NPY_INT64);
+    case 'f':
+      return numeric_array(c.f64, NPY_FLOAT64);
+    default:
+      break;
+  }
+  const size_t n_rows = c.spec == 's' ? c.i32.size() : c.text.size();
+  npy_intp n = static_cast<npy_intp>(n_rows);
+  PyObject *arr = PyArray_SimpleNew(1, &n, NPY_OBJECT);
+  if (!arr) return nullptr;
+  PyObject **data = reinterpret_cast<PyObject **>(
+      PyArray_DATA(reinterpret_cast<PyArrayObject *>(arr)));
+  if (c.spec == 's') {
+    std::vector<PyObject *> uniq(c.distinct.size());
+    for (size_t i = 0; i < c.distinct.size(); i++) {
+      uniq[i] = PyUnicode_DecodeUTF8(c.distinct[i].data(),
+                                     static_cast<Py_ssize_t>(
+                                         c.distinct[i].size()), nullptr);
+      if (!uniq[i]) {
+        for (size_t j = 0; j < i; j++) Py_DECREF(uniq[j]);
+        Py_DECREF(arr);
+        return nullptr;
+      }
+    }
+    for (size_t r = 0; r < n_rows; r++) {
+      PyObject *o = c.i32[r] < 0 ? Py_None : uniq[c.i32[r]];
+      Py_INCREF(o);
+      data[r] = o;
+    }
+    for (auto *o : uniq) Py_DECREF(o);  // array rows now hold the refs
+    return arr;
+  }
+  for (size_t r = 0; r < n_rows; r++) {
+    const TextRef &t = c.text[r];
+    PyObject *o;
+    if (c.spec == 'o' && c.tag[r] == O_INT)
+      o = PyLong_FromLongLong(c.i64[r]);
+    else if (c.spec == 'o' && c.tag[r] == O_FLOAT)
+      o = PyFloat_FromDouble(c.f64[r]);
+    else if (t.len < 0) {
+      o = Py_None;
+      Py_INCREF(o);
+    } else {
+      o = PyUnicode_DecodeUTF8(c.arena.data() + t.off, t.len, nullptr);
+    }
+    if (!o) {
+      Py_DECREF(arr);  // frees the rows materialized so far
+      return nullptr;
+    }
+    data[r] = o;
+  }
+  return arr;
 }
 
 // fetch_table(db_path, sql, params, spec, key_values) -> tuple of arrays
@@ -179,31 +395,63 @@ PyObject *err(const char *msg, sqlite3 *db = nullptr) {
 // spec: one char per selected column —
 //   p  TEXT key -> int32 code via the key_values list (error if unseen)
 //   t  TEXT ISO8601 -> int64 epoch-ns
-//   f  numeric -> float64 (NULL -> NaN)
+//   f  numeric -> float64 (NULL -> NaN; TEXT rejected)
 //   s  TEXT -> object array, values interned per column
 //   u  TEXT -> object array, no interning (high-cardinality, e.g. names)
 //   o  object array preserving sqlite's native type (int/float/text/None)
 PyObject *fetch_table(PyObject *, PyObject *args) {
-  const char *db_path, *sql, *spec;
-  PyObject *params, *keys;
-  if (!PyArg_ParseTuple(args, "ssOsO", &db_path, &sql, &params, &spec, &keys))
+  const char *db_path_c, *sql_c, *spec_c;
+  PyObject *params_o, *keys_o;
+  if (!PyArg_ParseTuple(args, "ssOsO", &db_path_c, &sql_c, &params_o, &spec_c,
+                        &keys_o))
     return nullptr;
-  if (!PySequence_Check(params) || !PySequence_Check(keys))
+  if (!PySequence_Check(params_o) || !PySequence_Check(keys_o))
     return err("params and key_values must be sequences");
 
-  const Py_ssize_t ncol = static_cast<Py_ssize_t>(strlen(spec));
-  std::vector<Col> cols(ncol);
-  for (Py_ssize_t i = 0; i < ncol; i++) {
+  const std::string db_path(db_path_c), sql(sql_c), spec(spec_c);
+  std::vector<Col> cols(spec.size());
+  for (size_t i = 0; i < spec.size(); i++) {
     cols[i].spec = spec[i];
     if (!strchr("ptfsuo", spec[i])) return err("unknown spec char");
   }
 
+  // Extract params / keys into pure C++ while still holding the GIL.
+  std::vector<Param> params;
+  {
+    PyObject *fast = PySequence_Fast(params_o, "params");
+    if (!fast) return nullptr;
+    const Py_ssize_t np = PySequence_Fast_GET_SIZE(fast);
+    for (Py_ssize_t i = 0; i < np; i++) {
+      PyObject *p = PySequence_Fast_GET_ITEM(fast, i);
+      if (PyUnicode_Check(p)) {
+        Py_ssize_t sl;
+        const char *sp = PyUnicode_AsUTF8AndSize(p, &sl);
+        if (!sp) {
+          Py_DECREF(fast);
+          return nullptr;
+        }
+        params.emplace_back(std::string(sp, sl));
+      } else if (PyLong_Check(p)) {
+        params.emplace_back(static_cast<long long>(PyLong_AsLongLong(p)));
+        if (PyErr_Occurred()) {
+          Py_DECREF(fast);
+          return nullptr;
+        }
+      } else if (PyFloat_Check(p)) {
+        params.emplace_back(PyFloat_AsDouble(p));
+      } else {
+        Py_DECREF(fast);
+        return err("unsupported parameter type");
+      }
+    }
+    Py_DECREF(fast);
+  }
   std::unordered_map<std::string, int32_t> keymap;
   {
-    PyObject *fast = PySequence_Fast(keys, "key_values");
+    PyObject *fast = PySequence_Fast(keys_o, "key_values");
     if (!fast) return nullptr;
-    const Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
-    for (Py_ssize_t i = 0; i < n; i++) {
+    const Py_ssize_t nk = PySequence_Fast_GET_SIZE(fast);
+    for (Py_ssize_t i = 0; i < nk; i++) {
       Py_ssize_t sl;
       const char *sp =
           PyUnicode_AsUTF8AndSize(PySequence_Fast_GET_ITEM(fast, i), &sl);
@@ -216,197 +464,23 @@ PyObject *fetch_table(PyObject *, PyObject *args) {
     Py_DECREF(fast);
   }
 
-  Closer guard;
-  guard.cols = &cols;
-  if (sqlite3_open_v2(db_path, &guard.db, SQLITE_OPEN_READONLY, nullptr) !=
-      SQLITE_OK)
-    return err("cannot open database", guard.db);
-  if (sqlite3_prepare_v2(guard.db, sql, -1, &guard.stmt, nullptr) != SQLITE_OK)
-    return err("prepare failed", guard.db);
+  // Phase 1: the whole sqlite scan runs without the GIL.
+  std::string scan_err;
+  Py_BEGIN_ALLOW_THREADS;
+  scan_err = scan(db_path, sql, params, keymap, cols);
+  Py_END_ALLOW_THREADS;
+  if (!scan_err.empty()) return err(scan_err);
 
-  {
-    PyObject *fast = PySequence_Fast(params, "params");
-    if (!fast) return nullptr;
-    const Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
-    for (Py_ssize_t i = 0; i < n; i++) {
-      PyObject *p = PySequence_Fast_GET_ITEM(fast, i);
-      int rc;
-      if (PyUnicode_Check(p)) {
-        Py_ssize_t sl;
-        const char *sp = PyUnicode_AsUTF8AndSize(p, &sl);
-        if (!sp) {
-          Py_DECREF(fast);
-          return nullptr;
-        }
-        rc = sqlite3_bind_text(guard.stmt, static_cast<int>(i + 1), sp,
-                               static_cast<int>(sl), SQLITE_TRANSIENT);
-      } else if (PyLong_Check(p)) {
-        rc = sqlite3_bind_int64(guard.stmt, static_cast<int>(i + 1),
-                                PyLong_AsLongLong(p));
-      } else if (PyFloat_Check(p)) {
-        rc = sqlite3_bind_double(guard.stmt, static_cast<int>(i + 1),
-                                 PyFloat_AsDouble(p));
-      } else {
-        Py_DECREF(fast);
-        return err("unsupported parameter type");
-      }
-      if (rc != SQLITE_OK) {
-        Py_DECREF(fast);
-        return err("bind failed", guard.db);
-      }
-    }
-    Py_DECREF(fast);
-  }
-
-  if (sqlite3_column_count(guard.stmt) != static_cast<int>(ncol))
-    return err("spec length != selected column count");
-
-  int rc;
-  while ((rc = sqlite3_step(guard.stmt)) == SQLITE_ROW) {
-    for (Py_ssize_t i = 0; i < ncol; i++) {
-      Col &c = cols[i];
-      const int ci = static_cast<int>(i);
-      const int ty = sqlite3_column_type(guard.stmt, ci);
-      switch (c.spec) {
-        case 'p': {
-          if (ty != SQLITE_TEXT) return err("key column must be TEXT");
-          const char *sp = reinterpret_cast<const char *>(
-              sqlite3_column_text(guard.stmt, ci));
-          auto it = keymap.find(
-              std::string(sp, sqlite3_column_bytes(guard.stmt, ci)));
-          if (it == keymap.end()) return err("key value not in key_values");
-          c.i32.push_back(it->second);
-          break;
-        }
-        case 't': {
-          if (ty != SQLITE_TEXT) return err("timestamp column must be TEXT");
-          int64_t ns;
-          if (!parse_iso_ns(reinterpret_cast<const char *>(
-                                sqlite3_column_text(guard.stmt, ci)),
-                            sqlite3_column_bytes(guard.stmt, ci), &ns))
-            return err("unparseable timestamp (caller should fall back)");
-          c.i64.push_back(ns);
-          break;
-        }
-        case 'f': {
-          // TEXT is rejected rather than coerced: sqlite3_column_double
-          // turns junk text into 0.0 silently, while the pandas fallback
-          // raises on malformed numerics — falling back keeps that
-          // fail-loudly contract.
-          if (ty == SQLITE_NULL)
-            c.f64.push_back(Py_NAN);
-          else if (ty == SQLITE_INTEGER || ty == SQLITE_FLOAT)
-            c.f64.push_back(sqlite3_column_double(guard.stmt, ci));
-          else
-            return err("non-numeric cell in float column "
-                       "(caller should fall back)");
-          break;
-        }
-        case 's':
-        case 'u': {
-          if (ty == SQLITE_NULL) {
-            Py_INCREF(Py_None);
-            c.obj.push_back(Py_None);
-            break;
-          }
-          const char *sp = reinterpret_cast<const char *>(
-              sqlite3_column_text(guard.stmt, ci));
-          const int sl = sqlite3_column_bytes(guard.stmt, ci);
-          if (c.spec == 's') {
-            std::string key(sp, sl);
-            auto it = c.intern.find(key);
-            if (it != c.intern.end()) {
-              Py_INCREF(it->second);
-              c.obj.push_back(it->second);
-            } else {
-              PyObject *o = PyUnicode_DecodeUTF8(sp, sl, nullptr);
-              if (!o) return nullptr;
-              c.intern.emplace(std::move(key), o);
-              Py_INCREF(o);  // one ref held via obj, one via intern map
-              c.obj.push_back(o);
-            }
-          } else {
-            PyObject *o = PyUnicode_DecodeUTF8(sp, sl, nullptr);
-            if (!o) return nullptr;
-            c.obj.push_back(o);
-          }
-          break;
-        }
-        case 'o': {
-          PyObject *o;
-          if (ty == SQLITE_NULL) {
-            o = Py_None;
-            Py_INCREF(o);
-          } else if (ty == SQLITE_INTEGER) {
-            o = PyLong_FromLongLong(sqlite3_column_int64(guard.stmt, ci));
-          } else if (ty == SQLITE_FLOAT) {
-            o = PyFloat_FromDouble(sqlite3_column_double(guard.stmt, ci));
-          } else {
-            o = PyUnicode_DecodeUTF8(reinterpret_cast<const char *>(
-                                         sqlite3_column_text(guard.stmt, ci)),
-                                     sqlite3_column_bytes(guard.stmt, ci),
-                                     nullptr);
-          }
-          if (!o) return nullptr;
-          c.obj.push_back(o);
-          break;
-        }
-      }
-    }
-  }
-  if (rc != SQLITE_DONE) return err("step failed", guard.db);
-  // Intern maps hold one extra ref per distinct value; release those now.
-  for (auto &c : cols)
-    for (auto &kv : c.intern) Py_DECREF(kv.second);
-  for (auto &c : cols) c.intern.clear();
-
-  PyObject *out = PyTuple_New(ncol);
+  // Phase 2: materialize numpy arrays under the GIL.
+  PyObject *out = PyTuple_New(static_cast<Py_ssize_t>(cols.size()));
   if (!out) return nullptr;
-  for (Py_ssize_t i = 0; i < ncol; i++) {
-    Col &c = cols[i];
-    npy_intp n;
-    PyObject *arr = nullptr;
-    switch (c.spec) {
-      case 'p':
-        n = static_cast<npy_intp>(c.i32.size());
-        arr = PyArray_SimpleNew(1, &n, NPY_INT32);
-        if (arr)
-          memcpy(PyArray_DATA(reinterpret_cast<PyArrayObject *>(arr)),
-                 c.i32.data(), c.i32.size() * sizeof(int32_t));
-        break;
-      case 't':
-        n = static_cast<npy_intp>(c.i64.size());
-        arr = PyArray_SimpleNew(1, &n, NPY_INT64);
-        if (arr)
-          memcpy(PyArray_DATA(reinterpret_cast<PyArrayObject *>(arr)),
-                 c.i64.data(), c.i64.size() * sizeof(int64_t));
-        break;
-      case 'f':
-        n = static_cast<npy_intp>(c.f64.size());
-        arr = PyArray_SimpleNew(1, &n, NPY_FLOAT64);
-        if (arr)
-          memcpy(PyArray_DATA(reinterpret_cast<PyArrayObject *>(arr)),
-                 c.f64.data(), c.f64.size() * sizeof(double));
-        break;
-      default: {
-        n = static_cast<npy_intp>(c.obj.size());
-        arr = PyArray_SimpleNew(1, &n, NPY_OBJECT);
-        if (arr) {
-          PyObject **data = reinterpret_cast<PyObject **>(
-              PyArray_DATA(reinterpret_cast<PyArrayObject *>(arr)));
-          // Transfer ownership of each ref into the (NULL-initialised)
-          // object array.
-          memcpy(data, c.obj.data(), c.obj.size() * sizeof(PyObject *));
-          c.obj.clear();  // refs now owned by the array
-        }
-        break;
-      }
-    }
+  for (size_t i = 0; i < cols.size(); i++) {
+    PyObject *arr = materialize(cols[i]);
     if (!arr) {
       Py_DECREF(out);
       return nullptr;
     }
-    PyTuple_SET_ITEM(out, i, arr);
+    PyTuple_SET_ITEM(out, static_cast<Py_ssize_t>(i), arr);
   }
   return out;
 }
