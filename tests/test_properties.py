@@ -1,0 +1,145 @@
+"""Property-based tests (hypothesis) for the pure kernels whose edge cases
+are too numerous to enumerate by hand: the native ISO parser vs the pandas
+oracle, the pg-array literal round-trip, rev_hash invariances, the
+segment-searchsorted device op vs numpy per segment, and the buildlog
+fetch window's ordering guarantee.
+"""
+
+from __future__ import annotations
+
+import os
+import sqlite3
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+from hypothesis import given, settings, strategies as st
+
+from tse1m_tpu.cluster import adjusted_rand_index  # noqa: F401 (env check)
+from tse1m_tpu.collect.buildlogs import _windowed_map
+from tse1m_tpu.data.columnar import rev_hash
+from tse1m_tpu.db.ingest import parse_array, pg_array_literal
+from tse1m_tpu.ops.segment import segment_searchsorted
+
+
+# -- native ISO parser vs pandas ----------------------------------------------
+
+def _native_available():
+    from tse1m_tpu import native
+
+    return native._load() is not None
+
+
+timestamps = st.datetimes(
+    min_value=pd.Timestamp("1700-01-01").to_pydatetime(),
+    max_value=pd.Timestamp("2200-12-31").to_pydatetime())
+
+
+@pytest.mark.skipif(not _native_available(), reason="native unavailable")
+@settings(max_examples=60, deadline=None)
+@given(st.lists(timestamps, min_size=1, max_size=8),
+       st.sampled_from(["%Y-%m-%dT%H:%M:%S", "%Y-%m-%d %H:%M:%S",
+                        "%Y-%m-%d"]),
+       st.integers(min_value=0, max_value=9))
+def test_native_iso_parse_matches_pandas(tmp_path_factory, dts, fmt, frac):
+    from tse1m_tpu.native import fetch_table
+
+    texts = []
+    for dt in dts:
+        s = dt.strftime(fmt)
+        if frac and "%H" in fmt:
+            digits = str(dt.microsecond).zfill(6)[:frac].ljust(frac, "0")
+            s += "." + digits
+        texts.append(s)
+    d = tmp_path_factory.mktemp("prop_iso")
+    p = str(d / "t.sqlite")
+    con = sqlite3.connect(p)
+    con.execute("CREATE TABLE t (ts TEXT)")
+    con.executemany("INSERT INTO t VALUES (?)", [(s,) for s in texts])
+    con.commit()
+    con.close()
+    (got,) = fetch_table(p, "SELECT ts FROM t", (), "t", [])
+    exp = (pd.to_datetime(pd.Series(texts), format="ISO8601").to_numpy()
+           .astype("datetime64[ns]").astype(np.int64))
+    os.unlink(p)
+    np.testing.assert_array_equal(got, exp)
+
+
+# -- pg array literal round-trip ----------------------------------------------
+
+array_items = st.lists(
+    st.text(alphabet=st.characters(blacklist_categories=("Cs",)),
+            max_size=30),
+    max_size=6)
+
+
+@settings(max_examples=200, deadline=None)
+@given(array_items)
+def test_pg_array_literal_roundtrip(items):
+    lit = pg_array_literal(items)
+    assert parse_array(lit) == [str(i) for i in items]
+
+
+# -- rev_hash invariances -----------------------------------------------------
+
+@settings(max_examples=100, deadline=None)
+@given(st.lists(st.text(alphabet="abcdef0123456789", min_size=1,
+                        max_size=12), min_size=1, max_size=8))
+def test_rev_hash_order_invariant_and_nonnegative(revs):
+    rng = np.random.default_rng(1)
+    shuffled = list(revs)
+    rng.shuffle(shuffled)
+    assert rev_hash(revs) == rev_hash(shuffled)  # set semantics (rq3:280)
+    assert rev_hash(revs) >= 0                   # 63-bit
+
+
+@settings(max_examples=60, deadline=None)
+@given(st.lists(st.text(min_size=1, max_size=8), min_size=1, max_size=5),
+       st.text(min_size=1, max_size=8))
+def test_rev_hash_sensitive_to_membership(revs, extra):
+    if extra in revs:
+        revs = [r for r in revs if r != extra] or ["x"]
+        if extra in revs:
+            return
+    assert rev_hash(revs) != rev_hash(revs + [extra])
+
+
+# -- segment_searchsorted vs numpy per segment --------------------------------
+
+@settings(max_examples=60, deadline=None)
+@given(st.data())
+def test_segment_searchsorted_matches_numpy(data):
+    rng_seed = data.draw(st.integers(0, 2**31 - 1))
+    rng = np.random.default_rng(rng_seed)
+    P = data.draw(st.integers(1, 5))
+    counts = rng.integers(0, 12, size=P)
+    off = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+    vals = rng.integers(-50, 50, size=int(off[-1])).astype(np.int32)
+    vals = np.concatenate(
+        [np.sort(vals[a:b]) for a, b in zip(off, off[1:])]) if off[-1] \
+        else vals
+    q = data.draw(st.integers(1, 16))
+    seg = rng.integers(0, P, size=q).astype(np.int32)
+    queries = rng.integers(-60, 60, size=q).astype(np.int32)
+    side = data.draw(st.sampled_from(["left", "right"]))
+    got = np.asarray(segment_searchsorted(
+        jnp.asarray(vals), jnp.asarray(off, jnp.int32),
+        jnp.asarray(queries), jnp.asarray(seg), side=side))
+    exp = np.array([
+        np.searchsorted(vals[off[s]:off[s + 1]], qv, side=side)
+        for s, qv in zip(seg, queries)], dtype=np.int32)
+    np.testing.assert_array_equal(got, exp)
+
+
+# -- windowed map ordering ----------------------------------------------------
+
+@settings(max_examples=50, deadline=None)
+@given(st.lists(st.integers(0, 1000), max_size=40),
+       st.integers(1, 10))
+def test_windowed_map_preserves_order(items, window):
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(4) as pool:
+        got = list(_windowed_map(pool, lambda x: x * 2, items, window))
+    assert got == [x * 2 for x in items]

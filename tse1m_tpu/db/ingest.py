@@ -41,10 +41,17 @@ def canon_result(value: str | None) -> str:
 
 def _split_pg_array(body: str) -> list[str]:
     """Tokenise the body of a Postgres array literal, honouring double-quoted
-    items containing commas/braces and backslash escapes."""
-    items: list[str] = []
+    items containing commas/braces and backslash escapes.
+
+    Quoted items are preserved verbatim — including empty strings and
+    leading/trailing whitespace (`{""}` is a one-element array in Postgres);
+    unquoted tokens are stripped and dropped when empty, matching how the
+    reference's loosely-formatted CSV arrays behave.  Round-trip with
+    `pg_array_literal` is property-tested (tests/test_properties.py)."""
+    items: list[tuple[str, bool]] = []
     buf: list[str] = []
     in_quotes = False
+    was_quoted = False
     i = 0
     while i < len(body):
         c = body[i]
@@ -59,15 +66,25 @@ def _split_pg_array(body: str) -> list[str]:
                 buf.append(c)
         elif c == '"':
             in_quotes = True
+            was_quoted = True
         elif c == ",":
-            items.append("".join(buf).strip())
+            items.append(("".join(buf), was_quoted))
             buf = []
+            was_quoted = False
         else:
             buf.append(c)
         i += 1
-    if buf or items:
-        items.append("".join(buf).strip())
-    return [it for it in items if it]
+    if buf or was_quoted or items:
+        items.append(("".join(buf), was_quoted))
+    out: list[str] = []
+    for text, quoted in items:
+        if quoted:
+            out.append(text)
+        else:
+            text = text.strip()
+            if text:
+                out.append(text)
+    return out
 
 
 def parse_array(value) -> list[str]:
@@ -93,7 +110,11 @@ def pg_array_literal(items: Sequence[str]) -> str:
     out = []
     for item in items:
         s = str(item)
-        if s == "" or any(c in s for c in ',{}" \\'):
+        # Quote anything the unquoted grammar could mangle: delimiters,
+        # backslashes, items with leading/trailing (or any) whitespace —
+        # unquoted tokens are stripped on parse — and empty strings.
+        if s == "" or s != s.strip() or any(
+                c in s for c in ',{}" \\') or not s.isprintable():
             s = '"' + s.replace("\\", "\\\\").replace('"', '\\"') + '"'
         out.append(s)
     return "{" + ",".join(out) + "}"
