@@ -159,11 +159,24 @@ def main() -> int:
     dev = jax.devices()[0]
     params = ClusterParams(n_hashes=args.hashes, n_bands=args.bands)
 
+    # TSE1M_PROFILE_DIR=<dir> wraps ONE steady-state run in a
+    # jax.profiler trace (same knob utils/timing.py gives the RQ drivers)
+    # — open the trace with tensorboard/xprof to see the on-device stage
+    # breakdown that wall clocks can't separate over a remote PJRT link.
+    profile_dir = os.environ.get("TSE1M_PROFILE_DIR")
+
     def timed(prm):
+        import contextlib
+
         runs = []
-        for _ in range(iters):
+        for i in range(iters):
+            ctx = contextlib.nullcontext()
+            if profile_dir and i == 0:
+                ctx = jax.profiler.trace(
+                    os.path.join(profile_dir, "cluster"))
             t0 = time.perf_counter()
-            labels = cluster_sessions(items, prm)
+            with ctx:
+                labels = cluster_sessions(items, prm)
             runs.append(time.perf_counter() - t0)
         return labels, runs
 
