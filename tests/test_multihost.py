@@ -70,3 +70,33 @@ def test_sharded_hist_on_process_local_array_matches_oracle():
 
 def test_all_processes_ready_noop_single_process():
     multihost.all_processes_ready("test")  # must not raise or block
+
+
+def test_cluster_sessions_accepts_presharded_global_array():
+    """The multi-host feeding path: a pre-sharded jax.Array (assembled via
+    put_process_local) must cluster identically to the numpy-input mesh
+    path."""
+    from tse1m_tpu.cluster import ClusterParams, cluster_sessions
+    from tse1m_tpu.data.synth import synth_session_sets
+
+    mesh = multihost.global_mesh()
+    n = 8 * 40
+    items, _ = synth_session_sets(n, set_size=16, seed=9)
+    params = ClusterParams(n_hashes=32, n_bands=4, use_pallas="never")
+    lo, hi = multihost.local_row_range(n)
+    arr = multihost.put_process_local(
+        np.ascontiguousarray(items[lo:hi], dtype=np.uint32), n, mesh)
+    got = cluster_sessions(arr, params, mesh=mesh)
+    want = cluster_sessions(items, params, mesh=mesh)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_cluster_sessions_rejects_unpadded_presharded():
+    from tse1m_tpu.cluster import ClusterParams, cluster_sessions
+    from tse1m_tpu.data.synth import synth_session_sets
+
+    mesh = multihost.global_mesh()
+    items, _ = synth_session_sets(8 * 3 + 1, set_size=16, seed=9)
+    arr = jnp.asarray(items.astype(np.uint32))
+    with pytest.raises(ValueError, match="padded"):
+        cluster_sessions(arr, ClusterParams(use_pallas="never"), mesh=mesh)

@@ -234,15 +234,39 @@ def _cmd_collect(args) -> int:
 
 def _cmd_cluster(args) -> int:
     """North-star session dedup: MinHash+LSH clustering with an ARI report
-    against the planted truth (and the host oracle on a subsample)."""
+    against the planted truth (and the host oracle on a subsample).
+
+    Multi-host aware: under TSE1M_COORDINATOR/…_NUM_PROCESSES (see
+    parallel/multihost.py) each process generates only its row slice,
+    the mesh spans every host's devices, and a barrier keeps the report
+    phase from racing slow hosts.  Single-process this degrades to the
+    plain local run."""
     import json
 
     from .cluster import ClusterParams, adjusted_rand_index, cluster_sessions, host_cluster
     from .data.synth import synth_session_sets
+    from .parallel import multihost
 
+    distributed = multihost.initialize_from_env()
     items, truth = synth_session_sets(args.n, seed=args.seed)
     params = ClusterParams(seed=args.seed)
-    labels = cluster_sessions(items, params)
+    if distributed:
+        import numpy as np
+
+        mesh = multihost.global_mesh()
+        # Pad the global row count to the mesh, feed only this process's
+        # contiguous slice, and cluster the pre-sharded global array.
+        n_pad = -(-args.n // mesh.devices.size) * mesh.devices.size
+        pad = np.zeros((n_pad - args.n,) + items.shape[1:], items.dtype)
+        padded = np.concatenate([items, pad])
+        lo, hi = multihost.local_row_range(n_pad)
+        items_d = multihost.put_process_local(
+            np.ascontiguousarray(padded[lo:hi], dtype=np.uint32),
+            n_pad, mesh)
+        labels = cluster_sessions(items_d, params, mesh=mesh)[:args.n]
+        multihost.all_processes_ready("cluster-report")
+    else:
+        labels = cluster_sessions(items, params)
     ari = adjusted_rand_index(labels, truth)
     k = min(args.ari_sample, args.n)
     report = {"n_sessions": args.n,

@@ -83,19 +83,31 @@ def cluster_sessions(items, params: ClusterParams | None = None,
     params = params or ClusterParams()
     a, b = make_hash_params(params.n_hashes, params.seed)
     a, b = jnp.asarray(a), jnp.asarray(b)
-    items = np.ascontiguousarray(items, dtype=np.uint32)
 
     if mesh is not None:
         from ..parallel.mesh import pad_to_devices
 
         sharding = jax.sharding.NamedSharding(
             mesh, jax.sharding.PartitionSpec(axis, None))
-        n = items.shape[0]
-        items, _ = pad_to_devices(items, mesh)
-        items_d = jax.device_put(items, sharding)
+        if isinstance(items, jax.Array):
+            # Pre-sharded global array (the multi-host feeding path:
+            # parallel/multihost.put_process_local — no single host holds
+            # all rows, so there is nothing to pad or device_put here).
+            if items.shape[0] % mesh.devices.size:
+                raise ValueError(
+                    "pre-sharded items must be padded to a multiple of the "
+                    "mesh size (see parallel/multihost.local_row_range)")
+            n = items.shape[0]
+            items_d = items
+        else:
+            items = np.ascontiguousarray(items, dtype=np.uint32)
+            n = items.shape[0]
+            items, _ = pad_to_devices(items, mesh)
+            items_d = jax.device_put(items, sharding)
         labels = _cluster_sharded(items_d, a, b, sharding, params.n_bands,
                                   params.threshold, params.n_iters)
         return np.asarray(labels)[:n]
+    items = np.ascontiguousarray(items, dtype=np.uint32)
 
     if params.use_pallas != "never":
         sig, keys = _minhash_streamed(items, a, b, params)

@@ -15,7 +15,7 @@ import os
 import threading
 import time
 import urllib.parse
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Protocol
 
 from ..utils.logging import get_logger
