@@ -192,6 +192,41 @@ def main() -> int:
         labels, runs = timed(params)
 
     wall = statistics.median(runs)
+
+    def compute_only() -> float:
+        """Device-compute wall with items already resident on device —
+        separates real kernel time from host->device link noise (on this
+        tunneled PJRT setup the same 192MB upload varies ~2x run-to-run,
+        dominating `value`; on a co-located TPU VM the two converge).
+        Sync via a 4-byte D2H: block_until_ready does not actually block
+        over the tunnel."""
+        import jax
+
+        from tse1m_tpu.cluster.minhash import make_hash_params
+        from tse1m_tpu.cluster.minhash_pallas import minhash_and_keys
+        from tse1m_tpu.cluster.pipeline import _cluster_from_sig_jit
+
+        a, b = make_hash_params(params.n_hashes, params.seed)
+        items_d = jax.device_put(items)
+        float(items_d[0, 0])  # finish the staging transfer
+        samples = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            sig, keys = minhash_and_keys(items_d, a, b, params.n_bands,
+                                         use_pallas=params.use_pallas,
+                                         block_n=params.block_n)
+            lab = _cluster_from_sig_jit(sig, keys, params.threshold,
+                                        params.n_iters)
+            float(lab[0])
+            samples.append(time.perf_counter() - t0)
+        return statistics.median(samples)
+
+    try:
+        compute_s = compute_only()
+    except Exception as e:
+        print(f"# compute-only probe failed ({type(e).__name__}: {e})",
+              file=sys.stderr)
+        compute_s = None
     ari = adjusted_rand_index(labels, truth)
     ari_host = None
     if args.ari_sample > 0:
@@ -213,6 +248,10 @@ def main() -> int:
         "vs_baseline": round(60.0 / wall, 2),
         "best_s": round(min(runs), 4),
         "runs_s": [round(r, 4) for r in runs],
+        # Kernel time with items device-resident (median of 3) — the
+        # link-noise-free floor of `value`.
+        "compute_only_s": (round(compute_s, 4)
+                           if compute_s is not None else None),
         "ari_vs_planted": round(ari, 5),
         "n_sessions": args.n,
         "n_hashes": args.hashes,
