@@ -106,6 +106,14 @@ def cluster_sessions(items, params: ClusterParams | None = None,
             items_d = jax.device_put(items, sharding)
         labels = _cluster_sharded(items_d, a, b, sharding, params.n_bands,
                                   params.threshold, params.n_iters)
+        if jax.process_count() > 1:
+            # Multi-host: shards live on non-addressable devices, so a
+            # plain np.asarray would fail — allgather across processes
+            # (rides DCN; every host gets the full label vector).
+            from jax.experimental import multihost_utils
+
+            return np.asarray(
+                multihost_utils.process_allgather(labels, tiled=True))[:n]
         return np.asarray(labels)[:n]
     items = np.ascontiguousarray(items, dtype=np.uint32)
 

@@ -51,15 +51,24 @@ def initialize_from_env() -> bool:
     True when a multi-process runtime is (already or newly) active.
     Idempotent: a second call is a no-op.
     """
-    if jax.process_count() > 1:
-        return True
     coord = os.environ.get(_ENV_COORD)
     nproc = os.environ.get(_ENV_NPROC)
     if not coord or not nproc or int(nproc) <= 1:
-        return False
+        # No env config: report the current runtime state.  (Only safe to
+        # query here — jax.process_count() initialises the backend, which
+        # must not happen before jax.distributed.initialize when a
+        # multi-process bring-up IS requested.)
+        return jax.process_count() > 1
     pid = int(os.environ.get(_ENV_PID, "0"))
-    jax.distributed.initialize(coordinator_address=coord,
-                               num_processes=int(nproc), process_id=pid)
+    try:
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=int(nproc), process_id=pid)
+    except RuntimeError:
+        # Already initialised (idempotent second call) — anything else
+        # (backend up before init, unreachable coordinator) re-raises.
+        if jax.process_count() > 1:
+            return True
+        raise
     log.info("jax.distributed up: process %d/%d, %d global devices",
              jax.process_index(), jax.process_count(), jax.device_count())
     return True
