@@ -56,7 +56,33 @@ WORKER = textwrap.dedent("""
         arr, ClusterParams(n_hashes=32, n_bands=4, use_pallas="never"),
         mesh=mesh)
     multihost.all_processes_ready("labels-done")
-    np.save(out, labels)
+
+    # Flagship RQ on the same global mesh: every process builds the same
+    # deterministic study and the sharded RQ1 kernel reduces across hosts.
+    import tempfile
+    from tse1m_tpu.backend.jax_backend import JaxBackend
+    from tse1m_tpu.config import Config
+    from tse1m_tpu.data.columnar import StudyArrays
+    from tse1m_tpu.data.synth import SynthSpec, generate_study
+    from tse1m_tpu.db.connection import DB
+
+    with tempfile.TemporaryDirectory() as d:
+        study = generate_study(SynthSpec(n_projects=6, days=380, seed=seed))
+        cfg = Config(engine="sqlite",
+                     sqlite_path=os.path.join(d, "s.sqlite"),
+                     limit_date="2026-01-01")
+        db = DB(config=cfg).connect()
+        study.to_db(db)
+        arrays = StudyArrays.from_db(db, cfg)
+        db.closeConnection()
+    limit_ns = int(np.datetime64(cfg.limit_date, "ns").astype(np.int64))
+    rq1 = JaxBackend(mesh=mesh).rq1_detection(arrays, limit_ns,
+                                              min_projects=2)
+    multihost.all_processes_ready("rq1-done")
+    np.savez(out, labels=labels, rq1_iterations=rq1.iterations,
+             rq1_total=rq1.total_projects, rq1_detected=rq1.detected_counts,
+             rq1_iter_of_issue=rq1.iteration_of_issue,
+             rq1_link=rq1.link_idx)
     print("WORKER_OK", jax.process_index(), flush=True)
 """)
 
@@ -72,7 +98,7 @@ def test_two_process_cluster_matches_single_process(tmp_path):
     script = tmp_path / "worker.py"
     script.write_text(WORKER)
     procs = []
-    outs = [str(tmp_path / f"labels_{p}.npy") for p in range(2)]
+    outs = [str(tmp_path / f"out_{p}.npz") for p in range(2)]
     for p in range(2):
         env = dict(os.environ)
         env.pop("XLA_FLAGS", None)  # worker sets its own device count
@@ -93,13 +119,39 @@ def test_two_process_cluster_matches_single_process(tmp_path):
         assert p.returncode == 0, (out[-2000:], errtxt[-2000:])
         assert "WORKER_OK" in out
 
-    # Single-process oracle on the identical deterministic study.
+    # Single-process oracles on the identical deterministic inputs.
+    import tempfile
+
+    from tse1m_tpu.backend.pandas_backend import PandasBackend
     from tse1m_tpu.cluster import ClusterParams, cluster_sessions
-    from tse1m_tpu.data.synth import synth_session_sets
+    from tse1m_tpu.config import Config
+    from tse1m_tpu.data.columnar import StudyArrays
+    from tse1m_tpu.data.synth import (SynthSpec, generate_study,
+                                      synth_session_sets)
+    from tse1m_tpu.db.connection import DB
 
     items, _ = synth_session_sets(N, set_size=16, seed=SEED)
     want = cluster_sessions(
         items, ClusterParams(n_hashes=32, n_bands=4, use_pallas="never"))
+    with tempfile.TemporaryDirectory() as d:
+        study = generate_study(SynthSpec(n_projects=6, days=380, seed=SEED))
+        cfg = Config(engine="sqlite",
+                     sqlite_path=os.path.join(d, "s.sqlite"),
+                     limit_date="2026-01-01")
+        db = DB(config=cfg).connect()
+        study.to_db(db)
+        arrays = StudyArrays.from_db(db, cfg)
+        db.closeConnection()
+    limit_ns = int(np.datetime64(cfg.limit_date, "ns").astype(np.int64))
+    rq1 = PandasBackend().rq1_detection(arrays, limit_ns, min_projects=2)
+
     for out_path in outs:
         got = np.load(out_path)
-        np.testing.assert_array_equal(got, want)
+        np.testing.assert_array_equal(got["labels"], want)
+        np.testing.assert_array_equal(got["rq1_iterations"], rq1.iterations)
+        np.testing.assert_array_equal(got["rq1_total"], rq1.total_projects)
+        np.testing.assert_array_equal(got["rq1_detected"],
+                                      rq1.detected_counts)
+        np.testing.assert_array_equal(got["rq1_iter_of_issue"],
+                                      rq1.iteration_of_issue)
+        np.testing.assert_array_equal(got["rq1_link"], rq1.link_idx)

@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import shard_map
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.segment import masked_mean, masked_spearman, segment_searchsorted
 from .mesh import make_mesh
@@ -63,6 +63,44 @@ def _pad_rows(x: np.ndarray, n_dev: int, fill) -> np.ndarray:
         return x
     block = np.full((pad,) + x.shape[1:], fill, dtype=x.dtype)
     return np.concatenate([x, block], axis=0)
+
+
+def _placed(mesh: Mesh, x, spec: P):
+    """Host array -> device array laid out per ``spec`` for ``mesh``.
+
+    Single-process this is a plain `jnp.asarray` (jit moves it; behavior
+    identical to the original kernels).  Multi-process — where the mesh
+    spans non-addressable devices and a host array cannot be device_put
+    globally — every process passes the IDENTICAL full array and this hands
+    `jax.make_array_from_process_local_data` only the process-local block
+    of the (at most one) mesh-sharded dim.  Dims are pre-padded to the
+    device count, which the per-process device counts divide evenly.
+    """
+    x = np.asarray(x)
+    if jax.process_count() == 1:
+        return jnp.asarray(x)
+    sharding = NamedSharding(mesh, spec)
+    dims = [i for i, s in enumerate(spec) if s == AXIS]
+    if not dims:
+        return jax.make_array_from_process_local_data(sharding, x, x.shape)
+    d = dims[0]
+    per = x.shape[d] // jax.process_count()
+    sl = [slice(None)] * x.ndim
+    sl[d] = slice(jax.process_index() * per, (jax.process_index() + 1) * per)
+    return jax.make_array_from_process_local_data(
+        sharding, np.ascontiguousarray(x[tuple(sl)]), x.shape)
+
+
+def _fetch(out) -> np.ndarray:
+    """Kernel output -> host numpy.  Multi-process, sharded outputs live
+    partly on non-addressable devices, so gather across processes first
+    (rides DCN); fully-replicated outputs and all single-process outputs
+    materialise directly."""
+    if jax.process_count() > 1 and not out.is_fully_replicated:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(out, tiled=True))
+    return np.asarray(out)
 
 
 # ---------------------------------------------------------------------------
@@ -112,14 +150,14 @@ def rq1_kernel_mesh(mesh: Mesh, fuzz_s, fuzz_ns, fuzz_offsets,
         return it, link, detected
 
     it, link, detected = kernel(
-        jnp.asarray(issue_s), jnp.asarray(issue_ns), jnp.asarray(issue_seg),
-        jnp.asarray(valid),
-        jnp.asarray(fuzz_s), jnp.asarray(fuzz_ns),
-        jnp.asarray(fuzz_offsets, dtype=jnp.int32),
-        jnp.asarray(ok_s), jnp.asarray(ok_ns),
-        jnp.asarray(ok_offsets, dtype=jnp.int32),
-        jnp.asarray(ok_orig_idx, dtype=jnp.int32))
-    return (np.asarray(it)[:q], np.asarray(link)[:q], np.asarray(detected))
+        _placed(mesh, issue_s, P(AXIS)), _placed(mesh, issue_ns, P(AXIS)),
+        _placed(mesh, issue_seg, P(AXIS)), _placed(mesh, valid, P(AXIS)),
+        _placed(mesh, fuzz_s, P()), _placed(mesh, fuzz_ns, P()),
+        _placed(mesh, np.asarray(fuzz_offsets, dtype=np.int32), P()),
+        _placed(mesh, ok_s, P()), _placed(mesh, ok_ns, P()),
+        _placed(mesh, np.asarray(ok_offsets, dtype=np.int32), P()),
+        _placed(mesh, np.asarray(ok_orig_idx, dtype=np.int32), P()))
+    return (_fetch(it)[:q], _fetch(link)[:q], _fetch(detected))
 
 
 # ---------------------------------------------------------------------------
@@ -165,10 +203,12 @@ def percentile_by_session_mesh(cols, colmask, q, mesh: Mesh):
         vhi = jnp.take_along_axis(srt, hi_.T, axis=-1).T
         return vlo, vhi
 
-    vlo, vhi = kernel(jnp.asarray(cols), jnp.asarray(colmask),
-                      jnp.asarray(lo), jnp.asarray(hi))
-    vlo = np.asarray(vlo, dtype=np.float32)
-    vhi = np.asarray(vhi, dtype=np.float32)
+    vlo, vhi = kernel(_placed(mesh, cols, P(AXIS, None)),
+                      _placed(mesh, colmask, P(AXIS, None)),
+                      _placed(mesh, lo, P(None, AXIS)),
+                      _placed(mesh, hi, P(None, AXIS)))
+    vlo = _fetch(vlo).astype(np.float32)
+    vhi = _fetch(vhi).astype(np.float32)
     hi_valid = (lo + 1) <= (n_valid[None, :] - 1)
     out = vlo + np.where(hi_valid, frac * (vhi - vlo), np.float32(0.0))
     out = np.where(n_valid[None, :] > 0, out, np.float32(np.nan))
@@ -188,8 +228,9 @@ def mean_by_session_mesh(cols, colmask, mesh: Mesh):
     def kernel(x, m):
         return masked_mean(x, m)
 
-    return np.asarray(kernel(jnp.asarray(cols), jnp.asarray(colmask)),
-                      dtype=np.float64)[:s]
+    return _fetch(kernel(_placed(mesh, cols, P(AXIS, None)),
+                         _placed(mesh, colmask, P(AXIS, None)))
+                  ).astype(np.float64)[:s]
 
 
 def counts_by_project_psum(mask, mesh: Mesh) -> np.ndarray:
@@ -206,7 +247,8 @@ def counts_by_project_psum(mask, mesh: Mesh) -> np.ndarray:
     def kernel(m):
         return jax.lax.psum(m.sum(axis=0, dtype=jnp.int32), AXIS)
 
-    return np.asarray(kernel(jnp.asarray(mask)), dtype=np.int64)
+    return _fetch(kernel(_placed(mesh, mask, P(AXIS, None)))
+                  ).astype(np.int64)
 
 
 def spearman_by_project_mesh(matrix, mask, mesh: Mesh):
@@ -223,8 +265,9 @@ def spearman_by_project_mesh(matrix, mask, mesh: Mesh):
     def kernel(x, m):
         return masked_spearman(x, m)
 
-    return np.asarray(kernel(jnp.asarray(matrix), jnp.asarray(mask)),
-                      dtype=np.float64)[:p]
+    return _fetch(kernel(_placed(mesh, matrix, P(AXIS, None)),
+                         _placed(mesh, mask, P(AXIS, None)))
+                  ).astype(np.float64)[:p]
 
 
 # ---------------------------------------------------------------------------
@@ -282,11 +325,12 @@ def nanpercentile_by_session_mesh(sub: np.ndarray, q, mesh: Mesh) -> np.ndarray:
             vhi = jnp.take_along_axis(srt, hi.T, axis=-1).T
             return vlo, vhi, n
 
-        vlo, vhi, n = kernel(jnp.asarray(cols, dtype=jnp.float64))
+        vlo, vhi, n = kernel(_placed(mesh, cols.astype(np.float64),
+                                     P(AXIS, None)))
 
-    vlo = np.asarray(vlo, dtype=np.float64)[:, :s]
-    vhi = np.asarray(vhi, dtype=np.float64)[:, :s]
-    n = np.asarray(n, dtype=np.int64)[:s]
+        vlo = _fetch(vlo).astype(np.float64)[:, :s]
+        vhi = _fetch(vhi).astype(np.float64)[:, :s]
+        n = _fetch(n).astype(np.int64)[:s]
     pos = (n - 1).astype(np.float64) * qf[:, None]
     gamma = pos - np.floor(pos)
     with np.errstate(invalid="ignore"):
@@ -330,7 +374,8 @@ def segment_searchsorted_mesh(mesh: Mesh, values_s, offsets, queries_s,
         return segment_searchsorted(vals, off, queries, seg_, side=side,
                                     values_lo=vals_lo, queries_lo=queries_lo_)
 
-    out = kernel(jnp.asarray(qs), jnp.asarray(qlo), jnp.asarray(seg),
-                 jnp.asarray(values_s), jnp.asarray(values_lo),
-                 jnp.asarray(offsets, dtype=jnp.int32))
-    return np.asarray(out)[:q]
+    out = kernel(_placed(mesh, qs, P(AXIS)), _placed(mesh, qlo, P(AXIS)),
+                 _placed(mesh, seg, P(AXIS)),
+                 _placed(mesh, values_s, P()), _placed(mesh, values_lo, P()),
+                 _placed(mesh, np.asarray(offsets, dtype=np.int32), P()))
+    return _fetch(out)[:q]
