@@ -76,13 +76,19 @@ WORKER = textwrap.dedent("""
         arrays = StudyArrays.from_db(db, cfg)
         db.closeConnection()
     limit_ns = int(np.datetime64(cfg.limit_date, "ns").astype(np.int64))
-    rq1 = JaxBackend(mesh=mesh).rq1_detection(arrays, limit_ns,
-                                              min_projects=2)
-    multihost.all_processes_ready("rq1-done")
+    backend = JaxBackend(mesh=mesh)
+    rq1 = backend.rq1_detection(arrays, limit_ns, min_projects=2)
+    # rq2 trends exercises the session/project-sharded percentile, mean,
+    # Spearman and psum-count kernels (the P(None, AXIS) placements RQ1
+    # never touches).
+    rq2 = backend.rq2_trends(arrays, limit_ns)
+    multihost.all_processes_ready("rq-done")
     np.savez(out, labels=labels, rq1_iterations=rq1.iterations,
              rq1_total=rq1.total_projects, rq1_detected=rq1.detected_counts,
              rq1_iter_of_issue=rq1.iteration_of_issue,
-             rq1_link=rq1.link_idx)
+             rq1_link=rq1.link_idx,
+             rq2_spearman=rq2.spearman, rq2_percentiles=rq2.percentiles,
+             rq2_mean=rq2.mean, rq2_counts=rq2.counts)
     print("WORKER_OK", jax.process_index(), flush=True)
 """)
 
@@ -144,6 +150,9 @@ def test_two_process_cluster_matches_single_process(tmp_path):
         db.closeConnection()
     limit_ns = int(np.datetime64(cfg.limit_date, "ns").astype(np.int64))
     rq1 = PandasBackend().rq1_detection(arrays, limit_ns, min_projects=2)
+    from tse1m_tpu.backend.jax_backend import JaxBackend
+
+    rq2 = JaxBackend(mesh=None).rq2_trends(arrays, limit_ns)
 
     for out_path in outs:
         got = np.load(out_path)
@@ -155,3 +164,8 @@ def test_two_process_cluster_matches_single_process(tmp_path):
         np.testing.assert_array_equal(got["rq1_iter_of_issue"],
                                       rq1.iteration_of_issue)
         np.testing.assert_array_equal(got["rq1_link"], rq1.link_idx)
+        np.testing.assert_array_equal(got["rq2_spearman"], rq2.spearman)
+        np.testing.assert_array_equal(got["rq2_percentiles"],
+                                      rq2.percentiles)
+        np.testing.assert_array_equal(got["rq2_mean"], rq2.mean)
+        np.testing.assert_array_equal(got["rq2_counts"], rq2.counts)
