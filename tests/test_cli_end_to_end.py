@@ -53,6 +53,7 @@ def test_cli_stats(synth_db):
     assert "projects" in proc.stdout.lower()
 
 
+@pytest.mark.slow
 def test_cli_all_runs_every_rq(synth_db, workdir):
     out = os.path.join(workdir, "results")
     proc = run_cli(["all", "--db", synth_db, "--backend", "jax_tpu",
@@ -75,6 +76,7 @@ def test_cli_all_runs_every_rq(synth_db, workdir):
     assert recorded.get("backend") == "jax_tpu"
 
 
+@pytest.mark.slow
 def test_cli_cluster_demo():
     proc = run_cli(["cluster", "--n", "4096", "--ari-sample", "1024"],
                    cwd="/root/repo")

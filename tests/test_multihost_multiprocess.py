@@ -99,6 +99,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+@pytest.mark.slow
 def test_two_process_cluster_matches_single_process(tmp_path):
     port = _free_port()
     script = tmp_path / "worker.py"

@@ -33,6 +33,7 @@ def env(tmp_path_factory):
     return e
 
 
+@pytest.mark.slow
 def test_run_all_analysis_script(env):
     proc = subprocess.run(["bash", "run_all_analysis.sh"], cwd="/root/repo",
                           env=env, capture_output=True, text=True,
@@ -47,6 +48,7 @@ def test_run_all_analysis_script(env):
         assert os.path.exists(os.path.join(out, artifact)), artifact
 
 
+@pytest.mark.slow
 def test_single_shim_runs_standalone(env):
     """A reference user can also invoke one RQ script directly
     (run_all_analysis.sh:17 does exactly this)."""
