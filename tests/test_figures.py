@@ -59,3 +59,33 @@ def test_rq2_gated_figures_written(figure_run):
 def test_rq4b_gated_boxplot_written(figure_run):
     _assert_pdf(os.path.join(figure_run, "rq4", "coverage",
                              "g2_g1_boxplot_comparison.pdf"))
+
+
+class _FakePrePost:
+    """Just enough surface for plot_transition_venn."""
+
+    kept_projects = ["a", "b", "c", "d", "e"]
+
+    @staticmethod
+    def transition_counts():
+        return {"pre_only": 2, "post_only": 1, "pre_and_post": 1,
+                "no_detection": 1}
+
+
+@pytest.mark.parametrize("with_venn", [True, False])
+def test_rq4a_venn_writer_both_paths(tmp_path, monkeypatch, with_venn):
+    """plot_transition_venn must emit a PDF whether matplotlib-venn is
+    installed or not (the reference hard-requires it, requirements.txt;
+    our writer falls back to raw matplotlib circles)."""
+    from tse1m_tpu.analysis import rq4a
+
+    if not with_venn:
+        # A None entry makes `from matplotlib_venn import venn2` raise
+        # ImportError even when the real package is installed.
+        monkeypatch.setitem(__import__("sys").modules, "matplotlib_venn",
+                            None)
+    else:
+        pytest.importorskip("matplotlib_venn")
+    path = tmp_path / f"venn_{with_venn}.pdf"
+    rq4a.plot_transition_venn(_FakePrePost(), str(path))
+    _assert_pdf(str(path))

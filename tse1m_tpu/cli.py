@@ -237,10 +237,14 @@ def _cmd_cluster(args) -> int:
     against the planted truth (and the host oracle on a subsample).
 
     Multi-host aware: under TSE1M_COORDINATOR/…_NUM_PROCESSES (see
-    parallel/multihost.py) each process generates only its row slice,
-    the mesh spans every host's devices, and a barrier keeps the report
-    phase from racing slow hosts.  Single-process this degrades to the
-    plain local run."""
+    parallel/multihost.py) the mesh spans every host's devices and a
+    barrier keeps the report phase from racing slow hosts.  Note the
+    synthetic items are generated in full on every host (the planted-truth
+    permutation is global, so deterministic per-slice generation isn't
+    possible) and only this process's contiguous row slice is *fed* to the
+    devices — a real study would stream each host's slice from the DB
+    (parallel/multihost.local_row_range).  Single-process this degrades to
+    the plain local run."""
     import json
 
     from .cluster import ClusterParams, adjusted_rand_index, cluster_sessions, host_cluster

@@ -30,7 +30,10 @@ class ClusterParams:
     n_hashes: int = 128
     n_bands: int = 16
     threshold: float = 0.5       # min estimated Jaccard to accept an edge
-    n_iters: int = 12            # label-propagation jumps (2^12 chain cover)
+    n_iters: int = 12            # label-propagation safety cap (propagation
+    #                              converges early via its global all-done
+    #                              check, see lsh.propagate_labels; 12 jumps
+    #                              bound worst-case 2^12-long rep chains)
     seed: int = 0
     use_pallas: str = "auto"     # auto | never | force | interpret
     block_n: int = 512

@@ -59,7 +59,15 @@ def initialize_from_env() -> bool:
         # must not happen before jax.distributed.initialize when a
         # multi-process bring-up IS requested.)
         return jax.process_count() > 1
-    pid = int(os.environ.get(_ENV_PID, "0"))
+    pid_raw = os.environ.get(_ENV_PID)
+    if not pid_raw:  # unset OR empty (unsubstituted template var)
+        # Silent default-to-0 would make every host that forgot the var
+        # register as process 0 — the coordinator then hangs or fails with
+        # an opaque duplicate-registration error.  Fail fast instead.
+        raise RuntimeError(
+            f"{_ENV_COORD} and {_ENV_NPROC}={nproc} are set but {_ENV_PID} "
+            "is not; every process must export its unique id (0..n-1)")
+    pid = int(pid_raw)
     try:
         jax.distributed.initialize(coordinator_address=coord,
                                    num_processes=int(nproc), process_id=pid)
