@@ -6,6 +6,14 @@ search for issue->iteration indexing and linkage, a bincount survival curve
 for per-iteration populations, and a boolean scatter for unique detected
 projects.  Timestamps ride as two int32 lanes (seconds, ns remainder) so
 sub-second ordering matches the host backend exactly without enabling x64.
+
+Dispatch economics (single device): the study's CSR arrays are uploaded to
+the device ONCE per (StudyArrays, limit_date) and cached on the StudyArrays
+instance (`_study_cache`), and each RQ runs as ONE fused jit call returning
+ONE packed result buffer — so an RQ call costs one dispatch round-trip and
+one device->host fetch instead of re-staging ~30 MB of host arrays per call
+(the round-3 profile: 0.75 s/call re-upload vs ~0.11 s link round-trip
+floor on a tunneled PJRT backend).
 """
 
 from __future__ import annotations
@@ -20,8 +28,8 @@ from .base import (Backend, RQ1Result, RQ2ChangePointsResult, RQ2TrendsResult,
                    RQ3Result, RQ4aTrendResult, RQ4bTrendsResult)
 from .pandas_backend import DAY_NS, HOUR_NS, floor_day_ns
 from ..data.columnar import StudyArrays, ns_to_device_pair
-from ..ops.segment import (counts_to_survival, masked_mean, masked_percentile,
-                           masked_spearman, segment_searchsorted,
+from ..ops.segment import (counts_to_survival, masked_mean, masked_spearman,
+                           segment_searchsorted,
                            unique_pairs_count_per_iteration)
 from ..parallel import rq_mesh
 
@@ -35,9 +43,186 @@ def masked_csr(offsets: np.ndarray, mask: np.ndarray):
     return pos, running[offsets]
 
 
-@partial(jax.jit, static_argnames=("n_projects", "max_iter"))
-def _rq1_kernel(fuzz_s, fuzz_ns, fuzz_offsets, ok_s, ok_ns, ok_offsets, ok_orig_idx,
-                issue_s, issue_ns, issue_seg, n_projects: int, max_iter: int):
+# ---------------------------------------------------------------------------
+# Device-resident study cache
+# ---------------------------------------------------------------------------
+
+def _study_cache(arrays: StudyArrays, limit_date_ns: int) -> dict:
+    """The per-(StudyArrays, limit_date) device cache.
+
+    Stored on the StudyArrays instance (immutable after construction), keyed
+    by the study cutoff: all six RQ kernels share the same value-side CSR
+    arrays, so the H2D staging happens once per study instead of once per RQ
+    call.  A different cutoff invalidates the whole cache (the masked CSR
+    views depend on it)."""
+    fp = tuple(_table_token(t) for t in
+               (arrays.fuzz, arrays.covb, arrays.issues, arrays.cov))
+    cache = getattr(arrays, "_jax_dev_cache", None)
+    if (cache is None or cache.get("limit_ns") != limit_date_ns
+            or cache.get("fp") != fp):
+        # fp guards shallow copies that swap a table out (and with it the
+        # case of two StudyArrays sharing one cache attribute object).
+        cache = {"limit_ns": limit_date_ns, "fp": fp}
+        arrays._jax_dev_cache = cache
+    return cache
+
+
+_table_tokens = iter(range(1 << 62))
+
+
+def _table_token(table) -> int:
+    """Monotonic identity token per Segmented (set on first use).  Unlike
+    id(), tokens are never reused after an object dies, so a freed table
+    whose address is recycled can't alias a cache entry."""
+    tok = getattr(table, "_cache_token", None)
+    if tok is None:
+        tok = table._cache_token = next(_table_tokens)
+    return tok
+
+
+def _cached(cache: dict, key: str, build):
+    if key not in cache:
+        cache[key] = build()
+    return cache[key]
+
+
+def _dev_fuzz(arrays: StudyArrays, cache: dict):
+    """(fs_d, fns_d, foff32_d): full fuzz two-lane times, device-resident."""
+    def build():
+        fs, fns = ns_to_device_pair(arrays.fuzz.columns["time_ns"])
+        return (jax.device_put(fs), jax.device_put(fns),
+                jax.device_put(arrays.fuzz.offsets.astype(np.int32)))
+    return _cached(cache, "fuzz", build)
+
+
+def _host_fuzz_ok(arrays: StudyArrays, cache: dict, limit_date_ns: int):
+    """Host (pos, offsets) of the ok & pre-cutoff fuzz CSR — shared by RQ1's
+    linkage side and RQ3's last-successful-build scan (rq3:269)."""
+    def build():
+        t = arrays.fuzz.columns["time_ns"]
+        return masked_csr(arrays.fuzz.offsets,
+                          arrays.fuzz.columns["ok"] & (t < limit_date_ns))
+    return _cached(cache, "fuzz_ok_host", build)
+
+
+def _dev_fuzz_ok(arrays: StudyArrays, cache: dict, limit_date_ns: int):
+    """(oks_d, okns_d, okoff32_d, okpos32_d): device CSR of ok pre-cutoff
+    fuzz builds.  Times are gathered ON DEVICE from the cached full-fuzz
+    lanes, so only the ~4 B/row position index crosses the link."""
+    def build():
+        pos, off = _host_fuzz_ok(arrays, cache, limit_date_ns)
+        fs_d, fns_d, _ = _dev_fuzz(arrays, cache)
+        pos_d = jax.device_put(pos.astype(np.int32))
+        return (jnp.take(fs_d, pos_d), jnp.take(fns_d, pos_d),
+                jax.device_put(off.astype(np.int32)), pos_d)
+    return _cached(cache, "fuzz_ok", build)
+
+
+def _dev_issues(arrays: StudyArrays, cache: dict):
+    """(is_d, ins_d, seg32_d): issue report times and their project
+    segments (the query side of every RQ searchsorted)."""
+    def build():
+        seg = np.repeat(np.arange(arrays.n_projects),
+                        arrays.issues.counts()).astype(np.int32)
+        is_, ins = ns_to_device_pair(arrays.issues.columns["time_ns"])
+        return (jax.device_put(is_), jax.device_put(ins),
+                jax.device_put(seg))
+    return _cached(cache, "issues", build)
+
+
+def _host_covb_cut(arrays: StudyArrays, cache: dict, limit_date_ns: int):
+    """Host (pos, offsets) of coverage builds before cutoff+1 day (RQ3's
+    first-coverage-build scan fetches to the boundary day, rq3:263)."""
+    def build():
+        t = arrays.covb.columns["time_ns"]
+        return masked_csr(arrays.covb.offsets, t < limit_date_ns + DAY_NS)
+    return _cached(cache, "covb_cut_host", build)
+
+
+def _dev_covb_cut(arrays: StudyArrays, cache: dict, limit_date_ns: int):
+    def build():
+        pos, off = _host_covb_cut(arrays, cache, limit_date_ns)
+        cts, ctn = ns_to_device_pair(arrays.covb.columns["time_ns"][pos])
+        return (jax.device_put(cts), jax.device_put(ctn),
+                jax.device_put(off.astype(np.int32)))
+    return _cached(cache, "covb_cut", build)
+
+
+def _host_cov_valid(arrays: StudyArrays, cache: dict):
+    """Host (pos, offsets) of non-null daily-coverage rows (RQ3's day-after
+    join side, rq3:287-293)."""
+    def build():
+        return masked_csr(arrays.cov.offsets,
+                          ~np.isnan(arrays.cov.columns["covered"]))
+    return _cached(cache, "cov_valid_host", build)
+
+
+def _dev_cov_valid(arrays: StudyArrays, cache: dict):
+    def build():
+        pos, off = _host_cov_valid(arrays, cache)
+        dts, dtn = ns_to_device_pair(arrays.cov.columns["date_ns"][pos])
+        return (jax.device_put(dts), jax.device_put(dtn),
+                jax.device_put(off.astype(np.int32)))
+    return _cached(cache, "cov_valid", build)
+
+
+def _host_cov_cut(arrays: StudyArrays, cache: dict, limit_date_ns: int):
+    """Host (pos, offsets) of pre-cutoff daily-coverage rows (RQ2's same-day
+    join side; dates ascend per segment so the mask keeps a prefix)."""
+    def build():
+        return masked_csr(arrays.cov.offsets,
+                          arrays.cov.columns["date_ns"] < limit_date_ns)
+    return _cached(cache, "cov_cut_host", build)
+
+
+def _dev_cov_cut(arrays: StudyArrays, cache: dict, limit_date_ns: int):
+    def build():
+        pos, off = _host_cov_cut(arrays, cache, limit_date_ns)
+        ds, dns = ns_to_device_pair(arrays.cov.columns["date_ns"][pos])
+        return (jax.device_put(ds), jax.device_put(dns),
+                jax.device_put(off.astype(np.int32)))
+    return _cached(cache, "cov_cut", build)
+
+
+def _host_fuzz_cut(arrays: StudyArrays, cache: dict, limit_date_ns: int):
+    """Host (pos, offsets) of ALL pre-cutoff fuzz builds regardless of
+    result — RQ4a counts every build (rq4a_bug.py:128-134)."""
+    def build():
+        t = arrays.fuzz.columns["time_ns"]
+        return masked_csr(arrays.fuzz.offsets, t < limit_date_ns)
+    return _cached(cache, "fuzz_cut_host", build)
+
+
+def _dev_fuzz_cut(arrays: StudyArrays, cache: dict, limit_date_ns: int):
+    def build():
+        pos, off = _host_fuzz_cut(arrays, cache, limit_date_ns)
+        fs_d, fns_d, _ = _dev_fuzz(arrays, cache)
+        pos_d = jax.device_put(pos.astype(np.int32))
+        return (jnp.take(fs_d, pos_d), jnp.take(fns_d, pos_d),
+                jax.device_put(off.astype(np.int32)))
+    return _cached(cache, "fuzz_cut", build)
+
+
+def _dev_rq3_targets(arrays: StudyArrays, cache: dict):
+    """(qts_d, qtn_d): day-after-report midnights, the RQ3 day join key."""
+    def build():
+        target = floor_day_ns(arrays.issues.columns["time_ns"]) + DAY_NS
+        qts, qtn = ns_to_device_pair(target)
+        return jax.device_put(qts), jax.device_put(qtn)
+    return _cached(cache, "rq3_targets", build)
+
+
+# ---------------------------------------------------------------------------
+# Fused kernels (one dispatch + one packed D2H fetch per RQ call)
+# ---------------------------------------------------------------------------
+
+_seg_searchsorted_jit = jax.jit(segment_searchsorted,
+                                static_argnames=("side",))
+
+
+def _rq1_body(fuzz_s, fuzz_ns, fuzz_offsets, ok_s, ok_ns, ok_offsets,
+              ok_orig_idx, issue_s, issue_ns, issue_seg,
+              n_projects: int, max_iter: int):
     # Iteration of each issue: #builds (any result) strictly before rts.
     iteration_of_issue = segment_searchsorted(
         fuzz_s, fuzz_offsets, issue_s, issue_seg, side="left",
@@ -62,13 +247,94 @@ def _rq1_kernel(fuzz_s, fuzz_ns, fuzz_offsets, ok_s, ok_ns, ok_offsets, ok_orig_
     return iteration_of_issue, link_idx, totals, detected
 
 
+@partial(jax.jit, static_argnames=("n_projects", "max_iter"))
+def _rq1_kernel_packed(fuzz_s, fuzz_ns, fuzz_offsets, ok_s, ok_ns, ok_offsets,
+                       ok_orig_idx, issue_s, issue_ns, issue_seg,
+                       n_projects: int, max_iter: int):
+    """`_rq1_body` with the four outputs packed into ONE int32 vector
+    [it(Q), link(Q), totals(max_iter), detected(max_iter)] so the whole RQ
+    costs a single device->host fetch."""
+    it, li, totals, detected = _rq1_body(
+        fuzz_s, fuzz_ns, fuzz_offsets, ok_s, ok_ns, ok_offsets, ok_orig_idx,
+        issue_s, issue_ns, issue_seg, n_projects, max_iter)
+    return jnp.concatenate([it.astype(jnp.int32), li.astype(jnp.int32),
+                            totals, detected])
+
+
+@jax.jit
+def _rq3_kernel(fts, ftn, f_off, cts, ctn, c_off, dts, dtn, v_off,
+                is_, ins, seg, qts, qtn):
+    """RQ3's three per-issue linear scans (rq3:269,273,287-293) as one fused
+    dispatch: last ok fuzz build before rts, first coverage build after rts,
+    and the day-after coverage row — stacked [3, Q] for a single fetch."""
+    pos_f = segment_searchsorted(fts, f_off, is_, seg, side="left",
+                                 values_lo=ftn, queries_lo=ins)
+    pos_c = segment_searchsorted(cts, c_off, is_, seg, side="right",
+                                 values_lo=ctn, queries_lo=ins)
+    pos_d = segment_searchsorted(dts, v_off, qts, seg, side="left",
+                                 values_lo=dtn, queries_lo=qtn)
+    return jnp.stack([pos_f, pos_c, pos_d])
+
+
+@partial(jax.jit, static_argnames=("n_projects", "max_iter"))
+def _rq4a_kernel(fts, ftn, f_off, is_, ins, seg, gid, sel1, sel2,
+                 n_projects: int, max_iter: int):
+    """RQ4a's G1/G2 loop (rq4a_bug.py:324-346) in one dispatch: one
+    searchsorted maps every grouped issue to its iteration; per-group
+    survival curves come from a weighted bincount (weight = group
+    membership) and detected-project counts from the boolean scatter.
+    Packed output: [ks(Q), g1_tot, g1_det, g2_tot, g2_det] int32."""
+    ks = segment_searchsorted(fts, f_off, is_, seg, side="left",
+                              values_lo=ftn, queries_lo=ins)
+    counts = f_off[1:] - f_off[:-1]
+    clipped = jnp.clip(counts, 0, max_iter)
+
+    def group(sel, g):
+        w = sel.astype(jnp.int32)
+        # Weighted survival: #group projects with >= k builds.  Equals
+        # counts_to_survival(counts[sel & counts > 0]) — zero-count rows
+        # appear in every cumsum term and cancel against w.sum().
+        hist = jnp.zeros(max_iter + 1, jnp.int32).at[clipped].add(w)
+        tot = w.sum() - jnp.cumsum(hist)[:-1]
+        det = unique_pairs_count_per_iteration(
+            seg, jnp.where(gid == g, ks, 0), n_projects, max_iter)
+        return tot, det
+
+    t1, d1 = group(sel1, 1)
+    t2, d2 = group(sel2, 2)
+    return jnp.concatenate([ks, t1, d1, t2, d2])
+
+
+@jax.jit
+def _rq2_trends_kernel(mj, kj, lo, hi):
+    """RQ2 trends' device work in one dispatch: per-project Spearman, the
+    per-session sort + two order-statistic gathers (the rounding-free part
+    of masked_percentile — the float32 lerp replays on host, same op order,
+    so results stay bit-identical to the eager kernel; see
+    rq_mesh.percentile_by_session_mesh), and the per-session mean.  Counts
+    stay on host (the caller already holds mask.sum(axis=0)).
+    Packed float32: [spear(P), vlo(K*S), vhi(K*S), mean(S)]."""
+    spear = masked_spearman(mj, kj)
+    cols, colmask = mj.T, kj.T
+    big = jnp.float32(np.finfo(np.float32).max)
+    srt = jnp.sort(jnp.where(colmask, cols, big), axis=-1)
+    vlo = jnp.take_along_axis(srt, lo.T, axis=-1).T
+    vhi = jnp.take_along_axis(srt, hi.T, axis=-1).T
+    mean = masked_mean(cols, colmask)
+    return jnp.concatenate([spear, vlo.ravel(), vhi.ravel(), mean])
+
+
 class JaxBackend(Backend):
     """mesh: "auto" (default) shards the RQ reductions over all visible
     devices when there is more than one (the north star's psum/pmean mesh
     collectives); None forces the single-device kernels; a
     `jax.sharding.Mesh` uses that mesh.  Both paths are bit-identical —
     sharding axes keep float reductions device-local and only integer
-    partials cross the mesh (see parallel/rq_mesh.py)."""
+    partials cross the mesh (see parallel/rq_mesh.py).
+
+    Single-device calls go through the device-resident study cache (module
+    docstring): value-side CSR arrays upload once per (study, cutoff) and
+    every RQ is one fused dispatch + one packed fetch."""
 
     name = "jax_tpu"
 
@@ -84,11 +350,10 @@ class JaxBackend(Backend):
             return rq_mesh.segment_searchsorted_mesh(
                 self._mesh, values_s, offsets, queries_s, seg, side,
                 values_lo, queries_lo)
-        return np.asarray(segment_searchsorted(
-            jnp.asarray(values_s), jnp.asarray(offsets, jnp.int32),
-            jnp.asarray(queries_s), jnp.asarray(seg, jnp.int32), side=side,
-            values_lo=jnp.asarray(values_lo),
-            queries_lo=jnp.asarray(queries_lo)))
+        return np.asarray(_seg_searchsorted_jit(
+            values_s, np.asarray(offsets, np.int32),
+            queries_s, np.asarray(seg, np.int32), side=side,
+            values_lo=values_lo, queries_lo=queries_lo))
 
     def rq1_detection(self, arrays: StudyArrays, limit_date_ns: int,
                       min_projects: int) -> RQ1Result:
@@ -102,33 +367,34 @@ class JaxBackend(Backend):
                              np.zeros(n_issues, np.int64),
                              np.full(n_issues, -1, np.int64))
 
-        btimes_ns = arrays.fuzz.columns["time_ns"]
-        fs, fns = ns_to_device_pair(btimes_ns)
-        ok_pos, ok_offsets = masked_csr(
-            arrays.fuzz.offsets,
-            arrays.fuzz.columns["ok"] & (btimes_ns < limit_date_ns))
-
-        issue_seg = np.repeat(np.arange(P), arrays.issues.counts())
-        is_, ins = ns_to_device_pair(arrays.issues.columns["time_ns"])
-
         if self._mesh is not None and n_issues:
+            btimes_ns = arrays.fuzz.columns["time_ns"]
+            fs, fns = ns_to_device_pair(btimes_ns)
+            ok_pos, ok_offsets = masked_csr(
+                arrays.fuzz.offsets,
+                arrays.fuzz.columns["ok"] & (btimes_ns < limit_date_ns))
+            issue_seg = np.repeat(np.arange(P), arrays.issues.counts())
+            is_, ins = ns_to_device_pair(arrays.issues.columns["time_ns"])
             it, li, detected = rq_mesh.rq1_kernel_mesh(
                 self._mesh, fs, fns, arrays.fuzz.offsets,
                 fs[ok_pos], fns[ok_pos], ok_offsets, ok_pos,
                 is_, ins, issue_seg, n_projects=P, max_iter=max_iter)
             totals = counts_to_survival(jnp.asarray(n_builds), max_iter)
+            it = np.asarray(it, dtype=np.int64)
+            li = np.asarray(li, dtype=np.int64)
         else:
-            it, li, totals, detected = _rq1_kernel(
-                jnp.asarray(fs), jnp.asarray(fns),
-                jnp.asarray(arrays.fuzz.offsets, dtype=jnp.int32),
-                jnp.asarray(fs[ok_pos]), jnp.asarray(fns[ok_pos]),
-                jnp.asarray(ok_offsets, dtype=jnp.int32),
-                jnp.asarray(ok_pos, dtype=jnp.int32),
-                jnp.asarray(is_), jnp.asarray(ins),
-                jnp.asarray(issue_seg, dtype=jnp.int32),
-                n_projects=P,
-                max_iter=max_iter,
-            )
+            cache = _study_cache(arrays, limit_date_ns)
+            fs_d, fns_d, foff_d = _dev_fuzz(arrays, cache)
+            oks_d, okns_d, okoff_d, okpos_d = _dev_fuzz_ok(
+                arrays, cache, limit_date_ns)
+            is_d, ins_d, seg_d = _dev_issues(arrays, cache)
+            packed = np.asarray(_rq1_kernel_packed(
+                fs_d, fns_d, foff_d, oks_d, okns_d, okoff_d, okpos_d,
+                is_d, ins_d, seg_d, n_projects=P, max_iter=max_iter))
+            it = packed[:n_issues].astype(np.int64)
+            li = packed[n_issues:2 * n_issues].astype(np.int64)
+            totals = packed[2 * n_issues:2 * n_issues + max_iter]
+            detected = packed[2 * n_issues + max_iter:]
         totals = np.asarray(totals, dtype=np.int64)
         detected = np.asarray(detected, dtype=np.int64)
         keep = totals >= min_projects
@@ -136,8 +402,8 @@ class JaxBackend(Backend):
             iterations=np.flatnonzero(keep) + 1,
             total_projects=totals[keep],
             detected_counts=detected[keep],
-            iteration_of_issue=np.asarray(it, dtype=np.int64),
-            link_idx=np.asarray(li, dtype=np.int64),
+            iteration_of_issue=it,
+            link_idx=li,
         )
 
     def rq2_change_points(self, arrays: StudyArrays,
@@ -153,9 +419,9 @@ class JaxBackend(Backend):
         # cov rows are fetched to limit+1 day; restrict the join (and the
         # project-has-coverage guard) to pre-cutoff rows via a masked CSR
         # (dates ascend within a segment, so the mask keeps a prefix).
+        cache = _study_cache(arrays, limit_date_ns)
         cov_date_all = arrays.cov.columns["date_ns"]
-        cov_pos, cov_offsets = masked_csr(arrays.cov.offsets,
-                                          cov_date_all < limit_date_ns)
+        cov_pos, cov_offsets = _host_cov_cut(arrays, cache, limit_date_ns)
         has_cov = np.diff(cov_offsets) > 0
         keep = ((covb_t < limit_date_ns) & arrays.covb.columns["ok"]
                 & has_cov[seg_all])
@@ -188,11 +454,17 @@ class JaxBackend(Backend):
         q_days = np.concatenate([floor_day_ns(covb_t[end_i]),
                                  floor_day_ns(covb_t[start_ip1])])
         q_seg = np.concatenate([proj, proj])
-        ds, dns = ns_to_device_pair(cov_days)
         qs, qns = ns_to_device_pair(q_days)
-        pos = self._seg_searchsorted(ds, cov_offsets, qs,
-                                     q_seg.astype(np.int32), "left",
-                                     dns, qns)
+        if self._mesh is not None:
+            ds, dns = ns_to_device_pair(cov_days)
+            pos = self._seg_searchsorted(ds, cov_offsets, qs,
+                                         q_seg.astype(np.int32), "left",
+                                         dns, qns)
+        else:
+            ds_d, dns_d, covoff_d = _dev_cov_cut(arrays, cache, limit_date_ns)
+            pos = np.asarray(_seg_searchsorted_jit(
+                ds_d, covoff_d, qs, q_seg.astype(np.int32), side="left",
+                values_lo=dns_d, queries_lo=qns))
         gidx = cov_offsets[q_seg] + pos
         in_seg = gidx < cov_offsets[q_seg + 1]
         safe = np.clip(gidx, 0, max(cov_pos.size - 1, 0))
@@ -212,23 +484,21 @@ class JaxBackend(Backend):
                                   limit_date_ns: int) -> RQ3Result:
         """Vectorised form of the reference's per-issue scans (rq3:241-302):
         the three linear searches per issue (last fuzz build, first coverage
-        build, day-after coverage row) become three device
-        segment-searchsorted calls over masked CSR arrays; the final float64
-        delta gathers stay on host for bit-exactness vs the pandas oracle.
-        Same three documented deviations as the pandas backend."""
+        build, day-after coverage row) become ONE fused device dispatch of
+        three segment-searchsorteds over cached masked CSR arrays; the final
+        float64 delta gathers stay on host for bit-exactness vs the pandas
+        oracle.  Same three documented deviations as the pandas backend."""
         P = arrays.n_projects
         issue_t = arrays.issues.columns["time_ns"]
         n_issues = issue_t.size
         cutoff_plus1 = limit_date_ns + DAY_NS
+        cache = _study_cache(arrays, limit_date_ns)
 
         fuzz_t = arrays.fuzz.columns["time_ns"]
-        f_pos, f_off = masked_csr(
-            arrays.fuzz.offsets,
-            arrays.fuzz.columns["ok"] & (fuzz_t < limit_date_ns))
+        f_pos, f_off = _host_fuzz_ok(arrays, cache, limit_date_ns)
         covb_t = arrays.covb.columns["time_ns"]
-        c_pos, c_off = masked_csr(arrays.covb.offsets, covb_t < cutoff_plus1)
-        v_pos, v_off = masked_csr(
-            arrays.cov.offsets, ~np.isnan(arrays.cov.columns["covered"]))
+        c_pos, c_off = _host_covb_cut(arrays, cache, limit_date_ns)
+        v_pos, v_off = _host_cov_valid(arrays, cache)
         days = arrays.cov.columns["date_ns"][v_pos]
         covered = arrays.cov.columns["covered"][v_pos]
         total = arrays.cov.columns["total"][v_pos]
@@ -240,21 +510,33 @@ class JaxBackend(Backend):
 
         can_detect = bool(n_issues and f_pos.size and c_pos.size and v_pos.size)
         seg32 = issue_seg.astype(np.int32)
-        is_, ins = ns_to_device_pair(issue_t)
-        fts, ftn = ns_to_device_pair(fuzz_t[f_pos])
-        cts, ctn = ns_to_device_pair(covb_t[c_pos])
-        # Last successful fuzzing build strictly before rts (rq3:269).
-        pos_f = self._seg_searchsorted(fts, f_off, is_, seg32, "left",
-                                       ftn, ins)
-        # First coverage build strictly after rts (rq3:273).
-        pos_c = self._seg_searchsorted(cts, c_off, is_, seg32, "right",
-                                       ctn, ins)
-        # Day-after coverage row (rq3:287-293).
         target = floor_day_ns(issue_t) + DAY_NS
-        dts, dtn = ns_to_device_pair(days)
-        qts, qtn = ns_to_device_pair(target)
-        pos_d = self._seg_searchsorted(dts, v_off, qts, seg32, "left",
-                                       dtn, qtn)
+        if self._mesh is not None:
+            is_, ins = ns_to_device_pair(issue_t)
+            fts, ftn = ns_to_device_pair(fuzz_t[f_pos])
+            cts, ctn = ns_to_device_pair(covb_t[c_pos])
+            # Last successful fuzzing build strictly before rts (rq3:269).
+            pos_f = self._seg_searchsorted(fts, f_off, is_, seg32, "left",
+                                           ftn, ins)
+            # First coverage build strictly after rts (rq3:273).
+            pos_c = self._seg_searchsorted(cts, c_off, is_, seg32, "right",
+                                           ctn, ins)
+            # Day-after coverage row (rq3:287-293).
+            dts, dtn = ns_to_device_pair(days)
+            qts, qtn = ns_to_device_pair(target)
+            pos_d = self._seg_searchsorted(dts, v_off, qts, seg32, "left",
+                                           dtn, qtn)
+        else:
+            fts_d, ftn_d, foff_d, _ = _dev_fuzz_ok(arrays, cache,
+                                                   limit_date_ns)
+            cts_d, ctn_d, coff_d = _dev_covb_cut(arrays, cache, limit_date_ns)
+            dts_d, dtn_d, voff_d = _dev_cov_valid(arrays, cache)
+            is_d, ins_d, seg_d = _dev_issues(arrays, cache)
+            qts_d, qtn_d = _dev_rq3_targets(arrays, cache)
+            pos3 = np.asarray(_rq3_kernel(
+                fts_d, ftn_d, foff_d, cts_d, ctn_d, coff_d,
+                dts_d, dtn_d, voff_d, is_d, ins_d, seg_d, qts_d, qtn_d))
+            pos_f, pos_c, pos_d = pos3[0], pos3[1], pos3[2]
 
         if can_detect:
             cand = (has_all[issue_seg] & (pos_f > 0)
@@ -325,15 +607,16 @@ class JaxBackend(Backend):
         one segment-searchsorted maps every issue of both groups to its
         iteration; per-group populations are bincount survival curves and
         detected-project counts a boolean scatter — the same kernel shapes
-        as RQ1 but over ALL builds (no result filter) per rq4a:128-134."""
+        as RQ1 but over ALL builds (no result filter) per rq4a:128-134.
+        Single-device, the whole G1/G2 computation is one fused dispatch
+        (`_rq4a_kernel`) over the cached pre-cutoff CSR."""
         P = arrays.n_projects
-        fuzz_t = arrays.fuzz.columns["time_ns"]
-        f_pos, f_off = masked_csr(arrays.fuzz.offsets, fuzz_t < limit_date_ns)
+        cache = _study_cache(arrays, limit_date_ns)
+        f_pos, f_off = _host_fuzz_cut(arrays, cache, limit_date_ns)
         counts = np.diff(f_off)
         in_g = np.zeros(P, dtype=np.int8)  # 1 -> g1, 2 -> g2
         in_g[np.asarray(g1_idx, dtype=np.int64)] = 1
         in_g[np.asarray(g2_idx, dtype=np.int64)] = 2
-        both = {}
         max_iter = int(counts[in_g > 0].max()) if (in_g > 0).any() else 0
         if max_iter == 0:
             e = np.empty(0, np.int64)
@@ -342,30 +625,48 @@ class JaxBackend(Backend):
         issue_seg = np.repeat(np.arange(P), arrays.issues.counts())
         issue_mask = in_g[issue_seg] > 0
         qi = np.flatnonzero(issue_mask)
-        is_, ins = ns_to_device_pair(arrays.issues.columns["time_ns"][qi])
-        fts, ftn = ns_to_device_pair(fuzz_t[f_pos])
-        ks = self._seg_searchsorted(fts, f_off, is_,
-                                    issue_seg[qi].astype(np.int32), "left",
-                                    ftn, ins)
+        issue_t = arrays.issues.columns["time_ns"][qi]
+        is_, ins = ns_to_device_pair(issue_t)
+        seg_q = issue_seg[qi].astype(np.int32)
+        gid = in_g[issue_seg[qi]].astype(np.int32)
 
-        for key, gid in (("g1", 1), ("g2", 2)):
-            sel = in_g == gid
-            tot = np.asarray(counts_to_survival(
-                jnp.asarray(counts[sel & (counts > 0)]), max_iter),
-                dtype=np.int64)
-            gi = in_g[issue_seg[qi]] == gid
-            det = np.asarray(unique_pairs_count_per_iteration(
-                jnp.asarray(issue_seg[qi][gi], jnp.int32),
-                jnp.asarray(ks[gi], jnp.int32), P, max_iter), dtype=np.int64)
-            both[key] = (tot, det)
+        if self._mesh is not None:
+            fuzz_t = arrays.fuzz.columns["time_ns"]
+            fts, ftn = ns_to_device_pair(fuzz_t[f_pos])
+            ks = self._seg_searchsorted(fts, f_off, is_, seg_q, "left",
+                                        ftn, ins)
+            both = {}
+            for key, g in (("g1", 1), ("g2", 2)):
+                sel = in_g == g
+                tot = np.asarray(counts_to_survival(
+                    jnp.asarray(counts[sel & (counts > 0)]), max_iter),
+                    dtype=np.int64)
+                gi = gid == g
+                det = np.asarray(unique_pairs_count_per_iteration(
+                    jnp.asarray(seg_q[gi], jnp.int32),
+                    jnp.asarray(ks[gi], jnp.int32), P, max_iter),
+                    dtype=np.int64)
+                both[key] = (tot, det)
+            g1_tot, g1_det = both["g1"]
+            g2_tot, g2_det = both["g2"]
+        else:
+            fts_d, ftn_d, fcoff_d = _dev_fuzz_cut(arrays, cache,
+                                                  limit_date_ns)
+            q = qi.size
+            packed = np.asarray(_rq4a_kernel(
+                fts_d, ftn_d, fcoff_d, is_, ins, seg_q, gid,
+                (in_g == 1), (in_g == 2), n_projects=P, max_iter=max_iter))
+            g1_tot = packed[q:q + max_iter].astype(np.int64)
+            g1_det = packed[q + max_iter:q + 2 * max_iter].astype(np.int64)
+            g2_tot = packed[q + 2 * max_iter:q + 3 * max_iter].astype(np.int64)
+            g2_det = packed[q + 3 * max_iter:].astype(np.int64)
 
-        valid = ((both["g1"][0] >= min_projects)
-                 & (both["g2"][0] >= min_projects))
+        valid = (g1_tot >= min_projects) & (g2_tot >= min_projects)
         keep = np.flatnonzero(valid)
         return RQ4aTrendResult(
             iterations=keep + 1,
-            g1_total=both["g1"][0][keep], g1_detected=both["g1"][1][keep],
-            g2_total=both["g2"][0][keep], g2_detected=both["g2"][1][keep],
+            g1_total=g1_tot[keep], g1_detected=g1_det[keep],
+            g2_total=g2_tot[keep], g2_detected=g2_det[keep],
         )
 
     def rq4b_group_trends(self, arrays: StudyArrays, limit_date_ns: int,
@@ -471,14 +772,29 @@ class JaxBackend(Backend):
             mean = rq_mesh.mean_by_session_mesh(matrix.T, mask.T, self._mesh)
             counts = rq_mesh.counts_by_project_psum(mask, self._mesh)
         else:
-            mj = jnp.asarray(matrix, dtype=jnp.float32)
-            kj = jnp.asarray(mask)
-            spear = np.asarray(masked_spearman(mj, kj), dtype=np.float64)
-            cols = mj.T  # [S, P]: percentile/mean per session index
-            colmask = kj.T
-            pcts = np.asarray(masked_percentile(cols, colmask, q),
-                              dtype=np.float64)
-            mean = np.asarray(masked_mean(cols, colmask), dtype=np.float64)
-            counts = mask.sum(axis=0)
+            # One fused dispatch; the percentile's float32 index math + lerp
+            # replay on host with the exact op order of the eager
+            # masked_percentile kernel (same scheme as the mesh path), so
+            # single-device, mesh, and eager all agree bit-for-bit.
+            K = q.shape[0]
+            n_valid = mask.sum(axis=0).astype(np.int32)            # [S]
+            pos = (n_valid.astype(np.float32) - np.float32(1.0)) \
+                * q[:, None] / np.float32(100.0)                   # [K, S]
+            lo = np.clip(np.floor(pos).astype(np.int32), 0, P - 1)
+            hi = np.clip(lo + 1, 0, P - 1)
+            frac = pos - lo.astype(np.float32)
+            packed = np.asarray(_rq2_trends_kernel(
+                jnp.asarray(matrix, dtype=jnp.float32), jnp.asarray(mask),
+                lo, hi))
+            spear = packed[:P].astype(np.float64)
+            vlo = packed[P:P + K * S].reshape(K, S)
+            vhi = packed[P + K * S:P + 2 * K * S].reshape(K, S)
+            hi_valid = (lo + 1) <= (n_valid[None, :] - 1)
+            pcts = vlo + np.where(hi_valid, frac * (vhi - vlo),
+                                  np.float32(0.0))
+            pcts = np.where(n_valid[None, :] > 0, pcts,
+                            np.float32(np.nan)).astype(np.float64)
+            mean = packed[P + 2 * K * S:].astype(np.float64)
+            counts = n_valid.astype(np.int64)
         return RQ2TrendsResult(matrix=matrix, mask=mask, spearman=spear,
                                percentiles=pcts, mean=mean, counts=counts)
