@@ -1,0 +1,64 @@
+"""The `auto` backend switch (backend/__init__.resolve_auto_backend).
+
+`auto` must resolve to the host oracle off-TPU (the tests' CPU platform)
+and to the device backend only when dispatch latency is local-class —
+over a tunneled PJRT link every device call pays the network round-trip,
+which no fused kernel can beat for ms-scale RQ reductions.
+"""
+
+import pytest
+
+import tse1m_tpu.backend as backend_mod
+from tse1m_tpu.backend import get_backend, resolve_auto_backend
+from tse1m_tpu.backend.pandas_backend import PandasBackend
+from tse1m_tpu.config import Config, load_config
+
+
+@pytest.fixture(autouse=True)
+def _reset_auto_cache():
+    backend_mod._auto_choice = None
+    yield
+    backend_mod._auto_choice = None
+
+
+def test_auto_resolves_to_pandas_on_cpu():
+    # The test platform is CPU (conftest pins it), so auto -> host oracle.
+    assert resolve_auto_backend() == "pandas"
+    assert isinstance(get_backend(Config(backend="auto")), PandasBackend)
+
+
+def test_auto_picks_device_only_when_dispatch_is_local(monkeypatch):
+    import jax
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(backend_mod, "_dispatch_rtt_s", lambda: 0.11)
+    assert resolve_auto_backend() == "pandas"
+    backend_mod._auto_choice = None
+    monkeypatch.setattr(backend_mod, "_dispatch_rtt_s", lambda: 0.0002)
+    assert resolve_auto_backend() == "jax_tpu"
+
+
+def test_auto_choice_cached_per_process(monkeypatch):
+    calls = []
+
+    def probe():
+        calls.append(1)
+        return 0.11
+
+    import jax
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(backend_mod, "_dispatch_rtt_s", probe)
+    resolve_auto_backend()
+    resolve_auto_backend()
+    assert len(calls) == 1
+
+
+def test_config_accepts_auto(tmp_path, monkeypatch):
+    ini = tmp_path / "envFile.ini"
+    ini.write_text("[FRAMEWORK]\nbackend = auto\n")
+    monkeypatch.delenv("TSE1M_BACKEND", raising=False)
+    assert load_config(str(ini)).backend == "auto"
+    ini.write_text("[FRAMEWORK]\nbackend = cuda\n")
+    with pytest.raises(ValueError, match="unknown backend"):
+        load_config(str(ini))

@@ -25,42 +25,44 @@ def limit_ns(study_cfg):
     return int(np.datetime64(study_cfg.limit_date, "ns").astype(np.int64))
 
 
-def test_cache_reused_across_rq_calls(arrays, limit_ns, monkeypatch):
-    """Second and later RQ calls must not re-upload the study arrays."""
-    be = JaxBackend(mesh=None)
-    be.rq1_detection(arrays, limit_ns, min_projects=1)
-    be.rq3_coverage_at_detection(arrays, limit_ns)
-    cache = arrays._jax_dev_cache
-    # Same cache object and no new device_put staging on repeat calls.
+def test_cache_reused_across_rq_calls(arrays, limit_ns):
+    """Warm rq1/rq3 calls must run entirely from cached device buffers: the
+    transfer guard turns ANY host->device staging (explicit device_put,
+    jnp.asarray, or implicit jit-argument transfer) into an error, which is
+    exactly the per-call re-upload regression this pins (round-3 verdict:
+    0.75 s/call re-staging)."""
     import jax
 
-    calls = []
-    real_put = jax.device_put
-
-    def counting_put(*a, **kw):
-        calls.append(1)
-        return real_put(*a, **kw)
-
-    monkeypatch.setattr(jax, "device_put", counting_put)
-    # The fused kernels receive only cached device buffers plus per-call
-    # query scalars; neither RQ should stage another value-side array.
-    import tse1m_tpu.backend.jax_backend as jb
-
-    monkeypatch.setattr(jb.jax, "device_put", counting_put)
-    be.rq1_detection(arrays, limit_ns, min_projects=1)
+    be = JaxBackend(mesh=None)
+    be.rq1_detection(arrays, limit_ns, min_projects=1)  # cold: stages
     be.rq3_coverage_at_detection(arrays, limit_ns)
+    cache = arrays._jax_dev_cache
+    with jax.transfer_guard_host_to_device("disallow"):
+        r1 = be.rq1_detection(arrays, limit_ns, min_projects=1)
+        r3 = be.rq3_coverage_at_detection(arrays, limit_ns)
     assert arrays._jax_dev_cache is cache
-    assert not calls
+    assert r1.iterations.size and r3.nondet_diff_percent.size
 
 
-def test_cache_invalidated_by_new_limit(arrays, limit_ns):
+def test_cutoff_sweep_keeps_study_level_entries(arrays, limit_ns):
+    """A new cutoff must re-derive only the masked views; the big
+    cutoff-independent lanes (full fuzz times, issues) stay resident."""
     be = JaxBackend(mesh=None)
     be.rq1_detection(arrays, limit_ns, min_projects=1)
-    first = arrays._jax_dev_cache
+    cache = arrays._jax_dev_cache
+    fuzz_entry = cache["fuzz"]
+    issues_entry = cache["issues"]
     day_ns = 86_400_000_000_000
-    be.rq1_detection(arrays, limit_ns - 30 * day_ns, min_projects=1)
-    assert arrays._jax_dev_cache is not first
-    assert arrays._jax_dev_cache["limit_ns"] == limit_ns - 30 * day_ns
+    limit2 = limit_ns - 30 * day_ns
+    res2 = be.rq1_detection(arrays, limit2, min_projects=1)
+    assert arrays._jax_dev_cache is cache
+    assert cache["fuzz"] is fuzz_entry
+    assert cache["issues"] is issues_entry
+    assert f"fuzz_ok:{limit_ns}" in cache and f"fuzz_ok:{limit2}" in cache
+    # and the earlier-cutoff result still matches the host oracle
+    resp = PandasBackend().rq1_detection(arrays, limit2, min_projects=1)
+    np.testing.assert_array_equal(res2.link_idx, resp.link_idx)
+    np.testing.assert_array_equal(res2.detected_counts, resp.detected_counts)
 
 
 def test_cache_not_shared_across_table_swap(arrays, limit_ns):

@@ -47,22 +47,22 @@ def masked_csr(offsets: np.ndarray, mask: np.ndarray):
 # Device-resident study cache
 # ---------------------------------------------------------------------------
 
-def _study_cache(arrays: StudyArrays, limit_date_ns: int) -> dict:
-    """The per-(StudyArrays, limit_date) device cache.
+def _study_cache(arrays: StudyArrays) -> dict:
+    """The per-StudyArrays device cache.
 
-    Stored on the StudyArrays instance (immutable after construction), keyed
-    by the study cutoff: all six RQ kernels share the same value-side CSR
-    arrays, so the H2D staging happens once per study instead of once per RQ
-    call.  A different cutoff invalidates the whole cache (the masked CSR
-    views depend on it)."""
+    Stored on the StudyArrays instance (immutable after construction): all
+    six RQ kernels share the same value-side CSR arrays, so the H2D staging
+    happens once per study instead of once per RQ call.  Cutoff-dependent
+    entries (the masked CSR views) carry the limit in their key, so a
+    cutoff sweep re-derives only those while the big cutoff-independent
+    lanes (full fuzz times, issues, valid-coverage rows) stay resident."""
     fp = tuple(_table_token(t) for t in
                (arrays.fuzz, arrays.covb, arrays.issues, arrays.cov))
     cache = getattr(arrays, "_jax_dev_cache", None)
-    if (cache is None or cache.get("limit_ns") != limit_date_ns
-            or cache.get("fp") != fp):
+    if cache is None or cache.get("fp") != fp:
         # fp guards shallow copies that swap a table out (and with it the
         # case of two StudyArrays sharing one cache attribute object).
-        cache = {"limit_ns": limit_date_ns, "fp": fp}
+        cache = {"fp": fp}
         arrays._jax_dev_cache = cache
     return cache
 
@@ -102,7 +102,7 @@ def _host_fuzz_ok(arrays: StudyArrays, cache: dict, limit_date_ns: int):
         t = arrays.fuzz.columns["time_ns"]
         return masked_csr(arrays.fuzz.offsets,
                           arrays.fuzz.columns["ok"] & (t < limit_date_ns))
-    return _cached(cache, "fuzz_ok_host", build)
+    return _cached(cache, f"fuzz_ok_host:{limit_date_ns}", build)
 
 
 def _dev_fuzz_ok(arrays: StudyArrays, cache: dict, limit_date_ns: int):
@@ -115,7 +115,7 @@ def _dev_fuzz_ok(arrays: StudyArrays, cache: dict, limit_date_ns: int):
         pos_d = jax.device_put(pos.astype(np.int32))
         return (jnp.take(fs_d, pos_d), jnp.take(fns_d, pos_d),
                 jax.device_put(off.astype(np.int32)), pos_d)
-    return _cached(cache, "fuzz_ok", build)
+    return _cached(cache, f"fuzz_ok:{limit_date_ns}", build)
 
 
 def _dev_issues(arrays: StudyArrays, cache: dict):
@@ -136,7 +136,7 @@ def _host_covb_cut(arrays: StudyArrays, cache: dict, limit_date_ns: int):
     def build():
         t = arrays.covb.columns["time_ns"]
         return masked_csr(arrays.covb.offsets, t < limit_date_ns + DAY_NS)
-    return _cached(cache, "covb_cut_host", build)
+    return _cached(cache, f"covb_cut_host:{limit_date_ns}", build)
 
 
 def _dev_covb_cut(arrays: StudyArrays, cache: dict, limit_date_ns: int):
@@ -145,7 +145,7 @@ def _dev_covb_cut(arrays: StudyArrays, cache: dict, limit_date_ns: int):
         cts, ctn = ns_to_device_pair(arrays.covb.columns["time_ns"][pos])
         return (jax.device_put(cts), jax.device_put(ctn),
                 jax.device_put(off.astype(np.int32)))
-    return _cached(cache, "covb_cut", build)
+    return _cached(cache, f"covb_cut:{limit_date_ns}", build)
 
 
 def _host_cov_valid(arrays: StudyArrays, cache: dict):
@@ -172,7 +172,7 @@ def _host_cov_cut(arrays: StudyArrays, cache: dict, limit_date_ns: int):
     def build():
         return masked_csr(arrays.cov.offsets,
                           arrays.cov.columns["date_ns"] < limit_date_ns)
-    return _cached(cache, "cov_cut_host", build)
+    return _cached(cache, f"cov_cut_host:{limit_date_ns}", build)
 
 
 def _dev_cov_cut(arrays: StudyArrays, cache: dict, limit_date_ns: int):
@@ -181,7 +181,7 @@ def _dev_cov_cut(arrays: StudyArrays, cache: dict, limit_date_ns: int):
         ds, dns = ns_to_device_pair(arrays.cov.columns["date_ns"][pos])
         return (jax.device_put(ds), jax.device_put(dns),
                 jax.device_put(off.astype(np.int32)))
-    return _cached(cache, "cov_cut", build)
+    return _cached(cache, f"cov_cut:{limit_date_ns}", build)
 
 
 def _host_fuzz_cut(arrays: StudyArrays, cache: dict, limit_date_ns: int):
@@ -190,7 +190,7 @@ def _host_fuzz_cut(arrays: StudyArrays, cache: dict, limit_date_ns: int):
     def build():
         t = arrays.fuzz.columns["time_ns"]
         return masked_csr(arrays.fuzz.offsets, t < limit_date_ns)
-    return _cached(cache, "fuzz_cut_host", build)
+    return _cached(cache, f"fuzz_cut_host:{limit_date_ns}", build)
 
 
 def _dev_fuzz_cut(arrays: StudyArrays, cache: dict, limit_date_ns: int):
@@ -200,7 +200,7 @@ def _dev_fuzz_cut(arrays: StudyArrays, cache: dict, limit_date_ns: int):
         pos_d = jax.device_put(pos.astype(np.int32))
         return (jnp.take(fs_d, pos_d), jnp.take(fns_d, pos_d),
                 jax.device_put(off.astype(np.int32)))
-    return _cached(cache, "fuzz_cut", build)
+    return _cached(cache, f"fuzz_cut:{limit_date_ns}", build)
 
 
 def _dev_rq3_targets(arrays: StudyArrays, cache: dict):
@@ -383,7 +383,7 @@ class JaxBackend(Backend):
             it = np.asarray(it, dtype=np.int64)
             li = np.asarray(li, dtype=np.int64)
         else:
-            cache = _study_cache(arrays, limit_date_ns)
+            cache = _study_cache(arrays)
             fs_d, fns_d, foff_d = _dev_fuzz(arrays, cache)
             oks_d, okns_d, okoff_d, okpos_d = _dev_fuzz_ok(
                 arrays, cache, limit_date_ns)
@@ -419,7 +419,7 @@ class JaxBackend(Backend):
         # cov rows are fetched to limit+1 day; restrict the join (and the
         # project-has-coverage guard) to pre-cutoff rows via a masked CSR
         # (dates ascend within a segment, so the mask keeps a prefix).
-        cache = _study_cache(arrays, limit_date_ns)
+        cache = _study_cache(arrays)
         cov_date_all = arrays.cov.columns["date_ns"]
         cov_pos, cov_offsets = _host_cov_cut(arrays, cache, limit_date_ns)
         has_cov = np.diff(cov_offsets) > 0
@@ -492,7 +492,7 @@ class JaxBackend(Backend):
         issue_t = arrays.issues.columns["time_ns"]
         n_issues = issue_t.size
         cutoff_plus1 = limit_date_ns + DAY_NS
-        cache = _study_cache(arrays, limit_date_ns)
+        cache = _study_cache(arrays)
 
         fuzz_t = arrays.fuzz.columns["time_ns"]
         f_pos, f_off = _host_fuzz_ok(arrays, cache, limit_date_ns)
@@ -611,7 +611,7 @@ class JaxBackend(Backend):
         Single-device, the whole G1/G2 computation is one fused dispatch
         (`_rq4a_kernel`) over the cached pre-cutoff CSR."""
         P = arrays.n_projects
-        cache = _study_cache(arrays, limit_date_ns)
+        cache = _study_cache(arrays)
         f_pos, f_off = _host_fuzz_cut(arrays, cache, limit_date_ns)
         counts = np.diff(f_off)
         in_g = np.zeros(P, dtype=np.int8)  # 1 -> g1, 2 -> g2

@@ -311,7 +311,8 @@ def main(argv=None) -> int:
     for name in ("rq1", "rq2a", "rq2b", "rq3", "rq4a", "rq4b", "all"):
         p = sub.add_parser(name, help=f"run {name} analysis")
         p.add_argument("--db", default=None)
-        p.add_argument("--backend", choices=("pandas", "jax_tpu"), default=None)
+        p.add_argument("--backend", choices=("pandas", "jax_tpu", "auto"),
+                       default=None)
         p.add_argument("--result-dir", default=None,
                        help="artifact root (default data/result_data; also "
                             "settable via TSE1M_RESULT_DIR)")

@@ -107,8 +107,9 @@ def load_config(ini_path: str | None = None) -> Config:
     cfg.result_dir = os.environ.get("TSE1M_RESULT_DIR", cfg.result_dir)
     if "TSE1M_TEST_MODE" in os.environ:
         cfg.test_mode = os.environ["TSE1M_TEST_MODE"].lower() in ("1", "true", "yes")
-    if cfg.backend not in ("pandas", "jax_tpu"):
-        raise ValueError(f"unknown backend {cfg.backend!r}; expected 'pandas' or 'jax_tpu'")
+    if cfg.backend not in ("pandas", "jax_tpu", "auto"):
+        raise ValueError(f"unknown backend {cfg.backend!r}; expected "
+                         "'pandas', 'jax_tpu' or 'auto'")
     if cfg.engine not in ("sqlite", "postgres"):
         raise ValueError(f"unknown engine {cfg.engine!r}; expected 'sqlite' or 'postgres'")
     return cfg
