@@ -190,32 +190,37 @@ def bench_link(probe_mb: int = 32) -> dict:
       encoding could achieve on the wire, separating 'link is slow' from
       'payload is big'.
     """
-    import jax  # noqa: F401  (device must be initialised before probing)
     import numpy as np
 
     from tse1m_tpu.backend import _dispatch_rtt_s
 
     rtt_s = _dispatch_rtt_s()
-
-    def h2d_mbps(a: "np.ndarray") -> float:
-        samples = []
-        for _ in range(3):
-            t0 = time.perf_counter()
-            d = jax.device_put(a)
-            int(d[0])  # 4-byte D2H: the only honest completion sync
-            samples.append(time.perf_counter() - t0)
-        return a.nbytes / statistics.median(samples) / 1e6
-
     n = probe_mb * 1024 * 1024
     rng = np.random.default_rng(0)
     rand = rng.integers(0, 256, size=n, dtype=np.uint8)
     zeros = np.zeros(n, dtype=np.uint8)
     return {
         "link_dispatch_rtt_ms": round(rtt_s * 1e3, 2),
-        "link_h2d_rand_MBps": round(h2d_mbps(rand), 1),
-        "link_h2d_zeros_MBps": round(h2d_mbps(zeros), 1),
+        "link_h2d_rand_MBps": round(_timed_h2d(rand)[1], 1),
+        "link_h2d_zeros_MBps": round(_timed_h2d(zeros)[1], 1),
         "link_probe_mb": probe_mb,
     }
+
+
+def _timed_h2d(payload, reps: int = 3) -> tuple:
+    """device_put + 4-byte D2H completion sync (the only honest sync over a
+    tunneled PJRT link — block_until_ready returns early there), median
+    over `reps`.  Returns (median_s, MB_per_s)."""
+    import jax
+
+    samples = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        d = jax.device_put(payload)
+        int(d[(0,) * payload.ndim])
+        samples.append(time.perf_counter() - t0)
+    med = statistics.median(samples)
+    return med, payload.nbytes / med / 1e6
 
 
 def main() -> int:
@@ -327,25 +332,19 @@ def main() -> int:
 
     def transfer_probe() -> dict:
         """Measured H2D wall for the exact packed payload the cluster
-        pipeline ships (host 24-bit pack + device_put + 4-byte completion
-        sync), median of 3 — `value` minus this minus `compute_only_s`
-        is dispatch/pack overhead, so the link bound is measured rather
-        than inferred from subtraction."""
-        from tse1m_tpu.cluster.pipeline import _PACK_LIMIT, _pack24_host
+        pipeline ships (its own pack decision + host 24-bit pack), median
+        of 3 — `value` minus this minus `compute_only_s` is dispatch/pack
+        overhead, so the link bound is measured rather than inferred from
+        subtraction."""
+        from tse1m_tpu.cluster.pipeline import _pack24_host, should_pack24
 
-        pack = bool(items.size and items.max() < _PACK_LIMIT)
+        pack = should_pack24(items)
         payload = _pack24_host(items) if pack else items
-        samples = []
-        for _ in range(3):
-            t0 = time.perf_counter()
-            d = jax.device_put(payload)
-            int(d[(0,) * payload.ndim])  # 4-byte D2H: honest sync
-            samples.append(time.perf_counter() - t0)
-        med = statistics.median(samples)
+        med, mbps = _timed_h2d(payload)
         return {
             "transfer_mb": round(payload.nbytes / 2**20, 1),
             "transfer_s": round(med, 4),
-            "transfer_MBps": round(payload.nbytes / med / 1e6, 1),
+            "transfer_MBps": round(mbps, 1),
             "transfer_packed24": pack,
         }
 

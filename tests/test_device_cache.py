@@ -65,6 +65,27 @@ def test_cutoff_sweep_keeps_study_level_entries(arrays, limit_ns):
     np.testing.assert_array_equal(res2.detected_counts, resp.detected_counts)
 
 
+def test_cutoff_entries_evicted_beyond_cap(arrays, limit_ns):
+    """HBM stays bounded on long cutoff sweeps: only the most recent
+    _MAX_CUTOFFS cutoffs keep their masked device views."""
+    from tse1m_tpu.backend.jax_backend import _MAX_CUTOFFS
+
+    be = JaxBackend(mesh=None)
+    day_ns = 86_400_000_000_000
+    limits = [limit_ns - k * day_ns for k in range(_MAX_CUTOFFS + 1)]
+    for lim in limits:
+        be.rq1_detection(arrays, lim, min_projects=1)
+    cache = arrays._jax_dev_cache
+    assert f"fuzz_ok:{limits[0]}" not in cache       # oldest evicted
+    for lim in limits[1:]:
+        assert f"fuzz_ok:{lim}" in cache             # recent resident
+    assert "fuzz" in cache and "issues" in cache     # big lanes never evicted
+    # evicted cutoff still computes correctly (rebuilds on demand)
+    res = be.rq1_detection(arrays, limits[0], min_projects=1)
+    resp = PandasBackend().rq1_detection(arrays, limits[0], min_projects=1)
+    np.testing.assert_array_equal(res.detected_counts, resp.detected_counts)
+
+
 def test_cache_not_shared_across_table_swap(arrays, limit_ns):
     """A shallow copy that swaps a table must not see the old cache (the
     copy shares the `_jax_dev_cache` attribute object)."""

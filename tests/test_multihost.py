@@ -26,21 +26,86 @@ def test_initialize_from_env_noop_without_config(monkeypatch):
     assert jax.process_count() == 1
 
 
+def _deal(n_rows: int, nproc: int, ldev: int):
+    """The device-aligned dealing rule local_row_range implements, for an
+    arbitrary (nproc, local-device-count) topology."""
+    n_dev = nproc * ldev
+    per_dev = -(-n_rows // n_dev) if n_rows else 0
+    spans = []
+    for pid in range(nproc):
+        start = min(pid * ldev * per_dev, n_rows)
+        spans.append((start, min(start + ldev * per_dev, n_rows)))
+    return spans
+
+
 def test_local_row_range_partitions_exactly():
     # Single-process: the full range.
     assert multihost.local_row_range(101) == (0, 101)
-    # The dealing rule itself (what each process would compute): contiguous,
-    # disjoint, covering, remainder on the last process.
-    for n_rows, nproc in [(101, 4), (8, 8), (5, 8), (0, 3), (1000, 7)]:
-        per = -(-n_rows // nproc) if n_rows else 0
-        spans = []
-        for pid in range(nproc):
-            start = min(pid * per, n_rows)
-            spans.append((start, min(start + per, n_rows)))
+    # The dealing rule: contiguous, disjoint, covering, remainder at the
+    # tail — for divisible and non-divisible row counts alike.
+    for n_rows, nproc, ldev in [(101, 4, 2), (8, 8, 1), (5, 8, 1),
+                                (0, 3, 2), (1000, 7, 3), (397, 2, 4)]:
+        spans = _deal(n_rows, nproc, ldev)
         assert spans[0][0] == 0
         assert spans[-1][1] == n_rows
         for (a, b), (c, d) in zip(spans, spans[1:]):
             assert b == c  # contiguous and disjoint
+
+
+def test_local_row_range_is_device_aligned():
+    """ADVICE round 3: jax lays a NamedSharding out ceil-per-DEVICE, so a
+    process owning >1 device must span its devices' blocks — n=10 on
+    2 procs x 2 devices is [0,6) + [6,10), NOT the per-process ceil [0,5)."""
+    assert _deal(10, 2, 2) == [(0, 6), (6, 10)]
+    # jax's own shard layout for that case: ceil(10/4)=3 rows per device.
+    per_dev = -(-10 // 4)
+    dev_rows = [(min(i * per_dev, 10), min((i + 1) * per_dev, 10))
+                for i in range(4)]
+    assert dev_rows == [(0, 3), (3, 6), (6, 9), (9, 10)]
+    # process 0 = devices 0-1, process 1 = devices 2-3
+    assert _deal(10, 2, 2)[0] == (dev_rows[0][0], dev_rows[1][1])
+    assert _deal(10, 2, 2)[1] == (dev_rows[2][0], dev_rows[3][1])
+
+
+def test_padded_row_count_and_padded_put_roundtrip():
+    mesh = multihost.global_mesh()
+    n = 101  # not a multiple of the 8-device mesh
+    n_pad = multihost.padded_row_count(n, mesh)
+    assert n_pad == 104 and n_pad % mesh.devices.size == 0
+    lo, hi = multihost.local_row_range(n_pad)
+    data = np.arange(n * 3, dtype=np.int32).reshape(n, 3)
+    arr, got_pad = multihost.put_process_local_padded(
+        data[lo:min(hi, n)], n, mesh)
+    assert got_pad == n_pad
+    assert arr.shape == (n_pad, 3)
+    out = np.asarray(arr)
+    np.testing.assert_array_equal(out[:n], data)
+    assert (out[n:] == 0).all()
+
+
+def test_padded_put_rejects_wrong_slice():
+    mesh = multihost.global_mesh()
+    data = np.zeros((7, 2), np.int32)  # not rows [0, 101) of anything
+    with pytest.raises(ValueError, match="must feed rows"):
+        multihost.put_process_local_padded(data, 101, mesh)
+
+
+def test_cluster_sessions_any_n_via_padded_put():
+    """End-to-end: a non-mesh-multiple study clusters identically through
+    the padded pre-sharded path and the plain host path."""
+    from tse1m_tpu.cluster import ClusterParams, cluster_sessions
+    from tse1m_tpu.data.synth import synth_session_sets
+
+    mesh = multihost.global_mesh()
+    n = 8 * 40 + 3
+    items, _ = synth_session_sets(n, set_size=16, seed=9)
+    params = ClusterParams(n_hashes=32, n_bands=4, use_pallas="never")
+    lo, hi = multihost.local_row_range(multihost.padded_row_count(n, mesh))
+    arr, _ = multihost.put_process_local_padded(
+        np.ascontiguousarray(items[lo:min(hi, n)], dtype=np.uint32), n, mesh)
+    got = cluster_sessions(arr, params, mesh=mesh)[:n]
+    want = cluster_sessions(items, params, mesh=mesh)
+    np.testing.assert_array_equal(got, want)
 
 
 def test_put_process_local_roundtrip():

@@ -23,7 +23,10 @@ import textwrap
 import numpy as np
 import pytest
 
-N = 8 * 50  # 2 processes x 4 virtual devices each
+# Deliberately NOT a multiple of the 8-device mesh (2 processes x 4 virtual
+# devices): a real study size never is one, so the padded feeding path
+# (padded_row_count + put_process_local_padded) is what this validates.
+N = 397
 SEED = 5
 
 WORKER = textwrap.dedent("""
@@ -49,12 +52,13 @@ WORKER = textwrap.dedent("""
     assert jax.process_count() == 2 and jax.device_count() == 8
     mesh = multihost.global_mesh()
     items, _ = synth_session_sets(n, set_size=16, seed=seed)
-    lo, hi = multihost.local_row_range(n)
-    arr = multihost.put_process_local(
-        np.ascontiguousarray(items[lo:hi], dtype=np.uint32), n, mesh)
+    lo, hi = multihost.local_row_range(multihost.padded_row_count(n, mesh))
+    arr, n_pad = multihost.put_process_local_padded(
+        np.ascontiguousarray(items[lo:min(hi, n)], dtype=np.uint32), n, mesh)
+    assert n_pad % mesh.devices.size == 0
     labels = cluster_sessions(
         arr, ClusterParams(n_hashes=32, n_bands=4, use_pallas="never"),
-        mesh=mesh)
+        mesh=mesh)[:n]
     multihost.all_processes_ready("labels-done")
 
     # Flagship RQ on the same global mesh: every process builds the same

@@ -86,6 +86,27 @@ def _cached(cache: dict, key: str, build):
     return cache[key]
 
 
+# How many distinct cutoffs keep their masked device views resident.  A
+# sweep touches cutoffs mostly in sequence; beyond this the oldest cutoff's
+# entries are dropped so HBM use stays bounded (the cutoff-independent lanes
+# are never evicted).
+_MAX_CUTOFFS = 2
+
+
+def _touch_limit(cache: dict, limit_date_ns: int) -> None:
+    """Record cutoff use order and evict the oldest cutoff's `...:{limit}`
+    entries once more than _MAX_CUTOFFS are resident."""
+    limits = cache.setdefault("_limits", [])
+    if limit_date_ns in limits:
+        limits.remove(limit_date_ns)
+    limits.append(limit_date_ns)
+    while len(limits) > _MAX_CUTOFFS:
+        old = limits.pop(0)
+        suffix = f":{old}"
+        for k in [k for k in cache if k.endswith(suffix)]:
+            del cache[k]
+
+
 def _dev_fuzz(arrays: StudyArrays, cache: dict):
     """(fs_d, fns_d, foff32_d): full fuzz two-lane times, device-resident."""
     def build():
@@ -384,6 +405,7 @@ class JaxBackend(Backend):
             li = np.asarray(li, dtype=np.int64)
         else:
             cache = _study_cache(arrays)
+            _touch_limit(cache, limit_date_ns)
             fs_d, fns_d, foff_d = _dev_fuzz(arrays, cache)
             oks_d, okns_d, okoff_d, okpos_d = _dev_fuzz_ok(
                 arrays, cache, limit_date_ns)
@@ -420,6 +442,7 @@ class JaxBackend(Backend):
         # project-has-coverage guard) to pre-cutoff rows via a masked CSR
         # (dates ascend within a segment, so the mask keeps a prefix).
         cache = _study_cache(arrays)
+        _touch_limit(cache, limit_date_ns)
         cov_date_all = arrays.cov.columns["date_ns"]
         cov_pos, cov_offsets = _host_cov_cut(arrays, cache, limit_date_ns)
         has_cov = np.diff(cov_offsets) > 0
@@ -493,6 +516,7 @@ class JaxBackend(Backend):
         n_issues = issue_t.size
         cutoff_plus1 = limit_date_ns + DAY_NS
         cache = _study_cache(arrays)
+        _touch_limit(cache, limit_date_ns)
 
         fuzz_t = arrays.fuzz.columns["time_ns"]
         f_pos, f_off = _host_fuzz_ok(arrays, cache, limit_date_ns)
@@ -612,6 +636,7 @@ class JaxBackend(Backend):
         (`_rq4a_kernel`) over the cached pre-cutoff CSR."""
         P = arrays.n_projects
         cache = _study_cache(arrays)
+        _touch_limit(cache, limit_date_ns)
         f_pos, f_off = _host_fuzz_cut(arrays, cache, limit_date_ns)
         counts = np.diff(f_off)
         in_g = np.zeros(P, dtype=np.int8)  # 1 -> g1, 2 -> g2

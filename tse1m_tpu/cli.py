@@ -258,15 +258,14 @@ def _cmd_cluster(args) -> int:
         import numpy as np
 
         mesh = multihost.global_mesh()
-        # Pad the global row count to the mesh, feed only this process's
-        # contiguous slice, and cluster the pre-sharded global array.
-        n_pad = -(-args.n // mesh.devices.size) * mesh.devices.size
-        pad = np.zeros((n_pad - args.n,) + items.shape[1:], items.dtype)
-        padded = np.concatenate([items, pad])
-        lo, hi = multihost.local_row_range(n_pad)
-        items_d = multihost.put_process_local(
-            np.ascontiguousarray(padded[lo:hi], dtype=np.uint32),
-            n_pad, mesh)
+        # Feed only this process's contiguous LOGICAL slice; the padded-put
+        # helper grows the tail block to the mesh multiple with zero rows
+        # (any study size works — a real N is never a mesh multiple).
+        lo, hi = multihost.local_row_range(
+            multihost.padded_row_count(args.n, mesh))
+        items_d, _ = multihost.put_process_local_padded(
+            np.ascontiguousarray(items[lo:min(hi, args.n)], dtype=np.uint32),
+            args.n, mesh)
         labels = cluster_sessions(items_d, params, mesh=mesh)[:args.n]
         multihost.all_processes_ready("cluster-report")
     else:

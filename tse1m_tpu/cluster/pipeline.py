@@ -99,7 +99,9 @@ def cluster_sessions(items, params: ClusterParams | None = None,
             if items.shape[0] % mesh.devices.size:
                 raise ValueError(
                     "pre-sharded items must be padded to a multiple of the "
-                    "mesh size (see parallel/multihost.local_row_range)")
+                    "mesh size — feed through parallel/multihost."
+                    "put_process_local_padded and slice the labels back to "
+                    "the logical row count")
             n = items.shape[0]
             items_d = items
         else:
@@ -149,6 +151,13 @@ _MAX_CHUNKS = 4
 _PACK_LIMIT = 1 << 24
 
 
+def should_pack24(items: np.ndarray) -> bool:
+    """True when `items` takes the 24-bit packed H2D encoding (feature ids
+    all below _PACK_LIMIT) — THE pack decision the streamed pipeline ships;
+    probes (bench.py) must use this, not re-derive it."""
+    return bool(items.size) and bool(items.max() < _PACK_LIMIT)
+
+
 @jax.jit
 def _unpack24(packed):
     """[n, S, 3] uint8 little-endian -> [n, S] uint32 (on device)."""
@@ -180,7 +189,7 @@ def _minhash_streamed(items: np.ndarray, a, b, params: ClusterParams):
     if n_chunks == 0:
         n_chunks = int(min(_MAX_CHUNKS, max(1, items.nbytes // _CHUNK_BYTES)))
     kw = dict(use_pallas=params.use_pallas, block_n=params.block_n)
-    pack = bool(items.size) and items.max() < _PACK_LIMIT
+    pack = should_pack24(items)
 
     def put(chunk):
         if pack:

@@ -91,15 +91,32 @@ def global_mesh(axis: str = "data") -> jax.sharding.Mesh:
 def local_row_range(n_rows: int) -> tuple[int, int]:
     """[start, stop) of the global row axis this process must materialise.
 
-    Rows are dealt contiguously per process in process-index order, exactly
-    matching how `put_process_local` lays shards onto the mesh; the last
-    process absorbs the remainder.
+    The deal is DEVICE-aligned, not process-aligned: jax lays a 1-D
+    NamedSharding out as ceil(n / n_devices) rows per device (last device
+    truncated), and a process owns its local devices' contiguous block —
+    so this process's range is ``local_device_count * ceil(n / n_devices)``
+    rows starting at its first device's offset.  (A per-process ceil
+    division disagrees with that layout whenever a process holds more than
+    one device and n is not a device-count multiple — e.g. n=10 on
+    2 procs x 2 devices: jax places [0,6) on process 0's devices, not
+    [0,5).)  For mesh-multiple n — e.g. after `padded_row_count` — the two
+    deals coincide.
     """
-    nproc = jax.process_count()
-    pid = jax.process_index()
-    per = -(-n_rows // nproc)  # ceil division: contiguous, last may be short
-    start = min(pid * per, n_rows)
-    return start, min(start + per, n_rows)
+    n_dev = jax.device_count()
+    per_dev = -(-n_rows // n_dev)  # ceil: jax's per-shard row count
+    start = min(jax.process_index() * jax.local_device_count() * per_dev,
+                n_rows)
+    stop = min(start + jax.local_device_count() * per_dev, n_rows)
+    return start, stop
+
+
+def padded_row_count(n_rows: int, mesh: jax.sharding.Mesh | None = None) -> int:
+    """n_rows rounded up to the mesh's device-count multiple — the global
+    pad contract for pre-sharded pipelines (`cluster_sessions` requires a
+    mesh-multiple row axis; a real study size never is one).  Pad rows are
+    fed as zeros by the owning process and sliced off the result."""
+    k = mesh.devices.size if mesh is not None else jax.device_count()
+    return -(-n_rows // k) * k
 
 
 def put_process_local(local_rows: np.ndarray, n_global_rows: int,
@@ -118,6 +135,43 @@ def put_process_local(local_rows: np.ndarray, n_global_rows: int,
     global_shape = (n_global_rows,) + local_rows.shape[1:]
     return jax.make_array_from_process_local_data(sharding, local_rows,
                                                   global_shape)
+
+
+def put_process_local_padded(local_rows: np.ndarray, n_logical_rows: int,
+                             mesh: jax.sharding.Mesh,
+                             axis: str = "data") -> tuple[jax.Array, int]:
+    """`put_process_local` for an arbitrary (non-mesh-multiple) row count.
+
+    The global row axis is padded to ``padded_row_count(n_logical_rows)``;
+    ``local_rows`` must be this process's LOGICAL rows — the intersection
+    of ``local_row_range(padded_row_count(n))`` with ``[0, n)`` — and the
+    owner of the tail block grows it with zero rows here.  Returns
+    ``(global_array, n_padded)``; consumers slice results back to
+    ``[:n_logical_rows]``.
+    """
+    if mesh.devices.size != jax.device_count():
+        # local_row_range deals by the GLOBAL device count; a sub-mesh
+        # would make the pad multiple and the slice deal disagree and
+        # misplace rows.  The multihost feeding contract is the global
+        # mesh (`global_mesh()`).
+        raise ValueError(
+            f"put_process_local_padded requires the global mesh "
+            f"({jax.device_count()} devices), got a {mesh.devices.size}-"
+            "device sub-mesh")
+    n_pad = padded_row_count(n_logical_rows, mesh)
+    lo, hi = local_row_range(n_pad)
+    want_logical = min(hi, n_logical_rows) - min(lo, n_logical_rows)
+    if local_rows.shape[0] != want_logical:
+        raise ValueError(
+            f"process {jax.process_index()} must feed rows "
+            f"[{lo}, {min(hi, n_logical_rows)}) of the logical array "
+            f"({want_logical} rows), got {local_rows.shape[0]}")
+    missing = (hi - lo) - local_rows.shape[0]
+    if missing:
+        block = np.zeros((missing,) + local_rows.shape[1:],
+                         dtype=local_rows.dtype)
+        local_rows = np.concatenate([local_rows, block], axis=0)
+    return (put_process_local(local_rows, n_pad, mesh, axis), n_pad)
 
 
 def all_processes_ready(tag: str = "barrier") -> None:
