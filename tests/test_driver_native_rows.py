@@ -1,0 +1,134 @@
+"""Driver-native row decode (the psycopg2 shape, offline).
+
+A live Postgres returns rows that look nothing like sqlite's text rows:
+TIMESTAMPTZ -> tz-aware ``datetime``, DATE -> ``datetime.date``, TEXT[] ->
+Python ``list``.  The columnar extractor must decode BOTH shapes to
+identical arrays (``to_epoch_ns``'s mixed/utc ladder, ``parse_array``'s
+list branch).  ``tests/test_postgres_live.py`` proves this against a real
+server; this offline twin serves the SAME study through a wrapper that
+converts sqlite rows into psycopg2's exact shapes, so the decode ladder is
+pinned in environments without a server (like this one's CI).
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import json
+
+import numpy as np
+import pytest
+
+from tse1m_tpu.config import Config
+from tse1m_tpu.data.columnar import StudyArrays
+from tse1m_tpu.backend.pandas_backend import PandasBackend
+from tse1m_tpu.db.connection import DB
+from tse1m_tpu.data.synth import SynthSpec, generate_study
+
+UTC = dt.timezone.utc
+
+
+def _ts(text: str) -> dt.datetime:
+    """sqlite text timestamp -> what psycopg2 yields for TIMESTAMPTZ."""
+    return dt.datetime.fromisoformat(str(text).replace("T", " ")).replace(
+        tzinfo=UTC)
+
+
+def _date(text) -> dt.date:
+    s = str(text)[:10]
+    return dt.date.fromisoformat(s)
+
+
+def _arr(text) -> list:
+    return [] if text in (None, "") else list(json.loads(text))
+
+
+class FakePsycopgDB:
+    """Serves a sqlite study DB through psycopg2's row shapes.
+
+    dialect='postgres' also forces the extractor off the native sqlite
+    decoder, exactly like a real Postgres connection."""
+
+    dialect = "postgres"
+
+    _CONVERTERS = {
+        "SELECT project, name, timecreated, result, modules, revisions":
+            (None, None, _ts, None, _arr, _arr),
+        "SELECT project, name, timecreated, modules, revisions, result":
+            (None, None, _ts, _arr, _arr, None),
+        "SELECT project, number, rts, status, crash_type, severity":
+            (None, None, _ts, None, None, None),
+        "SELECT project, date, coverage, covered_line, total_line":
+            (None, _date, None, None, None),
+    }
+
+    def __init__(self, inner: DB):
+        self.inner = inner
+        self.config = inner.config
+
+    def query(self, sql, params=()):
+        rows = self.inner.query(sql, params)
+        conv = None
+        for prefix, c in self._CONVERTERS.items():
+            if sql.startswith(prefix):
+                conv = c
+                break
+        if conv is None:
+            return rows
+        return [tuple(v if f is None or v is None else f(v)
+                      for f, v in zip(conv, row)) for row in rows]
+
+
+@pytest.fixture(scope="module")
+def dbs(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("drv") / "study.sqlite")
+    cfg = Config(engine="sqlite", sqlite_path=path, limit_date="2026-01-01")
+    db = DB(config=cfg).connect()
+    generate_study(SynthSpec(n_projects=10, days=400, seed=21)).to_db(db)
+    yield db, cfg
+    db.closeConnection()
+
+
+def test_driver_native_rows_decode_identically(dbs):
+    db, cfg = dbs
+    want = StudyArrays.from_db(db, cfg)
+    got = StudyArrays.from_db(FakePsycopgDB(db), cfg)
+    assert not getattr(got, "native_decode", False)  # pandas ladder carried it
+    assert got.projects == want.projects
+    for table in ("fuzz", "covb", "issues", "cov"):
+        a, b = getattr(got, table), getattr(want, table)
+        np.testing.assert_array_equal(a.offsets, b.offsets, err_msg=table)
+    np.testing.assert_array_equal(got.fuzz.columns["time_ns"],
+                                  want.fuzz.columns["time_ns"])
+    np.testing.assert_array_equal(got.covb.columns["time_ns"],
+                                  want.covb.columns["time_ns"])
+    np.testing.assert_array_equal(got.issues.columns["time_ns"],
+                                  want.issues.columns["time_ns"])
+    np.testing.assert_array_equal(got.cov.columns["date_ns"],
+                                  want.cov.columns["date_ns"])
+    np.testing.assert_array_equal(got.fuzz.columns["ok"],
+                                  want.fuzz.columns["ok"])
+    for col in ("coverage", "covered", "total"):
+        np.testing.assert_array_equal(got.cov.columns[col],
+                                      want.cov.columns[col], err_msg=col)
+    # grouphash codes come from different raw forms (list vs json text);
+    # the grouping PATTERN must be identical.
+    ga, gb = got.covb.columns["grouphash"], want.covb.columns["grouphash"]
+    np.testing.assert_array_equal(ga[1:] == ga[:-1], gb[1:] == gb[:-1])
+
+
+def test_rq_results_identical_through_driver_native_rows(dbs):
+    db, cfg = dbs
+    limit_ns = int(np.datetime64(cfg.limit_date, "ns").astype(np.int64))
+    be = PandasBackend()
+    want = StudyArrays.from_db(db, cfg)
+    got = StudyArrays.from_db(FakePsycopgDB(db), cfg)
+    a = be.rq1_detection(got, limit_ns, min_projects=1)
+    b = be.rq1_detection(want, limit_ns, min_projects=1)
+    for f in ("iterations", "total_projects", "detected_counts", "link_idx"):
+        np.testing.assert_array_equal(getattr(a, f), getattr(b, f),
+                                      err_msg=f)
+    # rq3 drives parse_array + rev_hash over the list-shaped raw columns.
+    r3a = be.rq3_coverage_at_detection(got, limit_ns)
+    r3b = be.rq3_coverage_at_detection(want, limit_ns)
+    np.testing.assert_array_equal(r3a.det_issue_idx, r3b.det_issue_idx)
+    np.testing.assert_array_equal(r3a.det_diff_percent, r3b.det_diff_percent)

@@ -9,7 +9,7 @@ per-iteration histogram and one all-reduce merges them.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -41,6 +41,24 @@ def pad_to_devices(x: np.ndarray, mesh: Mesh, fill=0) -> tuple[np.ndarray, int]:
     return x, pad
 
 
+@lru_cache(maxsize=64)
+def _hist_kernel(mesh: Mesh, max_iter: int, axis: str):
+    # jit'd + cached by (mesh, max_iter, axis): a wrapper built inside the
+    # public function would discard its compile cache on every call (see
+    # rq_mesh.py's factory note).
+    @jax.jit
+    @partial(shard_map, mesh=mesh, in_specs=P(axis), out_specs=P())
+    def hist(shard):
+        # Out-of-range iterations route to the discarded 0 bin — same
+        # semantics as ops.segment.unique_pairs_count_per_iteration.
+        in_range = (shard >= 1) & (shard <= max_iter)
+        local = jnp.bincount(jnp.where(in_range, shard, 0),
+                             length=max_iter + 1)
+        return jax.lax.psum(local[1:], axis_name=axis)
+
+    return hist
+
+
 def detection_hist_sharded(iterations, max_iter: int, mesh: Mesh,
                            axis: str = "data"):
     """Per-iteration event histogram as a mesh collective.
@@ -51,14 +69,5 @@ def detection_hist_sharded(iterations, max_iter: int, mesh: Mesh,
     reference's per-issue counting loop (rq1_detection_rate.py:215-230).
     Returns a replicated [max_iter] int32 histogram.
     """
-
-    @partial(shard_map, mesh=mesh, in_specs=P(axis), out_specs=P())
-    def hist(shard):
-        # Out-of-range iterations route to the discarded 0 bin — same
-        # semantics as ops.segment.unique_pairs_count_per_iteration.
-        in_range = (shard >= 1) & (shard <= max_iter)
-        local = jnp.bincount(jnp.where(in_range, shard, 0),
-                             length=max_iter + 1)
-        return jax.lax.psum(local[1:], axis_name=axis)
-
-    return hist(jnp.asarray(iterations, dtype=jnp.int32))
+    return _hist_kernel(mesh, max_iter, axis)(
+        jnp.asarray(iterations, dtype=jnp.int32))

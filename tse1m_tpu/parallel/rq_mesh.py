@@ -24,7 +24,7 @@ single-device path (asserted by tests/test_mesh_rq.py):
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -36,6 +36,13 @@ from ..ops.segment import masked_mean, masked_spearman, segment_searchsorted
 from .mesh import make_mesh
 
 AXIS = "data"
+
+# Every kernel below is built by an lru_cache'd factory keyed on (mesh,
+# static closure params): a jit wrapper created inside the public function
+# body would be a fresh function object per call, so its compile cache
+# would be discarded every time and each mesh RQ call would re-trace and
+# re-compile (caught in round 4: the multichip scaling curve was
+# compile-dominated for exactly this reason).
 
 _F64_EXACT: dict = {}
 
@@ -107,22 +114,9 @@ def _fetch(out) -> np.ndarray:
 # RQ1: sharded issue axis + psum'd detection grid
 # ---------------------------------------------------------------------------
 
-def rq1_kernel_mesh(mesh: Mesh, fuzz_s, fuzz_ns, fuzz_offsets,
-                    ok_s, ok_ns, ok_offsets, ok_orig_idx,
-                    issue_s, issue_ns, issue_seg,
-                    n_projects: int, max_iter: int):
-    """Sharded twin of `jax_backend._rq1_kernel`: issues are split over the
-    mesh, build arrays ride replicated, and the unique-detected-projects
-    grid merges with a `psum` (integer, hence bit-exact vs single device).
-    Returns host arrays (iteration_of_issue, link_idx, detected)."""
-    n_dev = mesh.devices.size
-    q = int(np.asarray(issue_s).shape[0])
-    issue_s = _pad_rows(np.asarray(issue_s), n_dev, 0)
-    issue_ns = _pad_rows(np.asarray(issue_ns), n_dev, 0)
-    issue_seg = _pad_rows(np.asarray(issue_seg, dtype=np.int32), n_dev, 0)
-    valid = _pad_rows(np.ones(q, dtype=bool), n_dev, False)
-    have_ok = int(np.asarray(ok_orig_idx).shape[0]) > 0
-
+@lru_cache(maxsize=64)
+def _rq1_mesh_kernel(mesh: Mesh, n_projects: int, max_iter: int,
+                     have_ok: bool):
     @jax.jit
     @partial(shard_map, mesh=mesh,
              in_specs=(P(AXIS), P(AXIS), P(AXIS), P(AXIS),
@@ -149,6 +143,26 @@ def rq1_kernel_mesh(mesh: Mesh, fuzz_s, fuzz_ns, fuzz_offsets,
         detected = (merged[:, 1:] > 0).sum(axis=0, dtype=jnp.int32)
         return it, link, detected
 
+    return kernel
+
+
+def rq1_kernel_mesh(mesh: Mesh, fuzz_s, fuzz_ns, fuzz_offsets,
+                    ok_s, ok_ns, ok_offsets, ok_orig_idx,
+                    issue_s, issue_ns, issue_seg,
+                    n_projects: int, max_iter: int):
+    """Sharded twin of `jax_backend._rq1_kernel`: issues are split over the
+    mesh, build arrays ride replicated, and the unique-detected-projects
+    grid merges with a `psum` (integer, hence bit-exact vs single device).
+    Returns host arrays (iteration_of_issue, link_idx, detected)."""
+    n_dev = mesh.devices.size
+    q = int(np.asarray(issue_s).shape[0])
+    issue_s = _pad_rows(np.asarray(issue_s), n_dev, 0)
+    issue_ns = _pad_rows(np.asarray(issue_ns), n_dev, 0)
+    issue_seg = _pad_rows(np.asarray(issue_seg, dtype=np.int32), n_dev, 0)
+    valid = _pad_rows(np.ones(q, dtype=bool), n_dev, False)
+    have_ok = int(np.asarray(ok_orig_idx).shape[0]) > 0
+
+    kernel = _rq1_mesh_kernel(mesh, n_projects, max_iter, have_ok)
     it, link, detected = kernel(
         _placed(mesh, issue_s, P(AXIS)), _placed(mesh, issue_ns, P(AXIS)),
         _placed(mesh, issue_seg, P(AXIS)), _placed(mesh, valid, P(AXIS)),
@@ -163,6 +177,23 @@ def rq1_kernel_mesh(mesh: Mesh, fuzz_s, fuzz_ns, fuzz_offsets,
 # ---------------------------------------------------------------------------
 # RQ2 trends: session-sharded percentiles/means, project-psum counts
 # ---------------------------------------------------------------------------
+
+@lru_cache(maxsize=64)
+def _percentile_mesh_kernel(mesh: Mesh):
+    @jax.jit
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P(AXIS, None), P(AXIS, None), P(None, AXIS),
+                       P(None, AXIS)),
+             out_specs=(P(None, AXIS), P(None, AXIS)))
+    def kernel(x, m, lo_, hi_):
+        big = jnp.float32(np.finfo(np.float32).max)
+        srt = jnp.sort(jnp.where(m, x, big), axis=-1)  # valid entries first
+        vlo = jnp.take_along_axis(srt, lo_.T, axis=-1).T
+        vhi = jnp.take_along_axis(srt, hi_.T, axis=-1).T
+        return vlo, vhi
+
+    return kernel
+
 
 def percentile_by_session_mesh(cols, colmask, q, mesh: Mesh):
     """masked_percentile over [S, P] with the session axis sharded.
@@ -191,19 +222,8 @@ def percentile_by_session_mesh(cols, colmask, q, mesh: Mesh):
     hi = np.clip(lo + 1, 0, width - 1)
     frac = pos - lo.astype(np.float32)
 
-    @jax.jit
-    @partial(shard_map, mesh=mesh,
-             in_specs=(P(AXIS, None), P(AXIS, None), P(None, AXIS),
-                       P(None, AXIS)),
-             out_specs=(P(None, AXIS), P(None, AXIS)))
-    def kernel(x, m, lo_, hi_):
-        big = jnp.float32(np.finfo(np.float32).max)
-        srt = jnp.sort(jnp.where(m, x, big), axis=-1)  # valid entries first
-        vlo = jnp.take_along_axis(srt, lo_.T, axis=-1).T
-        vhi = jnp.take_along_axis(srt, hi_.T, axis=-1).T
-        return vlo, vhi
-
-    vlo, vhi = kernel(_placed(mesh, cols, P(AXIS, None)),
+    vlo, vhi = _percentile_mesh_kernel(mesh)(
+        _placed(mesh, cols, P(AXIS, None)),
                       _placed(mesh, colmask, P(AXIS, None)),
                       _placed(mesh, lo, P(None, AXIS)),
                       _placed(mesh, hi, P(None, AXIS)))
@@ -215,22 +235,26 @@ def percentile_by_session_mesh(cols, colmask, q, mesh: Mesh):
     return out.astype(np.float64)[:, :s]
 
 
-def mean_by_session_mesh(cols, colmask, mesh: Mesh):
-    """masked_mean over [S, P] sharded on the session axis (bit-exact)."""
-    n_dev = mesh.devices.size
-    s = cols.shape[0]
-    cols = _pad_rows(np.asarray(cols, dtype=np.float32), n_dev, 0.0)
-    colmask = _pad_rows(np.asarray(colmask, dtype=bool), n_dev, False)
-
+@lru_cache(maxsize=64)
+def _mean_mesh_kernel(mesh: Mesh):
     @jax.jit
     @partial(shard_map, mesh=mesh, in_specs=(P(AXIS, None), P(AXIS, None)),
              out_specs=P(AXIS))
     def kernel(x, m):
         return masked_mean(x, m)
 
-    return _fetch(kernel(_placed(mesh, cols, P(AXIS, None)),
-                         _placed(mesh, colmask, P(AXIS, None)))
-                  ).astype(np.float64)[:s]
+    return kernel
+
+
+def mean_by_session_mesh(cols, colmask, mesh: Mesh):
+    """masked_mean over [S, P] sharded on the session axis (bit-exact)."""
+    n_dev = mesh.devices.size
+    s = cols.shape[0]
+    cols = _pad_rows(np.asarray(cols, dtype=np.float32), n_dev, 0.0)
+    colmask = _pad_rows(np.asarray(colmask, dtype=bool), n_dev, False)
+    return _fetch(_mean_mesh_kernel(mesh)(
+        _placed(mesh, cols, P(AXIS, None)),
+        _placed(mesh, colmask, P(AXIS, None)))).astype(np.float64)[:s]
 
 
 def counts_by_project_psum(mask, mesh: Mesh) -> np.ndarray:
@@ -240,15 +264,19 @@ def counts_by_project_psum(mask, mesh: Mesh) -> np.ndarray:
     exact."""
     n_dev = mesh.devices.size
     mask = _pad_rows(np.asarray(mask, dtype=bool), n_dev, False)
+    return _fetch(_counts_mesh_kernel(mesh)(
+        _placed(mesh, mask, P(AXIS, None)))).astype(np.int64)
 
+
+@lru_cache(maxsize=64)
+def _counts_mesh_kernel(mesh: Mesh):
     @jax.jit
     @partial(shard_map, mesh=mesh, in_specs=(P(AXIS, None),),
              out_specs=P())
     def kernel(m):
         return jax.lax.psum(m.sum(axis=0, dtype=jnp.int32), AXIS)
 
-    return _fetch(kernel(_placed(mesh, mask, P(AXIS, None)))
-                  ).astype(np.int64)
+    return kernel
 
 
 def spearman_by_project_mesh(matrix, mask, mesh: Mesh):
@@ -258,21 +286,50 @@ def spearman_by_project_mesh(matrix, mask, mesh: Mesh):
     p = matrix.shape[0]
     matrix = _pad_rows(np.asarray(matrix, dtype=np.float32), n_dev, 0.0)
     mask = _pad_rows(np.asarray(mask, dtype=bool), n_dev, False)
+    return _fetch(_spearman_mesh_kernel(mesh)(
+        _placed(mesh, matrix, P(AXIS, None)),
+        _placed(mesh, mask, P(AXIS, None)))).astype(np.float64)[:p]
 
+
+@lru_cache(maxsize=64)
+def _spearman_mesh_kernel(mesh: Mesh):
     @jax.jit
     @partial(shard_map, mesh=mesh, in_specs=(P(AXIS, None), P(AXIS, None)),
              out_specs=P(AXIS))
     def kernel(x, m):
         return masked_spearman(x, m)
 
-    return _fetch(kernel(_placed(mesh, matrix, P(AXIS, None)),
-                         _placed(mesh, mask, P(AXIS, None)))
-                  ).astype(np.float64)[:p]
+    return kernel
 
 
 # ---------------------------------------------------------------------------
 # RQ4b: float64 per-session group percentiles, session-sharded
 # ---------------------------------------------------------------------------
+
+@lru_cache(maxsize=64)
+def _nanpercentile_mesh_kernel(mesh: Mesh, qf_key: tuple, g: int):
+    qf_arr = np.asarray(qf_key, dtype=np.float64)
+
+    @jax.jit
+    @partial(shard_map, mesh=mesh, in_specs=(P(AXIS, None),),
+             out_specs=(P(None, AXIS), P(None, AXIS), P(AXIS)))
+    def kernel(x):
+        m = ~jnp.isnan(x)
+        n = m.sum(axis=-1)                       # [s_shard]
+        filled = jnp.where(m, x, jnp.inf)
+        srt = jnp.sort(filled, axis=-1)          # valid first
+        # virtual index per numpy's linear method: (n-1) * (q/100)
+        pos = (n - 1).astype(jnp.float64) * jnp.asarray(qf_arr)[:, None]
+        lo = jnp.clip(jnp.floor(pos).astype(jnp.int32), 0,
+                      max(g - 1, 0))
+        hi = jnp.minimum(lo + 1,
+                         jnp.maximum(n - 1, 0).astype(jnp.int32)[None, :])
+        vlo = jnp.take_along_axis(srt, lo.T, axis=-1).T
+        vhi = jnp.take_along_axis(srt, hi.T, axis=-1).T
+        return vlo, vhi, n
+
+    return kernel
+
 
 def nanpercentile_by_session_mesh(sub: np.ndarray, q, mesh: Mesh) -> np.ndarray:
     """Bit-exact `np.nanpercentile(sub, q, axis=0)` with the heavy work — the
@@ -306,25 +363,7 @@ def nanpercentile_by_session_mesh(sub: np.ndarray, q, mesh: Mesh) -> np.ndarray:
     cols = _pad_rows(np.ascontiguousarray(sub.T), n_dev, np.nan)  # [S', G]
 
     with jax.enable_x64(True):
-
-        @jax.jit
-        @partial(shard_map, mesh=mesh, in_specs=(P(AXIS, None),),
-                 out_specs=(P(None, AXIS), P(None, AXIS), P(AXIS)))
-        def kernel(x):
-            m = ~jnp.isnan(x)
-            n = m.sum(axis=-1)                       # [s_shard]
-            filled = jnp.where(m, x, jnp.inf)
-            srt = jnp.sort(filled, axis=-1)          # valid first
-            # virtual index per numpy's linear method: (n-1) * (q/100)
-            pos = (n - 1).astype(jnp.float64) * jnp.asarray(qf)[:, None]
-            lo = jnp.clip(jnp.floor(pos).astype(jnp.int32), 0,
-                          max(g - 1, 0))
-            hi = jnp.minimum(lo + 1,
-                             jnp.maximum(n - 1, 0).astype(jnp.int32)[None, :])
-            vlo = jnp.take_along_axis(srt, lo.T, axis=-1).T
-            vhi = jnp.take_along_axis(srt, hi.T, axis=-1).T
-            return vlo, vhi, n
-
+        kernel = _nanpercentile_mesh_kernel(mesh, tuple(qf.tolist()), g)
         vlo, vhi, n = kernel(_placed(mesh, cols.astype(np.float64),
                                      P(AXIS, None)))
 
@@ -346,6 +385,19 @@ def nanpercentile_by_session_mesh(sub: np.ndarray, q, mesh: Mesh) -> np.ndarray:
 # RQ3/RQ4a: per-segment searchsorted with the query axis sharded
 # ---------------------------------------------------------------------------
 
+@lru_cache(maxsize=64)
+def _searchsorted_mesh_kernel(mesh: Mesh, side: str):
+    @jax.jit
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P(AXIS), P(AXIS), P(AXIS), P(), P(), P()),
+             out_specs=P(AXIS))
+    def kernel(queries, queries_lo_, seg_, vals, vals_lo, off):
+        return segment_searchsorted(vals, off, queries, seg_, side=side,
+                                    values_lo=vals_lo, queries_lo=queries_lo_)
+
+    return kernel
+
+
 def segment_searchsorted_mesh(mesh: Mesh, values_s, offsets, queries_s,
                               query_seg, side: str,
                               values_lo, queries_lo) -> np.ndarray:
@@ -366,14 +418,7 @@ def segment_searchsorted_mesh(mesh: Mesh, values_s, offsets, queries_s,
     qlo = _pad_rows(np.asarray(queries_lo), n_dev, 0)
     seg = _pad_rows(np.asarray(query_seg, dtype=np.int32), n_dev, 0)
 
-    @jax.jit
-    @partial(shard_map, mesh=mesh,
-             in_specs=(P(AXIS), P(AXIS), P(AXIS), P(), P(), P()),
-             out_specs=P(AXIS))
-    def kernel(queries, queries_lo_, seg_, vals, vals_lo, off):
-        return segment_searchsorted(vals, off, queries, seg_, side=side,
-                                    values_lo=vals_lo, queries_lo=queries_lo_)
-
+    kernel = _searchsorted_mesh_kernel(mesh, side)
     out = kernel(_placed(mesh, qs, P(AXIS)), _placed(mesh, qlo, P(AXIS)),
                  _placed(mesh, seg, P(AXIS)),
                  _placed(mesh, values_s, P()), _placed(mesh, values_lo, P()),
