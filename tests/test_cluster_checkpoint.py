@@ -1,0 +1,119 @@
+"""Checkpointed cluster pipeline (cluster/checkpoint.py +
+cluster_sessions_resumable) — SURVEY §5 A4's device-side seat: per-chunk
+signature shards with kill-and-resume, the TPU analogue of the reference's
+batch-file checkpointing (2_get_buildlog_metadata.py:141-147).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import tse1m_tpu.cluster.pipeline as pipeline_mod
+from tse1m_tpu.cluster import (ClusterParams, cluster_sessions,
+                               cluster_sessions_resumable)
+from tse1m_tpu.cluster.checkpoint import ClusterCheckpoint
+from tse1m_tpu.data.synth import synth_session_sets
+
+# 2048 rows at block_n=512 and 4 chunks -> 4 shards of 512 rows.
+PARAMS = ClusterParams(n_hashes=32, n_bands=4, use_pallas="never",
+                       h2d_chunks=4)
+N = 2048
+
+
+@pytest.fixture(scope="module")
+def items():
+    return synth_session_sets(N, set_size=16, seed=13)[0]
+
+
+def test_resumable_matches_plain(items, tmp_path):
+    want = cluster_sessions(items, PARAMS)
+    got = cluster_sessions_resumable(items, PARAMS,
+                                     checkpoint_dir=str(tmp_path / "ck"))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_cleanup_after_success(items, tmp_path):
+    d = tmp_path / "ck"
+    cluster_sessions_resumable(items, PARAMS, checkpoint_dir=str(d))
+    assert not list(d.glob("shard_*.npz"))
+    assert not (d / "manifest.json").exists()
+
+
+def test_kill_and_resume_recomputes_only_missing_chunks(items, tmp_path,
+                                                        monkeypatch):
+    d = str(tmp_path / "ck")
+    want = cluster_sessions(items, PARAMS)
+
+    class Boom(RuntimeError):
+        pass
+
+    # "Kill" the run after two chunks have been durably saved.
+    saved = []
+    real_save = ClusterCheckpoint.save_chunk
+
+    def dying_save(self, index, sig, keys):
+        real_save(self, index, sig, keys)
+        saved.append(index)
+        if len(saved) == 2:
+            raise Boom()
+
+    monkeypatch.setattr(ClusterCheckpoint, "save_chunk", dying_save)
+    with pytest.raises(Boom):
+        cluster_sessions_resumable(items, PARAMS, checkpoint_dir=d)
+    monkeypatch.setattr(ClusterCheckpoint, "save_chunk", real_save)
+
+    # Resume: only the remaining chunks may hit the compute path.
+    computed = []
+    real_mk = pipeline_mod.minhash_and_keys
+
+    def counting_mk(*a, **kw):
+        computed.append(1)
+        return real_mk(*a, **kw)
+
+    monkeypatch.setattr(pipeline_mod, "minhash_and_keys", counting_mk)
+    got = cluster_sessions_resumable(items, PARAMS, checkpoint_dir=d)
+    n_chunks = -(-N // 512)
+    assert len(computed) == n_chunks - 2
+    np.testing.assert_array_equal(got, want)
+
+
+def test_crash_mid_write_recomputes_that_chunk(items, tmp_path, monkeypatch):
+    """A torn shard write (crash between file write and manifest update)
+    must leave the chunk 'not done'."""
+    d = str(tmp_path / "ck")
+
+    class Boom(RuntimeError):
+        pass
+
+    real_save = ClusterCheckpoint.save_chunk
+
+    def torn_save(self, index, sig, keys):
+        if index == 1:
+            # shard file lands, manifest never updates
+            np.savez(self._shard_path(index) + ".tmp.npz", sig=sig,
+                     keys=keys)
+            raise Boom()
+        real_save(self, index, sig, keys)
+
+    monkeypatch.setattr(ClusterCheckpoint, "save_chunk", torn_save)
+    with pytest.raises(Boom):
+        cluster_sessions_resumable(items, PARAMS, checkpoint_dir=d)
+    monkeypatch.setattr(ClusterCheckpoint, "save_chunk", real_save)
+    ck = ClusterCheckpoint(d, items, PARAMS, 512)
+    assert not ck.chunk_done(1)
+    assert ck.chunk_done(0)
+    got = cluster_sessions_resumable(items, PARAMS, checkpoint_dir=d)
+    np.testing.assert_array_equal(got, cluster_sessions(items, PARAMS))
+
+
+def test_refuses_mismatched_checkpoint(items, tmp_path):
+    d = str(tmp_path / "ck")
+    ClusterCheckpoint(d, items, PARAMS, 512)
+    other = ClusterParams(n_hashes=64, n_bands=4, use_pallas="never")
+    with pytest.raises(ValueError, match="different"):
+        ClusterCheckpoint(d, items, other, 512)
+    # different items too
+    items2 = synth_session_sets(N, set_size=16, seed=99)[0]
+    with pytest.raises(ValueError, match="different"):
+        ClusterCheckpoint(d, items2, PARAMS, 512)

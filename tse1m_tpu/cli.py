@@ -257,6 +257,12 @@ def _cmd_cluster(args) -> int:
     if distributed:
         import numpy as np
 
+        if args.checkpoint_dir:
+            log.warning("--checkpoint-dir is ignored under multi-host: "
+                        "per-chunk checkpointing is single-process only "
+                        "(give each process its own directory and the "
+                        "resumable API if you need it); this run is NOT "
+                        "checkpointed")
         mesh = multihost.global_mesh()
         # Feed only this process's contiguous LOGICAL slice; the padded-put
         # helper grows the tail block to the mesh multiple with zero rows
@@ -269,7 +275,10 @@ def _cmd_cluster(args) -> int:
         labels = cluster_sessions(items_d, params, mesh=mesh)[:args.n]
         multihost.all_processes_ready("cluster-report")
     else:
-        labels = cluster_sessions(items, params)
+        from .cluster import cluster_sessions_resumable
+
+        labels = cluster_sessions_resumable(
+            items, params, checkpoint_dir=args.checkpoint_dir)
     ari = adjusted_rand_index(labels, truth)
     k = min(args.ari_sample, args.n)
     report = {"n_sessions": args.n,
@@ -334,6 +343,10 @@ def main(argv=None) -> int:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--ari-sample", type=int, default=10_000,
                    help="subsample size for the device-vs-host ARI gate")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="persist per-chunk signature shards here; a killed "
+                        "run re-invoked with the same dir resumes at the "
+                        "first unfinished chunk (single-process path)")
     p.set_defaults(fn=_cmd_cluster)
 
     args = ap.parse_args(argv)

@@ -16,7 +16,8 @@ vs the host baseline.  Pipeline (SURVEY.md §7.2 step 5):
 from .metrics import adjusted_rand_index
 from .minhash import band_keys, make_hash_params, minhash_signatures
 from .host import host_cluster
-from .pipeline import ClusterParams, cluster_sessions
+from .pipeline import (ClusterParams, cluster_sessions,
+                       cluster_sessions_resumable)
 
 __all__ = [
     "adjusted_rand_index",
@@ -26,4 +27,5 @@ __all__ = [
     "host_cluster",
     "ClusterParams",
     "cluster_sessions",
+    "cluster_sessions_resumable",
 ]

@@ -158,6 +158,30 @@ def should_pack24(items: np.ndarray) -> bool:
     return bool(items.size) and bool(items.max() < _PACK_LIMIT)
 
 
+def _stream_plan(items: np.ndarray, params: ClusterParams) -> tuple[int, bool]:
+    """(chunk step, pack?) — THE chunking policy, shared by the streamed
+    and resumable paths so their chunks always align.  step >= n means
+    single-shot (chunking off or input too small to double-buffer); chunks
+    land on block_n boundaries so the pallas path pads at most the final
+    chunk."""
+    n = items.shape[0]
+    n_chunks = params.h2d_chunks
+    if n_chunks == 0:
+        n_chunks = int(min(_MAX_CHUNKS, max(1, items.nbytes // _CHUNK_BYTES)))
+    pack = should_pack24(items)
+    if n_chunks <= 1 or n < 2 * params.block_n:
+        return max(n, 1), pack
+    step = -(-n // n_chunks)
+    return -(-step // params.block_n) * params.block_n, pack
+
+
+def _put_chunk(chunk: np.ndarray, pack: bool):
+    """Stage one chunk on device (24-bit packed when the plan says so)."""
+    if pack:
+        return _unpack24(jax.device_put(_pack24_host(chunk)))
+    return jax.device_put(chunk)
+
+
 @jax.jit
 def _unpack24(packed):
     """[n, S, 3] uint8 little-endian -> [n, S] uint32 (on device)."""
@@ -173,6 +197,54 @@ def _pack24_host(chunk: np.ndarray) -> np.ndarray:
         chunk[..., None].view(np.uint8)[..., :3])
 
 
+def cluster_sessions_resumable(items, params: ClusterParams | None = None,
+                               checkpoint_dir: str | None = None,
+                               cleanup: bool = True) -> np.ndarray:
+    """`cluster_sessions` with per-chunk checkpoint/resume (SURVEY §5 A4).
+
+    Each streamed chunk's (signatures, band keys) shard persists under
+    ``checkpoint_dir`` as it completes (`cluster/checkpoint.py`); a killed
+    run re-invoked with the same directory recomputes only unfinished
+    chunks, then proceeds to label propagation.  ``cleanup`` removes the
+    shards after a successful run.  With no directory this is exactly
+    `cluster_sessions`.  Single-host form; a pod job gives each process
+    its own directory for its local row range.
+    """
+    params = params or ClusterParams()
+    if checkpoint_dir is None:
+        return cluster_sessions(items, params)
+    from .checkpoint import ClusterCheckpoint
+
+    items = np.ascontiguousarray(items, dtype=np.uint32)
+    n = items.shape[0]
+    if n == 0:
+        return np.empty(0, np.int32)
+    a, b = make_hash_params(params.n_hashes, params.seed)
+    a, b = jnp.asarray(a), jnp.asarray(b)
+    step, pack = _stream_plan(items, params)  # same chunks as streamed path
+    ckpt = ClusterCheckpoint(checkpoint_dir, items, params, step)
+    kw = dict(use_pallas=params.use_pallas, block_n=params.block_n)
+
+    parts = []
+    for idx, i in enumerate(range(0, n, step)):
+        if ckpt.chunk_done(idx):
+            sig_h, keys_h = ckpt.load_chunk(idx)
+            parts.append((jax.device_put(sig_h), jax.device_put(keys_h)))
+            continue
+        sig, keys = minhash_and_keys(_put_chunk(items[i:i + step], pack),
+                                     a, b, params.n_bands, **kw)
+        # D2H for durability: the persisted shard IS the resume state.
+        ckpt.save_chunk(idx, np.asarray(sig), np.asarray(keys))
+        parts.append((sig, keys))
+    sig = jnp.concatenate([p[0] for p in parts])
+    keys = jnp.concatenate([p[1] for p in parts])
+    labels = np.asarray(_cluster_from_sig_jit(sig, keys, params.threshold,
+                                              params.n_iters))
+    if cleanup:
+        ckpt.cleanup()
+    return labels
+
+
 def _minhash_streamed(items: np.ndarray, a, b, params: ClusterParams):
     """items -> (signatures, band keys), overlapping H2D with compute.
 
@@ -185,27 +257,15 @@ def _minhash_streamed(items: np.ndarray, a, b, params: ClusterParams):
     the unchunked path because MinHash is row-independent.
     """
     n = items.shape[0]
-    n_chunks = params.h2d_chunks
-    if n_chunks == 0:
-        n_chunks = int(min(_MAX_CHUNKS, max(1, items.nbytes // _CHUNK_BYTES)))
+    step, pack = _stream_plan(items, params)
     kw = dict(use_pallas=params.use_pallas, block_n=params.block_n)
-    pack = should_pack24(items)
-
-    def put(chunk):
-        if pack:
-            return _unpack24(jax.device_put(_pack24_host(chunk)))
-        return jax.device_put(chunk)
-
-    if n_chunks <= 1 or n < 2 * params.block_n:
-        return minhash_and_keys(put(items), a, b, params.n_bands, **kw)
-    # Chunk on block_n boundaries so the pallas path pads at most the
-    # final chunk.
-    step = -(-n // n_chunks)
-    step = -(-step // params.block_n) * params.block_n
+    if step >= n:
+        return minhash_and_keys(_put_chunk(items, pack), a, b,
+                                params.n_bands, **kw)
     parts = []
     for i in range(0, n, step):
-        parts.append(minhash_and_keys(put(items[i:i + step]), a, b,
-                                      params.n_bands, **kw))
+        parts.append(minhash_and_keys(_put_chunk(items[i:i + step], pack),
+                                      a, b, params.n_bands, **kw))
     sig = jnp.concatenate([p[0] for p in parts])
     keys = jnp.concatenate([p[1] for p in parts])
     return sig, keys
