@@ -116,9 +116,18 @@ def bench_rq_suite(arrays, cfg, extract_wall_s: float, iters: int = 3) -> dict:
         "rq4b": lambda b: b.rq4b_group_trends(arrays, limit_ns, g1, g2),
     }
 
-    backends = {"jax": JaxBackend(), "pandas": PandasBackend()}
+    from tse1m_tpu.backend import get_backend
+    from tse1m_tpu.config import Config
+
+    # The auto router is timed as a third column, constructed through the
+    # SHIPPED resolution path (off-TPU or probe failure -> host oracle, on
+    # TPU -> per-RQ router) so the column reports the configuration a user
+    # actually gets.  It shares the device backend's study cache, so its
+    # device-routed calls are warm too.
+    backends = {"jax": JaxBackend(), "pandas": PandasBackend(),
+                "auto": get_backend(Config(backend="auto"))}
     out = {}
-    suite = {"jax": 0.0, "pandas": 0.0}
+    suite = {k: 0.0 for k in backends}
     res = {}
     for name, call in calls.items():
         for key, be in backends.items():
@@ -164,6 +173,7 @@ def bench_rq_suite(arrays, cfg, extract_wall_s: float, iters: int = 3) -> dict:
         "rq1_iterations": int(len(res[("rq1", "jax")].iterations)),
         "rq_suite_jax_wall_s": round(suite["jax"], 4),
         "rq_suite_pandas_wall_s": round(suite["pandas"], 4),
+        "rq_suite_auto_wall_s": round(suite["auto"], 4),
         "rq_suite_winner": ("jax_tpu" if suite["jax"] <= suite["pandas"]
                             else "pandas"),
         "rq1_end_to_end_s": round(end_to_end, 4),

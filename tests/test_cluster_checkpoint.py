@@ -105,6 +105,11 @@ def test_crash_mid_write_recomputes_that_chunk(items, tmp_path, monkeypatch):
     assert ck.chunk_done(0)
     got = cluster_sessions_resumable(items, PARAMS, checkpoint_dir=d)
     np.testing.assert_array_equal(got, cluster_sessions(items, PARAMS))
+    # cleanup after the successful resume also swept the orphaned tmp file
+    import glob
+    import os
+
+    assert not glob.glob(os.path.join(d, "shard_*"))
 
 
 def test_refuses_mismatched_checkpoint(items, tmp_path):

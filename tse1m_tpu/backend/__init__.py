@@ -3,13 +3,15 @@ analysis scripts call :func:`get_backend` and receive the primitive set;
 which engine answers is decided by ``program/envFile.ini`` /
 ``TSE1M_BACKEND``.
 
-``auto`` resolves per machine: the device backend only pays when device
-dispatch is local-class.  Over a tunneled/remote PJRT link every call
-carries the network round-trip (~110 ms measured on this environment's
-tunnel), which no amount of kernel fusion can hide for the millisecond-
-scale RQ reductions of an extracted study — so auto picks the host oracle
-there, and the TPU backend on co-located hardware (TPU VM / pod), where
-the same fused kernels win.  The round-trip probe runs once per process.
+``auto`` (the shipped default) resolves to a per-RQ router
+(`auto.AutoBackend`): each RQ call goes to the engine predicted to win on
+this machine, using the measured device dispatch round-trip and per-RQ
+host-cost estimates.  Off-TPU it is simply the host oracle.  Round-4
+measurement behind this: on a tunneled PJRT link (~110 ms round-trip) the
+device still wins the loop-heavy RQs at the 1M-build scale (rq2 change
+points 1.80 s -> 0.48 s, rq3 1.29 s -> 0.21 s) while the host wins the
+vectorized ones (rq1 18 ms) — so neither pure engine is the right default.
+The round-trip probe runs once per process.
 """
 
 from __future__ import annotations
@@ -18,14 +20,6 @@ from ..config import Config
 from ..utils.logging import get_logger
 
 log = get_logger("backend")
-
-# Local PCIe/ICI-attached dispatch round-trips are O(100us); anything
-# slower than this is a remote link where the host oracle wins the
-# ms-scale RQ calls (round-3/4 measurements: 0.1-0.2ms co-located,
-# ~110ms tunneled).
-_LOCAL_RTT_S = 0.005
-
-_auto_choice: str | None = None
 
 
 def _dispatch_rtt_s() -> float:
@@ -48,11 +42,14 @@ def _dispatch_rtt_s() -> float:
     return sorted(samples)[1]
 
 
-def resolve_auto_backend() -> str:
-    """'jax_tpu' when a TPU is attached with local-class dispatch latency,
-    else 'pandas'.  Cached for the process lifetime."""
-    global _auto_choice
-    if _auto_choice is None:
+_auto_rtt_s: float | None = None
+
+
+def _probed_rtt_s() -> float | None:
+    """Cached per-process dispatch round-trip on TPU; None when the device
+    probe is unavailable (no TPU, or bring-up failed)."""
+    global _auto_rtt_s
+    if _auto_rtt_s is None:
         # auto is the shipped default, so it must never be the reason an
         # analysis run dies: any jax bring-up or probe failure (stale
         # libtpu, device held by another process) resolves to the host
@@ -61,23 +58,29 @@ def resolve_auto_backend() -> str:
             import jax
 
             if jax.default_backend() != "tpu":
-                _auto_choice = "pandas"
+                _auto_rtt_s = -1.0
             else:
-                rtt = _dispatch_rtt_s()
-                _auto_choice = "jax_tpu" if rtt < _LOCAL_RTT_S else "pandas"
-                log.info("backend=auto: TPU dispatch RTT %.1f ms -> %s",
-                         rtt * 1e3, _auto_choice)
+                _auto_rtt_s = _dispatch_rtt_s()
+                log.info("backend=auto: TPU dispatch RTT %.1f ms "
+                         "(per-RQ routing active)", _auto_rtt_s * 1e3)
         except Exception as e:
             log.warning("backend=auto: device probe failed (%s: %s); "
                         "using pandas", type(e).__name__, e)
-            _auto_choice = "pandas"
-    return _auto_choice
+            _auto_rtt_s = -1.0
+    return None if _auto_rtt_s < 0 else _auto_rtt_s
 
 
 def get_backend(cfg: Config):
     choice = cfg.backend
     if choice == "auto":
-        choice = resolve_auto_backend()
+        rtt = _probed_rtt_s()
+        if rtt is None:
+            from .pandas_backend import PandasBackend
+
+            return PandasBackend()
+        from .auto import AutoBackend
+
+        return AutoBackend(rtt)
     if choice == "jax_tpu":
         from .jax_backend import JaxBackend
 
@@ -87,4 +90,4 @@ def get_backend(cfg: Config):
     return PandasBackend()
 
 
-__all__ = ["get_backend", "resolve_auto_backend"]
+__all__ = ["get_backend"]

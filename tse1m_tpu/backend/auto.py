@@ -1,0 +1,110 @@
+"""Per-RQ routing backend (the resolved form of ``backend = auto``).
+
+Round-4 measurement on the 1M-build study (BENCH_r04): the best engine is
+per-RQ, not global.  The host oracle wins the RQs whose pandas form is a
+handful of vectorized array ops (rq1 18 ms, rq4a 13 ms), while the device
+wins the ones whose host form walks per-project/per-group loops (rq2
+change points 1.80 s -> 0.48 s, rq3 1.29 s -> 0.21 s) — even over a
+tunneled PJRT link where every device call pays ~110 ms round-trip.  On
+co-located TPU hardware (round-trip ~0.1-0.2 ms) the device wins
+everything above a few thousand rows.
+
+One rule covers both regimes: route an RQ to the device when its estimated
+host cost exceeds a few link round-trips,
+
+    rows * host_cost_per_row > _RTT_MULTIPLE * dispatch_rtt
+
+with per-RQ cost coefficients fitted from the measured suite.  The two
+engines are bit-parity-tested against each other (tests/test_*.py,
+bench_rq_suite), so routing is a pure performance decision.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .base import Backend
+from ..utils.logging import get_logger
+
+log = get_logger("backend.auto")
+
+# Estimated host seconds per relevant row, fitted from BENCH_r04 at ~1M
+# builds (713k coverage builds, 415k coverage days, 10k issues):
+#   rq1   0.018 s / 1.0M fuzz rows      (vectorized searchsorted)
+#   rq2cp 1.80 s  / 713k covb rows      (per-project group loop)
+#   rq2tr 0.34 s  / 415k cov rows       (matrix build + scipy loops)
+#   rq3   1.29 s  / 1.14M rows          (three per-issue scans)
+#   rq4a  0.013 s / 1.0M fuzz rows      (vectorized)
+#   rq4b  0.13 s  / 415k cov rows       (nanpercentile columns)
+_COEF = {
+    "rq1": 2e-8,
+    "rq2cp": 2.5e-6,
+    "rq2tr": 8e-7,
+    "rq3": 1.1e-6,
+    "rq4a": 2e-8,
+    "rq4b": 3e-7,
+}
+# Device path must beat the host estimate by this many dispatch round-trips
+# before it is chosen — one fused dispatch + one fetch + margin.
+_RTT_MULTIPLE = 4.0
+
+
+class AutoBackend(Backend):
+    """Routes each RQ call to the engine predicted to win on this machine.
+
+    ``rtt_s`` is the measured device dispatch round-trip
+    (`backend._dispatch_rtt_s`); both engines are constructed lazily and
+    share the device backend's per-study cache."""
+
+    name = "auto"
+
+    def __init__(self, rtt_s: float):
+        self._rtt_s = float(rtt_s)
+        self._jax = None
+        self._pd = None
+
+    def _engine(self, key: str, rows: int) -> Backend:
+        use_jax = rows * _COEF[key] > _RTT_MULTIPLE * self._rtt_s
+        if use_jax:
+            if self._jax is None:
+                from .jax_backend import JaxBackend
+
+                self._jax = JaxBackend()
+            return self._jax
+        if self._pd is None:
+            from .pandas_backend import PandasBackend
+
+            self._pd = PandasBackend()
+        return self._pd
+
+    @staticmethod
+    def _rows(arrays, *tables) -> int:
+        return int(sum(len(getattr(arrays, t)) for t in tables))
+
+    def rq1_detection(self, arrays, limit_date_ns, min_projects):
+        be = self._engine("rq1", self._rows(arrays, "fuzz"))
+        return be.rq1_detection(arrays, limit_date_ns, min_projects)
+
+    def rq2_change_points(self, arrays, limit_date_ns):
+        be = self._engine("rq2cp", self._rows(arrays, "covb"))
+        return be.rq2_change_points(arrays, limit_date_ns)
+
+    def rq2_trends(self, arrays, limit_date_ns):
+        be = self._engine("rq2tr", self._rows(arrays, "cov"))
+        return be.rq2_trends(arrays, limit_date_ns)
+
+    def rq3_coverage_at_detection(self, arrays, limit_date_ns):
+        be = self._engine("rq3", self._rows(arrays, "fuzz", "covb", "cov"))
+        return be.rq3_coverage_at_detection(arrays, limit_date_ns)
+
+    def rq4a_detection_trend(self, arrays, limit_date_ns, g1_idx, g2_idx,
+                             min_projects):
+        be = self._engine("rq4a", self._rows(arrays, "fuzz"))
+        return be.rq4a_detection_trend(arrays, limit_date_ns, g1_idx,
+                                       g2_idx, min_projects)
+
+    def rq4b_group_trends(self, arrays, limit_date_ns, g1_idx, g2_idx,
+                          percentiles=(25, 50, 75)):
+        be = self._engine("rq4b", self._rows(arrays, "cov"))
+        return be.rq4b_group_trends(arrays, limit_date_ns, g1_idx, g2_idx,
+                                    percentiles)

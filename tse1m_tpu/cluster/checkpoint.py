@@ -119,7 +119,14 @@ class ClusterCheckpoint:
             return z["sig"], z["keys"]
 
     def cleanup(self) -> None:
-        """Remove shards + manifest after a completed run."""
+        """Remove shards + manifest after a completed run — including any
+        orphaned ``.tmp.npz`` left by a crash mid-save (a torn write is
+        invisible to resume, but its temp file still occupies disk)."""
+        import glob
+
+        for p in glob.glob(os.path.join(self.directory,
+                                        "shard_*.npz.tmp.npz")):
+            os.remove(p)
         for i in range(self.n_chunks):
             p = self._shard_path(i)
             if os.path.exists(p):
