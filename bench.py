@@ -350,11 +350,18 @@ def main() -> int:
 
         pack = should_pack24(items)
         payload = _pack24_host(items) if pack else items
-        med, mbps = _timed_h2d(payload)
+        samples = []
+        for _ in range(3):
+            samples.append(_timed_h2d(payload, reps=1)[0])
+        med = statistics.median(samples)
         return {
             "transfer_mb": round(payload.nbytes / 2**20, 1),
             "transfer_s": round(med, 4),
-            "transfer_MBps": round(mbps, 1),
+            # The tunnel varies ~2x minute-to-minute; the per-rep list
+            # (and best) keep one slow window from reading as the bound.
+            "transfer_runs_s": [round(s, 4) for s in samples],
+            "transfer_best_s": round(min(samples), 4),
+            "transfer_MBps": round(payload.nbytes / med / 1e6, 1),
             "transfer_packed24": pack,
         }
 

@@ -168,3 +168,10 @@ def test_run_rq4a_end_to_end(study_db, tmp_path, corpus_csv, backend):
     for pdf in ("rq4_g1_g2_detection_trend.pdf", "rq4_gc_detection_trend.pdf",
                 "rq4_gc_bug_detection_venn.pdf"):
         assert os.path.exists(tmp_path / "rq4" / "bug" / pdf)
+
+
+def test_missing_corpus_csv_fails_with_guidance(tmp_path):
+    """A missing C8 output must die with the fix, not a pandas traceback
+    (the reference's rq4a_bug.py:34 read_csv crash)."""
+    with pytest.raises(SystemExit, match="cli synth|collect corpus"):
+        load_corpus_groups(str(tmp_path / "absent.csv"), {"p"})

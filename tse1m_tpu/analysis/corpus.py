@@ -18,6 +18,7 @@ and both backends share this exact code path so parity is structural.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 
 import numpy as np
@@ -52,6 +53,14 @@ def load_corpus_groups(csv_path: str, eligible: set,
                        days_threshold: int = 7) -> CorpusGroups:
     """rq4a_bug.py:82-121 — missing CSV file is an error; missing rows
     default to G1."""
+    if not os.path.exists(csv_path):
+        # The reference dies with a raw pandas traceback here; fail with
+        # the fix instead (rq4a/rq4b consume C8's output, rq4a_bug.py:34).
+        raise SystemExit(
+            f"corpus analysis CSV not found at {csv_path}. Generate it "
+            "first: `python -m tse1m_tpu.cli synth` (synthetic study) or "
+            "`python -m tse1m_tpu.cli collect corpus` (real data); or "
+            "point corpus_csv/TSE1M_CORPUS_CSV at an existing file.")
     df = pd.read_csv(csv_path)
     df["corpus_commit_time"] = pd.to_datetime(
         df["corpus_commit_time"], errors="coerce", utc=True, format="mixed")
