@@ -32,7 +32,9 @@ def _compile() -> bool:
     import numpy as np
 
     cmd = [
-        "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+        # C++20 for heterogeneous (string_view) unordered_map lookup in the
+        # hot per-cell scan (decode.cc SvMap).
+        "g++", "-O2", "-std=c++20", "-shared", "-fPIC",
         "-I" + sysconfig.get_paths()["include"],
         "-I" + np.get_include(),
         _SRC,
