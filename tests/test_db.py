@@ -139,9 +139,12 @@ def test_per_project_coverage_builders_match_bulk(study_db):
     for project in targets[:4]:
         sql, params = queries.coverage_builds(project)
         per = study_db.query(sql, params)
-        expect = [(r[1], r[0], r[2], "Coverage", r[5], r[3], r[4])
-                  for r in bulk if r[0] == project and r[5] == "Finish"]
-        assert per == expect
+        # bulk rows: (project, timecreated, modules, revisions, result) —
+        # no name (nothing consumes coverage-build names); compare the
+        # per-project builder's rows projected onto the bulk columns.
+        per_proj = [(r[1], r[2], r[5], r[6], r[4]) for r in per]
+        expect = [r for r in bulk if r[0] == project and r[4] == "Finish"]
+        assert per_proj == expect
         sql, params = queries.total_coverage_each_project(
             project, "coverage", LIMIT)
         per_cov = study_db.query(sql, params)

@@ -53,8 +53,8 @@ class FakePsycopgDB:
     _CONVERTERS = {
         "SELECT project, name, timecreated, result, modules, revisions":
             (None, None, _ts, None, _arr, _arr),
-        "SELECT project, name, timecreated, modules, revisions, result":
-            (None, None, _ts, _arr, _arr, None),
+        "SELECT project, timecreated, modules, revisions, result":
+            (None, _ts, _arr, _arr, None),
         "SELECT project, number, rts, status, crash_type, severity":
             (None, None, _ts, None, None, None),
         "SELECT project, date, coverage, covered_line, total_line":
@@ -73,6 +73,13 @@ class FakePsycopgDB:
                 conv = c
                 break
         if conv is None:
+            # Only the eligibility query (single project column) may pass
+            # through unconverted — any other study SELECT slipping
+            # through means a stale prefix and psycopg2 shapes silently
+            # not exercised (caught live in round 4 when covb dropped its
+            # name column).
+            assert sql.startswith("SELECT project FROM total_coverage"), \
+                f"stale converter prefix for: {sql[:80]}"
             return rows
         return [tuple(v if f is None or v is None else f(v)
                       for f, v in zip(conv, row)) for row in rows]

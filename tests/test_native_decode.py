@@ -52,6 +52,8 @@ def synth_db(tmp_path_factory):
 
 
 def _assert_arrays_equal(a: StudyArrays, b: StudyArrays):
+    from tse1m_tpu.data.columnar import CodedColumn
+
     assert a.projects == b.projects
     for table in ("fuzz", "covb", "issues", "cov"):
         sa, sb = getattr(a, table), getattr(b, table)
@@ -59,6 +61,16 @@ def _assert_arrays_equal(a: StudyArrays, b: StudyArrays):
         assert sa.columns.keys() == sb.columns.keys()
         for col, va in sa.columns.items():
             vb = sb.columns[col]
+            if isinstance(va, CodedColumn) or isinstance(vb, CodedColumn):
+                # Both paths must produce the coded form with identical
+                # codes AND vocab (factorize first-appearance order ==
+                # the native intern order).
+                assert type(va) is type(vb), (table, col)
+                np.testing.assert_array_equal(va.codes, vb.codes,
+                                              err_msg=f"{table}.{col}.codes")
+                np.testing.assert_array_equal(va.vocab, vb.vocab,
+                                              err_msg=f"{table}.{col}.vocab")
+                continue
             assert va.dtype == vb.dtype, (table, col)
             np.testing.assert_array_equal(va, vb, err_msg=f"{table}.{col}")
 

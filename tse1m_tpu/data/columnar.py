@@ -121,6 +121,38 @@ def _native_db_path(db: DB) -> str | None:
     return path
 
 
+class CodedColumn:
+    """Dictionary-encoded text column: int32 codes + object vocab.
+
+    The native decoder's 'c' spec (decode.cc) and the pandas fallback's
+    factorize both produce this — ZERO per-row Python objects for the
+    heavy interned columns (result, covb modules/revisions), which were
+    ~1 s of the 1M-build extraction as object arrays.  Supports exactly
+    what consumers need: ``len``, scalar indexing -> str|None (artifact
+    writers, lazy revhash), and slice/fancy indexing -> CodedColumn (the
+    CSR re-sort and ``Segmented.segment``).  Code -1 = NULL."""
+
+    __slots__ = ("codes", "vocab")
+
+    def __init__(self, codes: np.ndarray, vocab: np.ndarray):
+        self.codes = np.asarray(codes, dtype=np.int32)
+        self.vocab = np.asarray(vocab, dtype=object)
+
+    def __len__(self) -> int:
+        return int(self.codes.size)
+
+    def __getitem__(self, i):
+        if isinstance(i, (int, np.integer)):
+            c = int(self.codes[i])
+            return None if c < 0 else self.vocab[c]
+        return CodedColumn(self.codes[i], self.vocab)
+
+    def materialize(self) -> np.ndarray:
+        """Object-array form (None for NULL) — for rare full-column uses."""
+        padded = np.append(self.vocab, None)  # code -1 -> last slot
+        return padded[self.codes]
+
+
 @dataclass
 class Segmented:
     """One table's per-project CSR view."""
@@ -147,8 +179,9 @@ class StudyArrays:
     # covb_revhash_at, artifact writers).
     fuzz: Segmented       # columns: time_ns, name, result, ok,
     #                                modules_raw, revisions_raw
-    covb: Segmented       # columns: time_ns, name, result, ok,
-    #                                modules_raw, revisions_raw, grouphash
+    covb: Segmented       # columns: time_ns, result, ok, modules_raw,
+    #                                revisions_raw, grouphash (no name —
+    #                                nothing consumes coverage-build names)
     issues: Segmented     # columns: time_ns, number, status, crash_type
     cov: Segmented        # columns: date_ns, coverage, covered, total
 
@@ -188,10 +221,10 @@ class StudyArrays:
         plan = {
             "fuzz": (queries.all_fuzzing_builds_bulk(projects),
                      ["project", "name", "timecreated", "result",
-                      "modules", "revisions"], "putsuu"),
+                      "modules", "revisions"], "putcuu"),
             "covb": (queries.coverage_builds_bulk(projects),
-                     ["project", "name", "timecreated", "modules",
-                      "revisions", "result"], "putsss"),
+                     ["project", "timecreated", "modules",
+                      "revisions", "result"], "ptccc"),
             "issues": (queries.issues_bulk(projects, cfg.limit_date,
                                            fixed_only=True),
                        ["project", "number", "rts", "status", "crash_type",
@@ -235,7 +268,8 @@ class StudyArrays:
             out = None
             raw = prefetched.get(table)
             if raw is not None:
-                out = dict(zip(cols, raw))
+                out = {c: (CodedColumn(*v) if sp == "c" else v)
+                       for c, sp, v in zip(cols, spec, raw)}
                 native_fetches += 1
             if out is None:
                 rows = db.query(sql, params)
@@ -249,13 +283,36 @@ class StudyArrays:
                         out[c] = to_epoch_ns(df[c])
                     elif sp == "f":
                         out[c] = df[c].astype(np.float64).to_numpy()
+                    elif sp == "c":
+                        ser = df[c]
+                        try:
+                            codes, uniq = pd.factorize(ser,
+                                                       use_na_sentinel=True)
+                        except TypeError:
+                            # Driver-native rows (psycopg2 TEXT[] -> list)
+                            # are unhashable; tuples keep the original
+                            # values in the vocab (parse_array accepts
+                            # tuples), unlike a lossy str() projection.
+                            ser = ser.map(lambda v: tuple(v)
+                                          if isinstance(v, list) else v)
+                            codes, uniq = pd.factorize(ser,
+                                                       use_na_sentinel=True)
+                        out[c] = CodedColumn(codes,
+                                             np.asarray(uniq, dtype=object))
                     else:
                         out[c] = df[c].to_numpy(dtype=object)
             codes = out.pop(cols[0]).astype(np.int64, copy=False)
             order = np.argsort(codes, kind="stable")
             return ({c: v[order] for c, v in out.items()}, codes[order])
 
-        def ok_mask(result_col: np.ndarray) -> np.ndarray:
+        def ok_mask(result_col) -> np.ndarray:
+            if isinstance(result_col, CodedColumn):
+                ok_vocab = np.isin(result_col.vocab, list(RESULT_OK))
+                c = result_col.codes
+                good = np.zeros(c.size, dtype=bool)
+                valid = c >= 0
+                good[valid] = ok_vocab[c[valid]]
+                return good
             return pd.Series(result_col, dtype=object).isin(
                 RESULT_OK).to_numpy(dtype=bool)
 
@@ -286,17 +343,14 @@ class StudyArrays:
         ctb, ccodes = fetch("covb")
 
         def col_codes(vals) -> np.ndarray:
+            # CodedColumn ('c' fetches, both native and fallback) already
+            # IS the factorization; +1 folds NULL (-1) into its own
+            # non-negative group.
+            if isinstance(vals, CodedColumn):
+                return vals.codes.astype(np.int64) + 1
             s = pd.Series(vals, dtype=object)
-            try:
-                return pd.factorize(s, use_na_sentinel=False)[0].astype(
-                    np.int64)
-            except TypeError:
-                # Driver-native rows (psycopg2 TEXT[] -> Python list) are
-                # unhashable; stringify first — Postgres extraction takes
-                # the pandas path anyway, so the extra pass is off the
-                # native fast path.
-                return pd.factorize(s.astype(str),
-                                    use_na_sentinel=False)[0].astype(np.int64)
+            return pd.factorize(s, use_na_sentinel=True)[0].astype(
+                np.int64) + 1
 
         if len(ccodes):
             cm = col_codes(ctb["modules"])
@@ -308,7 +362,6 @@ class StudyArrays:
             offsets=_offsets_from_sorted_codes(ccodes, len(projects)),
             columns={
                 "time_ns": ctb["timecreated"],
-                "name": ctb["name"],
                 "result": ctb["result"],
                 "ok": ok_mask(ctb["result"]),
                 # Raw, like fuzz: RQ3 hashes only detection candidates

@@ -116,9 +116,13 @@ def coverage_builds_bulk(targets: Sequence[str]) -> Query:
     Downstream paths mask by result instead (RQ2 change-points keep
     RESULT_OK rows — note the reference's 'HalfWay' spelling in
     rq2_coverage_and_added.py:65 / rq3:261 silently matched only 'Finish'
-    against the DB's 'Halfway' vocabulary; we use the canonical enum)."""
+    against the DB's 'Halfway' vocabulary; we use the canonical enum).
+
+    ``name`` is deliberately NOT selected: no RQ consumes coverage-build
+    names, and decoding 713k near-unique strings cost ~0.25 s of the
+    1M-build extraction wall."""
     return (
-        "SELECT project, name, timecreated, modules, revisions, result "
+        "SELECT project, timecreated, modules, revisions, result "
         "FROM buildlog_data "
         f"WHERE build_type = 'Coverage' AND project IN {_in(targets)} "
         "ORDER BY project, timecreated",

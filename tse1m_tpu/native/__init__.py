@@ -31,23 +31,32 @@ _tried = False
 def _compile() -> bool:
     import numpy as np
 
-    cmd = [
-        # C++20 for heterogeneous (string_view) unordered_map lookup in the
-        # hot per-cell scan (decode.cc SvMap).
-        "g++", "-O2", "-std=c++20", "-shared", "-fPIC",
-        "-I" + sysconfig.get_paths()["include"],
-        "-I" + np.get_include(),
-        _SRC,
-        "-l:libsqlite3.so.0",
-    ]
+    def cmd(std: str) -> list:
+        return [
+            "g++", "-O2", std, "-shared", "-fPIC",
+            "-I" + sysconfig.get_paths()["include"],
+            "-I" + np.get_include(),
+            _SRC,
+            "-l:libsqlite3.so.0",
+        ]
+
     # Atomic replace so concurrent first-callers never import a half-written
     # object; the temp file must live on the same filesystem for rename.
     fd, tmp = tempfile.mkstemp(suffix=".so", dir=os.path.dirname(_SO))
     os.close(fd)
     try:
-        proc = subprocess.run(cmd + ["-o", tmp], capture_output=True,
-                              text=True, timeout=300)
-        if proc.returncode != 0:
+        # C++20 first (heterogeneous string_view map lookup in the hot
+        # per-cell scan — decode.cc SvMap); toolchains without it (g++ <11)
+        # retry C++17, where decode.cc compiles its std::string-temporary
+        # lookup form — slower per cell but the native path stays alive.
+        proc = None
+        for std in ("-std=c++20", "-std=c++17"):
+            proc = subprocess.run(cmd(std) + ["-o", tmp],
+                                  capture_output=True, text=True,
+                                  timeout=300)
+            if proc.returncode == 0:
+                break
+        if proc is None or proc.returncode != 0:
             log.info("native decode build failed (falling back to pandas "
                      "path): %s", proc.stderr.strip().splitlines()[-1]
                      if proc.stderr.strip() else proc.returncode)

@@ -162,7 +162,12 @@ struct TextRef {
 // std::unordered_map<std::string, …>::find forces a std::string temporary
 // per CELL — ~4M heap allocations per 1M-build study across the key and
 // intern maps.  Transparent hash/eq let the scan probe with a string_view
-// and allocate only on first insertion of a distinct value.
+// and allocate only on first insertion of a distinct value.  Generic
+// unordered lookup needs C++20/libstdc++ >= 11; older toolchains compile
+// the std::string-temporary form instead (the Python builder retries with
+// -std=c++17) — slower per cell, but the native path stays alive.
+#if defined(__cpp_lib_generic_unordered_lookup) && \
+    __cpp_lib_generic_unordered_lookup >= 201811L
 struct SvHash {
   using is_transparent = void;
   size_t operator()(std::string_view s) const noexcept {
@@ -174,6 +179,17 @@ struct SvHash {
 };
 using SvMap =
     std::unordered_map<std::string, int32_t, SvHash, std::equal_to<>>;
+template <typename M>
+inline auto sv_find(M &m, std::string_view k) {
+  return m.find(k);
+}
+#else
+using SvMap = std::unordered_map<std::string, int32_t>;
+template <typename M>
+inline auto sv_find(M &m, std::string_view k) {
+  return m.find(std::string(k));
+}
+#endif
 
 struct Col {
   char spec;                          // p/t/f/s/u/o
@@ -238,7 +254,7 @@ std::string scan(const std::string &db_path, const std::string &sql,
           if (ty != SQLITE_TEXT) return fail("key column must be TEXT");
           const char *sp = reinterpret_cast<const char *>(
               sqlite3_column_text(stmt, ci));
-          auto it = keymap.find(std::string_view(
+          auto it = sv_find(keymap, std::string_view(
               sp, static_cast<size_t>(sqlite3_column_bytes(stmt, ci))));
           if (it == keymap.end()) return fail("key value not in key_values");
           c.i32.push_back(it->second);
@@ -270,7 +286,8 @@ std::string scan(const std::string &db_path, const std::string &sql,
                         "(caller should fall back)");
           break;
         }
-        case 's': {
+        case 's':
+        case 'c': {  // same interned scan; they differ at materialize
           if (ty == SQLITE_NULL) {
             c.i32.push_back(-1);
             break;
@@ -279,7 +296,7 @@ std::string scan(const std::string &db_path, const std::string &sql,
               sqlite3_column_text(stmt, ci));
           const std::string_view key(
               sp, static_cast<size_t>(sqlite3_column_bytes(stmt, ci)));
-          auto it = c.intern.find(key);
+          auto it = sv_find(c.intern, key);
           if (it == c.intern.end()) {
             it = c.intern
                      .emplace(std::string(key),
@@ -366,6 +383,33 @@ PyObject *materialize(Col &c) {
     default:
       break;
   }
+  if (c.spec == 'c') {
+    // Coded column: (int32 codes, vocab list) — ZERO per-row Python
+    // objects.  -1 = NULL; vocab order is first appearance (matches
+    // pd.factorize in the fallback, so codes are byte-identical).
+    PyObject *codes = numeric_array(c.i32, NPY_INT32);
+    if (!codes) return nullptr;
+    PyObject *vocab = PyList_New(static_cast<Py_ssize_t>(c.distinct.size()));
+    if (!vocab) {
+      Py_DECREF(codes);
+      return nullptr;
+    }
+    for (size_t i = 0; i < c.distinct.size(); i++) {
+      PyObject *o = PyUnicode_DecodeUTF8(
+          c.distinct[i].data(),
+          static_cast<Py_ssize_t>(c.distinct[i].size()), nullptr);
+      if (!o) {
+        Py_DECREF(codes);
+        Py_DECREF(vocab);
+        return nullptr;
+      }
+      PyList_SET_ITEM(vocab, static_cast<Py_ssize_t>(i), o);
+    }
+    PyObject *pair = PyTuple_Pack(2, codes, vocab);
+    Py_DECREF(codes);
+    Py_DECREF(vocab);
+    return pair;
+  }
   const size_t n_rows = c.spec == 's' ? c.i32.size() : c.text.size();
   npy_intp n = static_cast<npy_intp>(n_rows);
   PyObject *arr = PyArray_SimpleNew(1, &n, NPY_OBJECT);
@@ -421,6 +465,9 @@ PyObject *materialize(Col &c) {
 //   t  TEXT ISO8601 -> int64 epoch-ns
 //   f  numeric -> float64 (NULL -> NaN; TEXT rejected)
 //   s  TEXT -> object array, values interned per column
+//   c  TEXT -> (int32 codes, vocab list) — interned like 's' but with NO
+//      per-row Python objects (codes match pd.factorize's first-appearance
+//      order; -1 = NULL)
 //   u  TEXT -> object array, no interning (high-cardinality, e.g. names)
 //   o  object array preserving sqlite's native type (int/float/text/None)
 PyObject *fetch_table(PyObject *, PyObject *args) {
@@ -436,7 +483,7 @@ PyObject *fetch_table(PyObject *, PyObject *args) {
   std::vector<Col> cols(spec.size());
   for (size_t i = 0; i < spec.size(); i++) {
     cols[i].spec = spec[i];
-    if (!strchr("ptfsuo", spec[i])) return err("unknown spec char");
+    if (!strchr("ptfscuo", spec[i])) return err("unknown spec char");
   }
 
   // Extract params / keys into pure C++ while still holding the GIL.
