@@ -52,7 +52,7 @@ def synth_db(tmp_path_factory):
 
 
 def _assert_arrays_equal(a: StudyArrays, b: StudyArrays):
-    from tse1m_tpu.data.columnar import CodedColumn
+    from tse1m_tpu.data.columnar import BytesColumn, CodedColumn
 
     assert a.projects == b.projects
     for table in ("fuzz", "covb", "issues", "cov"):
@@ -61,6 +61,17 @@ def _assert_arrays_equal(a: StudyArrays, b: StudyArrays):
         assert sa.columns.keys() == sb.columns.keys()
         for col, va in sa.columns.items():
             vb = sb.columns[col]
+            if isinstance(va, BytesColumn) or isinstance(vb, BytesColumn):
+                # Both paths must produce the lazy form over an identical
+                # arena layout (same row order -> same offsets).
+                assert type(va) is type(vb), (table, col)
+                np.testing.assert_array_equal(va.arena, vb.arena,
+                                              err_msg=f"{table}.{col}.arena")
+                np.testing.assert_array_equal(va.starts, vb.starts,
+                                              err_msg=f"{table}.{col}.starts")
+                np.testing.assert_array_equal(va.lens, vb.lens,
+                                              err_msg=f"{table}.{col}.lens")
+                continue
             if isinstance(va, CodedColumn) or isinstance(vb, CodedColumn):
                 # Both paths must produce the coded form with identical
                 # codes AND vocab (factorize first-appearance order ==
@@ -183,3 +194,41 @@ def test_interned_and_object_columns(tmp_path):
     assert nums[0] == 1 and isinstance(nums[0], int)
     assert nums[1] == 2.5 and isinstance(nums[1], float)
     assert nums[2] == "txt" and nums[3] is None
+
+
+@needs_native
+def test_null_text_cells_parity(tmp_path, monkeypatch):
+    """NULL cells in 'b' (lazy bytes) and 'c' (coded) columns must decode
+    identically on both paths — including the starts array layout (the
+    native scan records start 0 for NULLs; round-4 review caught the
+    fallback recording the running offset instead)."""
+    from tse1m_tpu.db.schema import create_schema
+
+    cfg = Config(engine="sqlite", sqlite_path=str(tmp_path / "n.sqlite"),
+                 limit_date="2026-01-01", min_coverage_days=1)
+    db = DB(config=cfg).connect()
+    create_schema(db)
+    db.executeMany(
+        "INSERT INTO buildlog_data (name, project, timecreated, build_type,"
+        " result, modules, revisions) VALUES (?,?,?,?,?,?,?)",
+        [("b1", "p0", "2024-01-01 10:00:00", "Fuzzing", "Finish",
+          '["m1"]', None),
+         ("b2", "p0", "2024-01-02 10:00:00", "Fuzzing", "Error", None,
+          '["r2"]'),
+         ("c1", "p0", "2024-01-01 11:00:00", "Coverage", "Finish", None,
+          '["r1"]')])
+    db.executeMany(
+        "INSERT INTO total_coverage (project, date, coverage, covered_line,"
+        " total_line) VALUES (?,?,?,?,?)",
+        [("p0", "2024-01-01", 10.0, 1.0, 10.0)])
+    native = StudyArrays.from_db(db, cfg, projects=["p0"])
+    assert native.native_decode
+    monkeypatch.setattr(columnar, "_native_db_path", lambda _db: None)
+    fallback = StudyArrays.from_db(db, cfg, projects=["p0"])
+    _assert_arrays_equal(native, fallback)
+    # NULL semantics through the lazy accessors
+    assert native.fuzz.columns["revisions_raw"][0] is None
+    assert native.fuzz.columns["modules_raw"][1] is None
+    assert fallback.fuzz.columns["modules_raw"][1] is None
+    assert native.covb.columns["modules_raw"][0] is None
+    db.closeConnection()

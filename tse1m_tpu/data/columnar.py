@@ -153,6 +153,63 @@ class CodedColumn:
         return padded[self.codes]
 
 
+class BytesColumn:
+    """Lazy text column: one shared uint8 arena + per-row (start, len).
+
+    The native decoder's 'b' spec — near-unique columns (build names,
+    fuzz modules/revisions) whose ~1M-per-table PyUnicode materialisations
+    dominated the extraction wall, while consumers (artifact writers, the
+    lazy revhash) touch only tiny subsets.  Cells decode on scalar access;
+    slice/fancy indexing shares the arena.  len -1 = NULL."""
+
+    __slots__ = ("arena", "starts", "lens")
+
+    def __init__(self, arena: np.ndarray, starts: np.ndarray,
+                 lens: np.ndarray):
+        self.arena = np.asarray(arena, dtype=np.uint8)
+        self.starts = np.asarray(starts, dtype=np.int64)
+        self.lens = np.asarray(lens, dtype=np.int32)
+
+    @classmethod
+    def from_objects(cls, vals) -> "BytesColumn":
+        """Fallback-path constructor from str|None cells (raises
+        AttributeError on non-str cells, e.g. driver-native lists —
+        callers keep the object array then)."""
+        n = len(vals)
+        starts = np.empty(n, np.int64)
+        lens = np.empty(n, np.int32)
+        parts = []
+        pos = 0
+        for i, v in enumerate(vals):
+            if v is None:
+                starts[i] = 0   # matches the native scan's {0, -1} NULLs
+                lens[i] = -1
+            else:
+                b = v.encode("utf-8")
+                parts.append(b)
+                starts[i] = pos
+                lens[i] = len(b)
+                pos += len(b)
+        arena = np.frombuffer(b"".join(parts), dtype=np.uint8)
+        return cls(arena, starts, lens)
+
+    def __len__(self) -> int:
+        return int(self.starts.size)
+
+    def __getitem__(self, i):
+        if isinstance(i, (int, np.integer)):
+            ln = int(self.lens[i])
+            if ln < 0:
+                return None
+            s = int(self.starts[i])
+            return self.arena[s:s + ln].tobytes().decode("utf-8")
+        return BytesColumn(self.arena, self.starts[i], self.lens[i])
+
+    def materialize(self) -> np.ndarray:
+        """Object-array form — for rare full-column uses."""
+        return np.array([self[i] for i in range(len(self))], dtype=object)
+
+
 @dataclass
 class Segmented:
     """One table's per-project CSR view."""
@@ -221,7 +278,7 @@ class StudyArrays:
         plan = {
             "fuzz": (queries.all_fuzzing_builds_bulk(projects),
                      ["project", "name", "timecreated", "result",
-                      "modules", "revisions"], "putcuu"),
+                      "modules", "revisions"], "pbtcbb"),
             "covb": (queries.coverage_builds_bulk(projects),
                      ["project", "timecreated", "modules",
                       "revisions", "result"], "ptccc"),
@@ -268,8 +325,14 @@ class StudyArrays:
             out = None
             raw = prefetched.get(table)
             if raw is not None:
-                out = {c: (CodedColumn(*v) if sp == "c" else v)
-                       for c, sp, v in zip(cols, spec, raw)}
+                out = {}
+                for c, sp, v in zip(cols, spec, raw):
+                    if sp == "c":
+                        out[c] = CodedColumn(*v)
+                    elif sp == "b":
+                        out[c] = BytesColumn(*v)
+                    else:
+                        out[c] = v
                 native_fetches += 1
             if out is None:
                 rows = db.query(sql, params)
@@ -299,22 +362,30 @@ class StudyArrays:
                                                        use_na_sentinel=True)
                         out[c] = CodedColumn(codes,
                                              np.asarray(uniq, dtype=object))
+                    elif sp == "b":
+                        vals = df[c].to_numpy(dtype=object)
+                        try:
+                            out[c] = BytesColumn.from_objects(vals)
+                        except AttributeError:
+                            # Driver-native rows (psycopg2 TEXT[] lists):
+                            # keep the original objects — consumers index
+                            # scalars and parse_array accepts lists.
+                            out[c] = vals
                     else:
                         out[c] = df[c].to_numpy(dtype=object)
             codes = out.pop(cols[0]).astype(np.int64, copy=False)
             order = np.argsort(codes, kind="stable")
             return ({c: v[order] for c, v in out.items()}, codes[order])
 
-        def ok_mask(result_col) -> np.ndarray:
-            if isinstance(result_col, CodedColumn):
-                ok_vocab = np.isin(result_col.vocab, list(RESULT_OK))
-                c = result_col.codes
-                good = np.zeros(c.size, dtype=bool)
-                valid = c >= 0
-                good[valid] = ok_vocab[c[valid]]
-                return good
-            return pd.Series(result_col, dtype=object).isin(
-                RESULT_OK).to_numpy(dtype=bool)
+        def ok_mask(result_col: CodedColumn) -> np.ndarray:
+            # result is a 'c' fetch on both the native and fallback paths,
+            # so the vocabulary test covers the whole column.
+            ok_vocab = np.isin(result_col.vocab, list(RESULT_OK))
+            c = result_col.codes
+            good = np.zeros(c.size, dtype=bool)
+            valid = c >= 0
+            good[valid] = ok_vocab[c[valid]]
+            return good
 
         # Fuzzing builds (bulk; replaces ALL_FUZZING_BUILD per-project loop).
         ftb, fcodes = fetch("fuzz")
@@ -342,19 +413,11 @@ class StudyArrays:
         # strings — ~0.5 s of the extraction wall at the 1M-build scale.)
         ctb, ccodes = fetch("covb")
 
-        def col_codes(vals) -> np.ndarray:
-            # CodedColumn ('c' fetches, both native and fallback) already
-            # IS the factorization; +1 folds NULL (-1) into its own
-            # non-negative group.
-            if isinstance(vals, CodedColumn):
-                return vals.codes.astype(np.int64) + 1
-            s = pd.Series(vals, dtype=object)
-            return pd.factorize(s, use_na_sentinel=True)[0].astype(
-                np.int64) + 1
-
         if len(ccodes):
-            cm = col_codes(ctb["modules"])
-            cr = col_codes(ctb["revisions"])
+            # The 'c' fetches already ARE the factorization; +1 folds NULL
+            # (-1) into its own non-negative group.
+            cm = ctb["modules"].codes.astype(np.int64) + 1
+            cr = ctb["revisions"].codes.astype(np.int64) + 1
             ghash = cm * (int(cr.max()) + 1) + cr
         else:
             ghash = np.empty(0, np.int64)

@@ -49,17 +49,21 @@ def _compile() -> bool:
         # per-cell scan — decode.cc SvMap); toolchains without it (g++ <11)
         # retry C++17, where decode.cc compiles its std::string-temporary
         # lookup form — slower per cell but the native path stays alive.
-        proc = None
+        errors = []
         for std in ("-std=c++20", "-std=c++17"):
             proc = subprocess.run(cmd(std) + ["-o", tmp],
                                   capture_output=True, text=True,
                                   timeout=300)
             if proc.returncode == 0:
                 break
-        if proc is None or proc.returncode != 0:
+            tail = (proc.stderr.strip().splitlines()[-1]
+                    if proc.stderr.strip() else proc.returncode)
+            errors.append(f"{std}: {tail}")
+        else:
+            # Every attempt's diagnostic is kept — the first one usually
+            # names the real problem, the retry's would mask it.
             log.info("native decode build failed (falling back to pandas "
-                     "path): %s", proc.stderr.strip().splitlines()[-1]
-                     if proc.stderr.strip() else proc.returncode)
+                     "path): %s", " | ".join(map(str, errors)))
             return False
         os.replace(tmp, _SO)
         return True

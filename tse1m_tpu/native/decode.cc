@@ -307,7 +307,8 @@ std::string scan(const std::string &db_path, const std::string &sql,
           c.i32.push_back(it->second);
           break;
         }
-        case 'u': {
+        case 'u':
+        case 'b': {  // same arena scan; 'b' materialises lazily
           if (ty == SQLITE_NULL) {
             c.text.push_back({0, -1});
             break;
@@ -382,6 +383,38 @@ PyObject *materialize(Col &c) {
       return numeric_array(c.f64, NPY_FLOAT64);
     default:
       break;
+  }
+  if (c.spec == 'b') {
+    // Lazy bytes column: (uint8 arena, int64 starts, int32 lens) — zero
+    // per-row Python objects; the Python BytesColumn wrapper decodes
+    // single cells on demand (consumers touch only tiny subsets of these
+    // near-unique columns).  len -1 = NULL.
+    npy_intp n = static_cast<npy_intp>(c.text.size());
+    std::vector<int64_t> starts(c.text.size());
+    std::vector<int32_t> lens(c.text.size());
+    for (size_t i = 0; i < c.text.size(); i++) {
+      starts[i] = static_cast<int64_t>(c.text[i].off);
+      lens[i] = c.text[i].len;
+    }
+    npy_intp asize = static_cast<npy_intp>(c.arena.size());
+    PyObject *arena = PyArray_SimpleNew(1, &asize, NPY_UINT8);
+    if (!arena) return nullptr;
+    memcpy(PyArray_DATA(reinterpret_cast<PyArrayObject *>(arena)),
+           c.arena.data(), c.arena.size());
+    PyObject *st = numeric_array(starts, NPY_INT64);
+    PyObject *ln = numeric_array(lens, NPY_INT32);
+    if (!st || !ln) {
+      Py_DECREF(arena);
+      Py_XDECREF(st);
+      Py_XDECREF(ln);
+      return nullptr;
+    }
+    (void)n;
+    PyObject *triple = PyTuple_Pack(3, arena, st, ln);
+    Py_DECREF(arena);
+    Py_DECREF(st);
+    Py_DECREF(ln);
+    return triple;
   }
   if (c.spec == 'c') {
     // Coded column: (int32 codes, vocab list) — ZERO per-row Python
@@ -469,6 +502,9 @@ PyObject *materialize(Col &c) {
 //      per-row Python objects (codes match pd.factorize's first-appearance
 //      order; -1 = NULL)
 //   u  TEXT -> object array, no interning (high-cardinality, e.g. names)
+//   b  TEXT -> (uint8 arena, int64 starts, int32 lens) — like 'u' but with
+//      NO per-row Python objects; cells decode lazily on the Python side
+//      (len -1 = NULL)
 //   o  object array preserving sqlite's native type (int/float/text/None)
 PyObject *fetch_table(PyObject *, PyObject *args) {
   const char *db_path_c, *sql_c, *spec_c;
@@ -483,7 +519,7 @@ PyObject *fetch_table(PyObject *, PyObject *args) {
   std::vector<Col> cols(spec.size());
   for (size_t i = 0; i < spec.size(); i++) {
     cols[i].spec = spec[i];
-    if (!strchr("ptfscuo", spec[i])) return err("unknown spec char");
+    if (!strchr("ptfscubo", spec[i])) return err("unknown spec char");
   }
 
   // Extract params / keys into pure C++ while still holding the GIL.
