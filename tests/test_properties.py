@@ -143,3 +143,53 @@ def test_windowed_map_preserves_order(items, window):
     with ThreadPoolExecutor(4) as pool:
         got = list(_windowed_map(pool, lambda x: x * 2, items, window))
     assert got == [x * 2 for x in items]
+
+
+# -- lazy/coded column wrappers ----------------------------------------------
+
+_text_cells = st.lists(
+    st.one_of(st.none(),
+              st.text(min_size=0, max_size=24)),
+    min_size=0, max_size=64)
+
+
+@settings(max_examples=60, deadline=None)
+@given(cells=_text_cells, data=st.data())
+def test_bytes_column_roundtrip_and_indexing(cells, data):
+    """BytesColumn.from_objects must be a lossless lazy view: scalar access
+    reproduces every cell (incl None and empty/unicode strings), and
+    slice/fancy indexing commutes with materialisation."""
+    from tse1m_tpu.data.columnar import BytesColumn
+
+    col = BytesColumn.from_objects(cells)
+    assert len(col) == len(cells)
+    for i, v in enumerate(cells):
+        assert col[i] == v
+    if cells:
+        idx = np.asarray(
+            data.draw(st.lists(st.integers(0, len(cells) - 1),
+                               min_size=0, max_size=8)), dtype=np.int64)
+        sub = col[idx]
+        for k, i in enumerate(idx):
+            assert sub[k] == cells[int(i)]
+        np.testing.assert_array_equal(
+            col[1:].materialize(),
+            np.array(cells[1:], dtype=object))
+
+
+@settings(max_examples=60, deadline=None)
+@given(cells=_text_cells)
+def test_coded_column_matches_factorize_semantics(cells):
+    """CodedColumn built the fallback way (factorize) must reproduce every
+    cell through scalar access and materialize(), with NULL as code -1."""
+    from tse1m_tpu.data.columnar import CodedColumn
+
+    ser = pd.Series(cells, dtype=object)
+    codes, uniq = pd.factorize(ser, use_na_sentinel=True)
+    col = CodedColumn(codes, np.asarray(uniq, dtype=object))
+    assert len(col) == len(cells)
+    for i, v in enumerate(cells):
+        assert col[i] == v
+    np.testing.assert_array_equal(col.materialize(),
+                                  np.array(cells, dtype=object))
+    assert ((col.codes == -1) == np.array([c is None for c in cells])).all()

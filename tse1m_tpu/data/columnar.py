@@ -266,14 +266,12 @@ class StudyArrays:
 
         # Table plan: (query, column names, decode spec).  The native
         # decoder's sqlite scan runs with the GIL released (decode.cc
-        # phase 1), but fetching the four tables from a thread pool was
-        # measured NOT to pay: wall time is dominated by the GIL-held
-        # PyUnicode materialisation (phase 2), so the fetches run serially
-        # and the GIL release simply keeps other Python threads live.
-        # fuzz modules/revisions are 'u' (no interning): fuzz rows carry
-        # near-unique revision text, so an intern map would copy ~every
-        # value into its keys for no dedup (covb's repeated group keys are
-        # where 's' pays).
+        # phase 1); fetches run serially — a thread pool was measured NOT
+        # to pay on this host.  Spec choices: near-unique fuzz text (name,
+        # modules, revisions) rides 'b' (lazy bytes arena — zero per-row
+        # Python objects; consumers touch only issue-linked subsets);
+        # low-cardinality text (result, covb's repeated group keys) rides
+        # 'c' (dictionary codes + vocab, also object-free).
         plus1 = str(np.datetime64(cfg.limit_date) + np.timedelta64(1, "D"))
         plan = {
             "fuzz": (queries.all_fuzzing_builds_bulk(projects),
@@ -308,10 +306,12 @@ class StudyArrays:
             """One bulk query -> {col: array} sorted by our project codes.
 
             Spec chars (see native/decode.cc): 'p' project->code, 't'
-            ISO8601 text->int64 ns, 'f' float64, 's' interned text, 'u'
-            text, 'o' as-stored.  The native decoder handles the whole row
-            loop in C++ when available; the pandas fallback below produces
-            byte-identical arrays (asserted by tests/test_native_decode.py).
+            ISO8601 text->int64 ns, 'f' float64, 's' interned text, 'c'
+            dictionary codes+vocab (CodedColumn), 'u' text, 'b' lazy bytes
+            (BytesColumn), 'o' as-stored.  The native decoder handles the
+            whole row loop in C++ when available; the pandas fallback below
+            produces byte-identical arrays/columns (asserted by
+            tests/test_native_decode.py).
             Everything after this is column-wise — no per-row Python at the
             1.19M-build scale.
 

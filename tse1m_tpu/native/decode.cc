@@ -389,7 +389,6 @@ PyObject *materialize(Col &c) {
     // per-row Python objects; the Python BytesColumn wrapper decodes
     // single cells on demand (consumers touch only tiny subsets of these
     // near-unique columns).  len -1 = NULL.
-    npy_intp n = static_cast<npy_intp>(c.text.size());
     std::vector<int64_t> starts(c.text.size());
     std::vector<int32_t> lens(c.text.size());
     for (size_t i = 0; i < c.text.size(); i++) {
@@ -409,7 +408,6 @@ PyObject *materialize(Col &c) {
       Py_XDECREF(ln);
       return nullptr;
     }
-    (void)n;
     PyObject *triple = PyTuple_Pack(3, arena, st, ln);
     Py_DECREF(arena);
     Py_DECREF(st);
