@@ -12,6 +12,7 @@ reference workflow end to end.
 
 from __future__ import annotations
 
+import json
 import os
 import subprocess
 
@@ -58,3 +59,41 @@ def test_single_shim_runs_standalone(env):
         timeout=600)
     assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
     assert "Retained" in proc.stdout  # the reference transcript's phrasing
+
+
+@pytest.mark.slow
+def test_bench_script_emits_driver_artifact_line(env):
+    """The driver records BENCH_r{N}.json from bench.py's single JSON line;
+    a crash here silently costs the round its perf artifact, so CI runs the
+    whole script end-to-end at tiny scale and checks the contract keys."""
+    proc = subprocess.run(
+        ["python3", "bench.py", "--n", "2000", "--iters", "1",
+         "--ari-sample", "500", "--extract-builds", "5000"],
+        cwd="/root/repo", env=env, capture_output=True, text=True,
+        timeout=900)
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    line = proc.stdout.strip().splitlines()[-1]
+    d = json.loads(line)
+    for key in ("metric", "value", "unit", "vs_baseline", "ari_vs_planted",
+                "rq1_end_to_end_s", "rq1_end_to_end_backend",
+                "rq_suite_winner", "link_dispatch_rtt_ms", "transfer_s"):
+        assert key in d, key
+    assert d["unit"] == "s" and d["value"] > 0
+
+
+@pytest.mark.slow
+def test_graft_dryrun_emits_scaling_block(env):
+    """The driver validates multi-chip via dryrun_multichip(n) and records
+    its tail — which must stay a parseable scaling JSON line.  (The module
+    fixture's 8 virtual devices suffice; the [1,2,4] curve depends only on
+    the n=4 argument.)"""
+    proc = subprocess.run(
+        ["python3", "-c",
+         "import __graft_entry__ as g; g.dryrun_multichip(4)"],
+        cwd="/root/repo", env=env, capture_output=True, text=True,
+        timeout=900)
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    last = proc.stdout.strip().splitlines()[-1]
+    d = json.loads(last)
+    assert d["scaling"]["mode"] == "weak"
+    assert [c["devices"] for c in d["scaling"]["curve"]] == [1, 2, 4]
