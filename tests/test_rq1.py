@@ -89,7 +89,7 @@ def test_oracle_reference_semantics(arrays, limit_ns, study_db):
         assert got_detected[k] == len(detected.get(k, set())), f"iteration {k}"
 
 
-@pytest.mark.parametrize("backend", ["pandas", "jax_tpu"])
+@pytest.mark.parametrize("backend", ["pandas", "jax_tpu", "auto"])
 def test_run_rq1_end_to_end(backend, study_db, tmp_path):
     cfg = Config(engine="sqlite", sqlite_path=study_db.config.sqlite_path,
                  limit_date=LIMIT, backend=backend,
@@ -165,11 +165,13 @@ def test_backend_parity_subsecond_ordering():
 
 def test_run_rq1_backends_identical_artifacts(study_db, tmp_path):
     outs = {}
-    for backend in ("pandas", "jax_tpu"):
+    for backend in ("pandas", "jax_tpu", "auto"):
         cfg = Config(engine="sqlite", sqlite_path=study_db.config.sqlite_path,
                      limit_date=LIMIT, backend=backend,
                      result_dir=str(tmp_path / ("r_" + backend)))
         cfg.min_projects_per_iteration = 2
         outs[backend] = run_rq1(cfg, db=study_db)["stats_csv"]
-    with open(outs["pandas"]) as a, open(outs["jax_tpu"]) as b:
-        assert a.read() == b.read()
+    from pathlib import Path
+
+    contents = {k: Path(v).read_text() for k, v in outs.items()}
+    assert contents["pandas"] == contents["jax_tpu"] == contents["auto"]

@@ -124,7 +124,7 @@ def test_floor_day_ns():
     assert floor_day_ns(np.array([t]))[0] == d
 
 
-@pytest.mark.parametrize("backend", ["pandas", "jax_tpu"])
+@pytest.mark.parametrize("backend", ["pandas", "jax_tpu", "auto"])
 def test_run_rq2_end_to_end(backend, study_db, tmp_path):
     cfg = Config(engine="sqlite", sqlite_path=study_db.config.sqlite_path,
                  limit_date=LIMIT, backend=backend,
@@ -147,10 +147,12 @@ def test_run_rq2_end_to_end(backend, study_db, tmp_path):
 
 def test_rq2_artifacts_identical_across_backends(study_db, tmp_path):
     paths = {}
-    for backend in ("pandas", "jax_tpu"):
+    for backend in ("pandas", "jax_tpu", "auto"):
         cfg = Config(engine="sqlite", sqlite_path=study_db.config.sqlite_path,
                      limit_date=LIMIT, backend=backend,
                      result_dir=str(tmp_path / ("r_" + backend)))
         paths[backend] = run_rq2_changepoints(cfg, db=study_db)["merged_csv"]
-    with open(paths["pandas"]) as a, open(paths["jax_tpu"]) as b:
-        assert a.read() == b.read()
+    from pathlib import Path
+
+    contents = {k: Path(v).read_text() for k, v in paths.items()}
+    assert contents["pandas"] == contents["jax_tpu"] == contents["auto"]

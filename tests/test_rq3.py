@@ -125,7 +125,7 @@ def test_summary_statistics():
     assert s["median"] == 0.5
 
 
-@pytest.mark.parametrize("backend", ["pandas", "jax_tpu"])
+@pytest.mark.parametrize("backend", ["pandas", "jax_tpu", "auto"])
 def test_run_rq3_end_to_end(study_db, tmp_path, backend):
     cfg = Config(engine="sqlite", sqlite_path=study_db.config.sqlite_path,
                  backend=backend, result_dir=str(tmp_path), limit_date=LIMIT)

@@ -152,7 +152,7 @@ def test_g4_prepost_oracle(arrays, limit_ns, groups, study_db):
             assert row[j] == expect, (name, s)
 
 
-@pytest.mark.parametrize("backend", ["pandas", "jax_tpu"])
+@pytest.mark.parametrize("backend", ["pandas", "jax_tpu", "auto"])
 def test_run_rq4a_end_to_end(study_db, tmp_path, corpus_csv, backend):
     cfg = Config(engine="sqlite", sqlite_path=study_db.config.sqlite_path,
                  backend=backend, result_dir=str(tmp_path), limit_date=LIMIT,

@@ -147,7 +147,7 @@ def test_initial_coverage_stats_empty():
     assert out == {"n_g2": 0, "n_g1": 2}
 
 
-@pytest.mark.parametrize("backend", ["pandas", "jax_tpu"])
+@pytest.mark.parametrize("backend", ["pandas", "jax_tpu", "auto"])
 def test_run_rq4b_end_to_end(study_db, tmp_path, corpus_csv, backend):
     cfg = Config(engine="sqlite", sqlite_path=study_db.config.sqlite_path,
                  backend=backend, result_dir=str(tmp_path / backend),
