@@ -304,6 +304,11 @@ def main() -> int:
         labels, runs = timed(params)
 
     wall = statistics.median(runs)
+    # Snapshot now: the ARI subsample below runs cluster_sessions again and
+    # would overwrite the timed runs' encoding stats.
+    from tse1m_tpu.cluster.pipeline import last_run_info
+
+    cluster_info = dict(last_run_info)
 
     def compute_only() -> float:
         """Device-compute wall with items already resident on device —
@@ -341,28 +346,57 @@ def main() -> int:
         compute_s = None
 
     def transfer_probe() -> dict:
-        """Measured H2D wall for the exact packed payload the cluster
-        pipeline ships (its own pack decision + host 24-bit pack), median
-        of 3 — `value` minus this minus `compute_only_s` is dispatch/pack
+        """Measured H2D wall for the exact payload the cluster pipeline
+        ships — the pipeline's OWN encoding decision (base-delta lanes
+        when `cluster/encode.py` engages, else 24-bit pack), median of 3 —
+        `value` minus this minus `compute_only_s` is dispatch/encode
         overhead, so the link bound is measured rather than inferred from
         subtraction."""
-        from tse1m_tpu.cluster.pipeline import _pack24_host, should_pack24
+        import jax.numpy as jnp
 
-        pack = should_pack24(items)
-        payload = _pack24_host(items) if pack else items
+        from tse1m_tpu.cluster import pipeline as pl
+
+        enc = pl._maybe_encode(items, params)
+        pack = pl.should_pack24(items)
+        if enc is None:
+            payloads = [pl._pack24_host(items) if pack else items]
+            kind = "pack24" if pack else "raw"
+        else:
+            payloads = [
+                pl._pack24_host(enc.full_rows) if pack else enc.full_rows,
+                enc.rep_in_full, enc.counts, enc.pos_flat,
+                pl._pack24_host(enc.val_flat) if pack else enc.val_flat,
+                enc.mask_bits,
+            ]
+            kind = "delta"
+        # An all-exact-duplicate workload has zero diffs: empty lanes can't
+        # be indexed by the sync op and ship nothing anyway.
+        payloads = [p for p in payloads if p.size]
+        nbytes = sum(p.nbytes for p in payloads)
+
+        @jax.jit
+        def _touch(*xs):
+            # One 4-byte completion sync covering every lane (a per-array
+            # int() would pay the ~0.11 s tunnel RTT once per lane).
+            return sum(x.ravel()[0].astype(jnp.uint32) for x in xs)
+
         samples = []
         for _ in range(3):
-            samples.append(_timed_h2d(payload, reps=1)[0])
+            t0 = time.perf_counter()
+            ds = [jax.device_put(p) for p in payloads]
+            int(_touch(*ds))
+            samples.append(time.perf_counter() - t0)
         med = statistics.median(samples)
         return {
-            "transfer_mb": round(payload.nbytes / 2**20, 1),
+            "transfer_mb": round(nbytes / 2**20, 1),
             "transfer_s": round(med, 4),
             # The tunnel varies ~2x minute-to-minute; the per-rep list
             # (and best) keep one slow window from reading as the bound.
             "transfer_runs_s": [round(s, 4) for s in samples],
             "transfer_best_s": round(min(samples), 4),
-            "transfer_MBps": round(payload.nbytes / med / 1e6, 1),
+            "transfer_MBps": round(nbytes / med / 1e6, 1),
             "transfer_packed24": pack,
+            "transfer_encoding": kind,
         }
 
     try:
@@ -405,6 +439,9 @@ def main() -> int:
     }
     if ari_host is not None:
         result["ari_vs_host_sample"] = ari_host
+    # Encoding stats of the last timed run (cluster/encode.py): lane split,
+    # wire bytes, host encode seconds.
+    result.update({f"cluster_{k}": v for k, v in cluster_info.items()})
     result.update(transfer_stats)
     try:
         result.update(bench_link())

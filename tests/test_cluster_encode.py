@@ -1,0 +1,259 @@
+"""Base-delta H2D encoding (cluster/encode.py + the pipeline's encoded
+path) — the round-5 attack on the north star's dominant cost, the ~25 MB/s
+tunneled H2D link (BENCH_r04: 7.2 s of a 9.5 s wall moving 183 MB).
+
+Contracts under test:
+- encode/decode round-trips bit-exactly (numpy AND native encoders);
+- grouping is only a heuristic: rep_of has no chains, every encoded pair
+  verified within max_diffs;
+- the encoded pipeline's labels are bit-identical to the unencoded
+  pipeline's (hub election by original index — lsh.bucket_representatives);
+- the auto policy only engages when worthwhile;
+- the checkpoint/resume path survives a kill with encoding on.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import tse1m_tpu.cluster.pipeline as pipeline_mod
+from tse1m_tpu.cluster import (ClusterParams, cluster_sessions,
+                               cluster_sessions_resumable)
+from tse1m_tpu.cluster.checkpoint import ClusterCheckpoint
+from tse1m_tpu.cluster.encode import (DeltaEncoding, _group_rows, decode_host,
+                                      encode_delta)
+from tse1m_tpu.data.synth import synth_session_sets
+
+N = 4096
+
+
+@pytest.fixture(scope="module")
+def dup_items():
+    # dup_fraction 0.6 / mean cluster 8 — the planted near-duplicate shape
+    # the encoder exists for.
+    return synth_session_sets(N, set_size=16, seed=21)[0]
+
+
+def _encoders():
+    yield "numpy", False
+    from tse1m_tpu.native import group_delta_native
+
+    if group_delta_native(np.zeros((2, 4), np.uint32), 4, 1) is not None:
+        yield "native", True
+
+
+@pytest.mark.parametrize("name,use_native", list(_encoders()))
+def test_roundtrip_bit_exact(dup_items, name, use_native):
+    enc = encode_delta(dup_items, use_native=use_native)
+    assert enc is not None and enc.n_delta > 0
+    np.testing.assert_array_equal(decode_host(enc), dup_items)
+    # the encoding actually compresses this workload
+    assert enc.wire_bytes(True) < dup_items.shape[0] * dup_items.shape[1] * 3
+
+
+@pytest.mark.parametrize("name,use_native", list(_encoders()))
+def test_group_invariants(dup_items, name, use_native):
+    if use_native:
+        from tse1m_tpu.native import group_delta_native
+
+        rep_of = np.asarray(group_delta_native(dup_items, 16, 3))
+    else:
+        rep_of = _group_rows(dup_items, 16, 3)
+    d = rep_of >= 0
+    assert d.any()
+    # no chains: a base row is never itself a delta row
+    assert np.all(rep_of[rep_of[d]] == -1)
+    # every encoded pair verified within the cap
+    nd = (dup_items[d] != dup_items[rep_of[d]]).sum(axis=1)
+    assert nd.max() <= 16
+
+
+def test_roundtrip_with_wide_values():
+    """Values above 2^24 (no 24-bit pack) still round-trip."""
+    rng = np.random.default_rng(5)
+    base = rng.integers(0, 1 << 31, size=(64, 8), dtype=np.uint32)
+    items = np.repeat(base, 4, axis=0)
+    mut = rng.random(items.shape) < 0.1
+    items[mut] = rng.integers(0, 1 << 31, size=int(mut.sum()), dtype=np.uint32)
+    enc = encode_delta(items, use_native=False)
+    assert enc is not None
+    np.testing.assert_array_equal(decode_host(enc), items)
+
+
+def test_no_duplicates_returns_none():
+    rng = np.random.default_rng(6)
+    items = rng.integers(0, 1 << 24, size=(512, 16), dtype=np.uint32)
+    # distinct random rows: nothing to attach (verification rejects any
+    # chance key collision), so the encoder declines
+    assert encode_delta(items, min_delta_fraction=0.05) is None
+
+
+def test_encoded_labels_bit_identical(dup_items):
+    base = ClusterParams(use_pallas="interpret", block_n=128, h2d_chunks=4,
+                         encoding="pack24")
+    enc = ClusterParams(use_pallas="interpret", block_n=128, h2d_chunks=4,
+                        encoding="delta")
+    np.testing.assert_array_equal(cluster_sessions(dup_items, enc),
+                                  cluster_sessions(dup_items, base))
+    assert pipeline_mod.last_run_info["encoding"] == "pack24"
+
+
+def test_encoded_labels_bit_identical_raw_values():
+    """Same parity when values exceed the 24-bit pack limit."""
+    rng = np.random.default_rng(9)
+    base_rows = rng.integers(0, 1 << 30, size=(128, 16), dtype=np.uint32)
+    items = np.repeat(base_rows, 6, axis=0)
+    mut = rng.random(items.shape) < 0.08
+    items[mut] = rng.integers(0, 1 << 30, size=int(mut.sum()),
+                              dtype=np.uint32)
+    perm = rng.permutation(items.shape[0])
+    items = items[perm]
+    prm = dict(use_pallas="never", h2d_chunks=2)
+    np.testing.assert_array_equal(
+        cluster_sessions(items, ClusterParams(encoding="delta", **prm)),
+        cluster_sessions(items, ClusterParams(encoding="pack24", **prm)))
+
+
+def test_auto_policy_skips_small_inputs(dup_items):
+    cluster_sessions(dup_items[:512],
+                     ClusterParams(use_pallas="never", encoding="auto"))
+    assert pipeline_mod.last_run_info["encoding"] == "pack24"
+
+
+def test_auto_policy_engages_on_large_compressible(dup_items, monkeypatch):
+    monkeypatch.setattr(pipeline_mod, "_AUTO_MIN_BYTES", 1024)
+    cluster_sessions(dup_items,
+                     ClusterParams(use_pallas="never", encoding="auto"))
+    info = pipeline_mod.last_run_info
+    assert info["encoding"] == "delta"
+    assert info["n_full"] + info["n_delta"] == N
+    assert info["wire_mb"] <= N * 16 * 3 / 2**20
+
+
+def test_resumable_encoded_matches_plain(dup_items, tmp_path):
+    prm = ClusterParams(use_pallas="never", h2d_chunks=4, encoding="delta")
+    want = cluster_sessions(dup_items, prm)
+    got = cluster_sessions_resumable(dup_items, prm,
+                                     checkpoint_dir=str(tmp_path / "ck"))
+    np.testing.assert_array_equal(got, want)
+    assert not list((tmp_path / "ck").glob("shard_*.npz"))
+
+
+def test_resumable_encoded_kill_and_resume(dup_items, tmp_path, monkeypatch):
+    d = str(tmp_path / "ck")
+    prm = ClusterParams(use_pallas="never", h2d_chunks=4, encoding="delta")
+    want = cluster_sessions(dup_items, prm)
+
+    class Boom(RuntimeError):
+        pass
+
+    saved = []
+    real_save = ClusterCheckpoint.save_chunk
+
+    def dying_save(self, index, sig, keys):
+        real_save(self, index, sig, keys)
+        saved.append(index)
+        if len(saved) == 2:
+            raise Boom()
+
+    monkeypatch.setattr(ClusterCheckpoint, "save_chunk", dying_save)
+    with pytest.raises(Boom):
+        cluster_sessions_resumable(dup_items, prm, checkpoint_dir=d)
+    monkeypatch.setattr(ClusterCheckpoint, "save_chunk", real_save)
+    got = cluster_sessions_resumable(dup_items, prm, checkpoint_dir=d)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_resumable_refuses_different_lane_split(dup_items, tmp_path,
+                                                monkeypatch):
+    """A resume whose encoder drew different lanes must refuse, not mix
+    shards (the native/numpy encoders may legitimately group differently)."""
+    d = str(tmp_path / "ck")
+    prm = ClusterParams(use_pallas="never", h2d_chunks=4, encoding="delta")
+
+    class Boom(RuntimeError):
+        pass
+
+    saved = []
+    real_save = ClusterCheckpoint.save_chunk
+
+    def dying_save(self, index, sig, keys):
+        real_save(self, index, sig, keys)
+        saved.append(index)
+        raise Boom()
+
+    monkeypatch.setattr(ClusterCheckpoint, "save_chunk", dying_save)
+    with pytest.raises(Boom):
+        cluster_sessions_resumable(dup_items, prm, checkpoint_dir=d)
+    monkeypatch.setattr(ClusterCheckpoint, "save_chunk", real_save)
+
+    real_encode = pipeline_mod.encode_delta
+
+    def other_lanes(items, **kw):
+        enc = real_encode(items, **kw)
+        # drop one delta row back into the full lane -> different split
+        keep = np.ones(enc.n_delta, bool)
+        keep[0] = False
+        return _drop_delta_row(items, enc, keep)
+
+    monkeypatch.setattr(pipeline_mod, "encode_delta", other_lanes)
+    with pytest.raises(ValueError, match="different"):
+        cluster_sessions_resumable(dup_items, prm, checkpoint_dir=d)
+
+
+def test_resumable_refuses_encoding_mode_change(dup_items, tmp_path,
+                                                monkeypatch):
+    """A delta-encoded checkpoint resumed with encoding off holds
+    full-lane shards that would be misread as item-chunk shards — the
+    manifest's symmetric meta comparison must refuse."""
+    d = str(tmp_path / "ck")
+    prm = ClusterParams(use_pallas="never", h2d_chunks=4, encoding="delta")
+
+    class Boom(RuntimeError):
+        pass
+
+    real_save = ClusterCheckpoint.save_chunk
+
+    def dying_save(self, index, sig, keys):
+        real_save(self, index, sig, keys)
+        raise Boom()
+
+    monkeypatch.setattr(ClusterCheckpoint, "save_chunk", dying_save)
+    with pytest.raises(Boom):
+        cluster_sessions_resumable(dup_items, prm, checkpoint_dir=d)
+    monkeypatch.setattr(ClusterCheckpoint, "save_chunk", real_save)
+    plain = ClusterParams(use_pallas="never", h2d_chunks=4,
+                          encoding="pack24")
+    with pytest.raises(ValueError, match="different"):
+        cluster_sessions_resumable(dup_items, plain, checkpoint_dir=d)
+
+
+def test_unknown_encoding_rejected(dup_items):
+    with pytest.raises(ValueError, match="unknown encoding"):
+        cluster_sessions(dup_items[:64],
+                         ClusterParams(use_pallas="never", encoding="raw"))
+
+
+def _drop_delta_row(items: np.ndarray, enc: DeltaEncoding,
+                    keep: np.ndarray) -> DeltaEncoding:
+    """Rebuild an encoding with a subset of its delta rows (test helper)."""
+    is_delta = np.unpackbits(enc.mask_bits, bitorder="little")[:enc.n]
+    delta_idx = np.flatnonzero(is_delta)
+    new_mask = np.zeros(enc.n, bool)
+    new_mask[delta_idx[keep]] = True
+    full_rank = np.cumsum(~new_mask) - 1
+    rows = np.repeat(np.arange(enc.n_delta), enc.counts)
+    keep_flat = keep[rows]
+    # original index of each kept delta row's base
+    full_idx_old = np.flatnonzero(~is_delta)
+    rep_orig = full_idx_old[enc.rep_in_full]
+    return DeltaEncoding(
+        n=enc.n, set_size=enc.set_size,
+        mask_bits=np.packbits(new_mask, bitorder="little"),
+        full_rows=np.ascontiguousarray(items[~new_mask]),
+        rep_in_full=full_rank[rep_orig[keep]].astype(np.int32),
+        counts=enc.counts[keep],
+        pos_flat=enc.pos_flat[keep_flat],
+        val_flat=enc.val_flat[keep_flat],
+    )

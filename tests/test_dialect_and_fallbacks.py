@@ -63,11 +63,15 @@ def test_native_loader_degrades_without_compiler(monkeypatch, tmp_path):
     fallback), never an exception at import or call time."""
     from tse1m_tpu import native
 
-    monkeypatch.setattr(native, "_SO", str(tmp_path / "never_built.so"))
     monkeypatch.setattr(native, "_module", None)
     monkeypatch.setattr(native, "_tried", False)
-    monkeypatch.setattr(native, "_compile", lambda: False)
+    monkeypatch.setattr(native, "_build_and_load", lambda *a, **k: None)
     assert native.fetch_table("/nope.sqlite", "SELECT 1", (), "o", []) is None
+    # ...and the delta-grouping extension degrades the same way
+    monkeypatch.setattr(native, "_enc_module", None)
+    monkeypatch.setattr(native, "_enc_tried", False)
+    assert native.group_delta_native(
+        np.zeros((2, 2), np.uint32), 4, 1) is None
 
 
 def test_columnar_works_end_to_end_without_native(study_db, study_cfg,

@@ -53,7 +53,8 @@ class ClusterCheckpoint:
     """
 
     def __init__(self, directory: str, items: np.ndarray, params,
-                 step: int) -> None:
+                 step: int, extra: dict | None = None,
+                 n_chunks: int | None = None) -> None:
         self.directory = directory
         os.makedirs(directory, exist_ok=True)
         self.meta = {
@@ -64,11 +65,21 @@ class ClusterCheckpoint:
             "n_bands": params.n_bands,
             "seed": params.seed,
             "step": int(step),
+            # Shape-affecting facts beyond (items, params) — e.g. the delta
+            # encoder's lane split, which decides what each chunk contains.
+            **(extra or {}),
         }
+        if n_chunks is not None:
+            self.meta["n_chunks"] = int(n_chunks)
         self._manifest_path = os.path.join(directory, _MANIFEST)
         prior = self._load_manifest()
         if prior is not None:
-            if {k: prior[k] for k in self.meta} != self.meta:
+            # Symmetric comparison: a prior manifest carrying keys this run
+            # doesn't (e.g. a delta-encoded run resumed without encoding)
+            # means the shards hold different rows — refuse, don't load.
+            prior_meta = {k: v for k, v in prior.items()
+                          if k != "chunks_done"}
+            if prior_meta != self.meta:
                 raise ValueError(
                     f"checkpoint at {directory} belongs to a different "
                     "run (items or params changed); use a fresh directory "
@@ -82,6 +93,8 @@ class ClusterCheckpoint:
 
     @property
     def n_chunks(self) -> int:
+        if "n_chunks" in self.meta:
+            return self.meta["n_chunks"]
         return -(-self.meta["n"] // self.meta["step"])
 
     def _load_manifest(self) -> dict | None:

@@ -18,14 +18,26 @@ import jax
 import jax.numpy as jnp
 
 
-def bucket_representatives(keys: jax.Array) -> jax.Array:
+def bucket_representatives(keys: jax.Array, orig: jax.Array | None = None,
+                           lane_of: jax.Array | None = None) -> jax.Array:
     """[N, B] band keys -> [N, B] reps: min item index sharing the key.
 
     Per band: argsort the keys, mark run boundaries, segment-min the item
     indices within runs, scatter back.  Items in singleton buckets get
     themselves as rep (self-edges are dropped by the verifier's caller).
+
+    ``orig``/``lane_of`` (both [N] int32, inverse permutations) make the
+    election permutation-independent when rows arrive in an encoder's lane
+    order (pipeline._cluster_encoded): the bucket hub is the member with
+    the minimum ORIGINAL index (``orig``: row order -> original index),
+    mapped back into row order via ``lane_of``.  Without them the row
+    order is the original order and the two maps are identity.  This is
+    what makes the delta-encoded path's labels bit-identical to the
+    unencoded path's — buckets are order-invariant sets, so electing by
+    original index yields the same hub, hence the same verified edges.
     """
     n, n_bands = keys.shape
+    vals = jnp.arange(n, dtype=jnp.int32) if orig is None else orig
 
     def one_band(k):
         order = jnp.argsort(k)  # [N]
@@ -33,9 +45,10 @@ def bucket_representatives(keys: jax.Array) -> jax.Array:
         new_run = jnp.concatenate(
             [jnp.ones((1,), jnp.bool_), ks[1:] != ks[:-1]])
         seg = jnp.cumsum(new_run.astype(jnp.int32)) - 1  # [N] run ids
-        run_min = jax.ops.segment_min(order.astype(jnp.int32), seg,
-                                      num_segments=n)
-        rep_sorted = run_min[seg]
+        run_min = jax.ops.segment_min(vals[order], seg, num_segments=n)
+        rep_sorted = run_min[seg]  # min original index in my bucket
+        if lane_of is not None:
+            rep_sorted = lane_of[rep_sorted]
         return jnp.zeros((n,), jnp.int32).at[order].set(rep_sorted)
 
     return jax.vmap(one_band, in_axes=1, out_axes=1)(keys.astype(jnp.uint32))

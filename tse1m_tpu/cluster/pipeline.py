@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .encode import (_AUTO_MIN_BYTES, _AUTO_MIN_DELTA_FRACTION, encode_delta)
 from .lsh import bucket_representatives, estimated_jaccard, propagate_labels
 from .minhash import band_keys, make_hash_params, minhash_signatures
 from .minhash_pallas import minhash_and_keys
@@ -42,6 +43,18 @@ class ClusterParams:
     # i+1 streams over the (slow, remote-PJRT) link while MinHash runs on
     # chunk i.  0 = auto (chunk when items exceed _CHUNK_BYTES), 1 = off.
     h2d_chunks: int = 0
+    # H2D payload encoding (cluster/encode.py): 'auto' base-delta-encodes
+    # large inputs when enough rows are near-duplicates (the measured win:
+    # 183 -> ~104 MB on the 1M north star); 'delta' forces it; 'pack24'
+    # keeps the plain packed lane.  Labels are bit-identical either way
+    # (hub election is by original index — lsh.bucket_representatives).
+    encoding: str = "auto"
+
+
+# Observability surface for bench.py: stats of the last single-host
+# cluster_sessions call (encoding chosen, lane sizes, wire bytes, host
+# encode seconds).  A plain dict, overwritten per call — not an API.
+last_run_info: dict = {}
 
 
 def _cluster_from_sig(sig, keys, threshold: float, n_iters: int):
@@ -74,6 +87,112 @@ def _cluster_sharded(items_d, a, b, sharding, n_bands: int, threshold: float,
     return _cluster_from_sig(sig, keys, threshold, n_iters)
 
 
+@jax.jit
+def _decode_delta_packed(full_d, rep_d, counts_d, pos_d, val3_d):
+    """Delta lane -> [D, S] uint32 rows, on device.
+
+    Gather each delta row's base from the decoded full lane, then scatter
+    its (position, value) diffs.  Flat diff stream is CSR-style: per-row
+    counts cumsum to offsets; each flat slot finds its row by searchsorted.
+    """
+    vals = _unpack24(val3_d)
+    offsets = jnp.cumsum(counts_d.astype(jnp.int32))
+    t = jnp.arange(pos_d.shape[0], dtype=jnp.int32)
+    row = jnp.searchsorted(offsets, t, side="right").astype(jnp.int32)
+    base = full_d[rep_d]
+    return base.at[row, pos_d.astype(jnp.int32)].set(vals, mode="drop")
+
+
+@jax.jit
+def _decode_delta_raw(full_d, rep_d, counts_d, pos_d, val_d):
+    offsets = jnp.cumsum(counts_d.astype(jnp.int32))
+    t = jnp.arange(pos_d.shape[0], dtype=jnp.int32)
+    row = jnp.searchsorted(offsets, t, side="right").astype(jnp.int32)
+    base = full_d[rep_d]
+    return base.at[row, pos_d.astype(jnp.int32)].set(val_d, mode="drop")
+
+
+@partial(jax.jit, static_argnames=("n", "threshold", "n_iters"))
+def _cluster_encoded_labels(sig, keys, mask_bytes, n: int, threshold: float,
+                            n_iters: int):
+    """Cluster rows that sit in lane order and return labels in ORIGINAL
+    order, equal elementwise to the unencoded path's.
+
+    ``mask_bytes`` is the encoder's 1-bit-per-row membership mask
+    (little-endian); cumsums of it reconstruct both permutations, so the
+    wire cost of reordering is n/8 bytes instead of 4n.  Hub election by
+    original index (see bucket_representatives) keeps the verified edge
+    set — and therefore the components and the min-original-index labels —
+    identical to a run without the encoder.
+    """
+    bits = ((mask_bytes[:, None] >> jnp.arange(8, dtype=jnp.uint8)[None, :])
+            & 1).reshape(-1)[:n].astype(jnp.int32)  # 1 = delta lane
+    n_full_dyn = n - jnp.sum(bits)
+    dr = jnp.cumsum(bits) - bits          # exclusive cumsum: delta rank
+    fr = jnp.cumsum(1 - bits) - (1 - bits)
+    lane_of = jnp.where(bits == 1, n_full_dyn + dr, fr).astype(jnp.int32)
+    orig_of = jnp.zeros(n, jnp.int32).at[lane_of].set(
+        jnp.arange(n, dtype=jnp.int32))
+    reps = bucket_representatives(keys, orig=orig_of, lane_of=lane_of)
+    est = estimated_jaccard(sig, reps)
+    self_idx = jnp.arange(n, dtype=jnp.int32)[:, None]
+    valid = (est >= threshold) & (reps != self_idx)
+    lab = propagate_labels(reps, valid, n_iters=n_iters)  # lane-space ids
+    cmin = jnp.full(n, n, jnp.int32).at[lab].min(orig_of)
+    return cmin[lab][lane_of]
+
+
+def _maybe_encode(items: np.ndarray, params: ClusterParams):
+    """Apply the ClusterParams.encoding policy; None = ship plain lanes."""
+    if params.encoding not in ("auto", "delta", "pack24"):
+        raise ValueError(f"unknown encoding {params.encoding!r}; "
+                         "expected auto | delta | pack24")
+    if params.encoding == "pack24":
+        return None
+    if params.encoding == "auto" and items.nbytes < _AUTO_MIN_BYTES:
+        return None
+    frac = _AUTO_MIN_DELTA_FRACTION if params.encoding == "auto" else 0.0
+    return encode_delta(items, min_delta_fraction=frac)
+
+
+def _cluster_encoded(items: np.ndarray, enc, a, b, params: ClusterParams,
+                     pack: bool) -> np.ndarray:
+    """Single-host encoded path: stream the full lane chunked (retaining
+    the decoded device rows), decode the delta lane against it, MinHash
+    both, cluster with original-order labels.
+
+    ``pack`` is the caller's should_pack24 decision over BOTH lanes: delta
+    values can exceed 2^24 even when every full-lane row packs, and the
+    wire format uses one width.
+    """
+    n = items.shape[0]
+    kw = dict(use_pallas=params.use_pallas, block_n=params.block_n)
+    full = enc.full_rows
+    step, _ = _stream_plan(full, params)
+    chunks_d, parts = [], []
+    for i in range(0, full.shape[0], step):
+        cd = _put_chunk(full[i:i + step], pack)
+        chunks_d.append(cd)
+        parts.append(minhash_and_keys(cd, a, b, params.n_bands, **kw))
+    full_d = chunks_d[0] if len(chunks_d) == 1 else jnp.concatenate(chunks_d)
+    rep_d = jax.device_put(enc.rep_in_full)
+    counts_d = jax.device_put(enc.counts)
+    pos_d = jax.device_put(enc.pos_flat)
+    if pack:
+        delta_items = _decode_delta_packed(
+            full_d, rep_d, counts_d, pos_d,
+            jax.device_put(_pack24_host(enc.val_flat)))
+    else:
+        delta_items = _decode_delta_raw(full_d, rep_d, counts_d, pos_d,
+                                        jax.device_put(enc.val_flat))
+    dsig, dkeys = minhash_and_keys(delta_items, a, b, params.n_bands, **kw)
+    sig = jnp.concatenate([p[0] for p in parts] + [dsig])
+    keys = jnp.concatenate([p[1] for p in parts] + [dkeys])
+    labels = _cluster_encoded_labels(sig, keys, jax.device_put(enc.mask_bits),
+                                     n, params.threshold, params.n_iters)
+    return np.asarray(labels)
+
+
 def cluster_sessions(items, params: ClusterParams | None = None,
                      mesh: jax.sharding.Mesh | None = None,
                      axis: str = "data") -> np.ndarray:
@@ -88,6 +207,11 @@ def cluster_sessions(items, params: ClusterParams | None = None,
     a, b = jnp.asarray(a), jnp.asarray(b)
 
     if mesh is not None:
+        # The base-delta wire encoding is a single-host H2D optimisation;
+        # mesh feeding ships raw shards (multi-host rows never transit one
+        # host's link), so params.encoding does not apply here.
+        last_run_info.clear()
+        last_run_info.update(encoding="mesh-raw")
         from ..parallel.mesh import pad_to_devices
 
         sharding = jax.sharding.NamedSharding(
@@ -121,6 +245,23 @@ def cluster_sessions(items, params: ClusterParams | None = None,
                 multihost_utils.process_allgather(labels, tiled=True))[:n]
         return np.asarray(labels)[:n]
     items = np.ascontiguousarray(items, dtype=np.uint32)
+
+    import time as _time
+
+    t0 = _time.perf_counter()
+    enc = _maybe_encode(items, params)
+    pack = should_pack24(items)  # once: a full O(N*S) max scan
+    last_run_info.clear()
+    if enc is not None:
+        last_run_info.update(
+            encoding="delta", encode_s=round(_time.perf_counter() - t0, 4),
+            n_full=enc.n_full, n_delta=enc.n_delta,
+            wire_mb=round(enc.wire_bytes(pack) / 2**20, 1))
+        return _cluster_encoded(items, enc, a, b, params, pack)
+    last_run_info.update(
+        encoding="pack24" if pack else "raw",
+        wire_mb=round(items.shape[0] * items.shape[1]
+                      * (3 if pack else 4) / 2**20, 1))
 
     if params.use_pallas != "never":
         sig, keys = _minhash_streamed(items, a, b, params)
@@ -221,25 +362,93 @@ def cluster_sessions_resumable(items, params: ClusterParams | None = None,
         return np.empty(0, np.int32)
     a, b = make_hash_params(params.n_hashes, params.seed)
     a, b = jnp.asarray(a), jnp.asarray(b)
-    step, pack = _stream_plan(items, params)  # same chunks as streamed path
-    ckpt = ClusterCheckpoint(checkpoint_dir, items, params, step)
     kw = dict(use_pallas=params.use_pallas, block_n=params.block_n)
+    enc = _maybe_encode(items, params)
 
+    if enc is None:
+        step, pack = _stream_plan(items, params)  # same chunks as streamed
+        ckpt = ClusterCheckpoint(checkpoint_dir, items, params, step)
+        parts = []
+        for idx, i in enumerate(range(0, n, step)):
+            if ckpt.chunk_done(idx):
+                sig_h, keys_h = ckpt.load_chunk(idx)
+                parts.append((jax.device_put(sig_h), jax.device_put(keys_h)))
+                continue
+            sig, keys = minhash_and_keys(_put_chunk(items[i:i + step], pack),
+                                         a, b, params.n_bands, **kw)
+            # D2H for durability: the persisted shard IS the resume state.
+            ckpt.save_chunk(idx, np.asarray(sig), np.asarray(keys))
+            parts.append((sig, keys))
+        sig = jnp.concatenate([p[0] for p in parts])
+        keys = jnp.concatenate([p[1] for p in parts])
+        labels = np.asarray(_cluster_from_sig_jit(sig, keys, params.threshold,
+                                                  params.n_iters))
+        if cleanup:
+            ckpt.cleanup()
+        return labels
+
+    # Encoded layout: one shard per full-lane chunk + one delta-lane shard.
+    # The lane split is part of the manifest (it decides what each shard
+    # holds); a resume whose encoder drew different lanes — e.g. the native
+    # grouping pass available on one machine but not the other — refuses
+    # instead of concatenating mismatched shards.
+    import hashlib
+
+    full = enc.full_rows
+    step, _ = _stream_plan(full, params)
+    pack = should_pack24(items)  # one width for both lanes
+    n_full_chunks = max(1, -(-full.shape[0] // step))
+    lane_fp = hashlib.blake2b(
+        enc.mask_bits.tobytes() + enc.counts.tobytes(),
+        digest_size=16).hexdigest()
+    ckpt = ClusterCheckpoint(checkpoint_dir, items, params, step,
+                             extra={"encoding": "delta",
+                                    "lane_fingerprint": lane_fp},
+                             n_chunks=n_full_chunks + 1)
     parts = []
-    for idx, i in enumerate(range(0, n, step)):
+    chunks_d: list = [None] * n_full_chunks
+    for idx, i in enumerate(range(0, full.shape[0], step)):
         if ckpt.chunk_done(idx):
             sig_h, keys_h = ckpt.load_chunk(idx)
             parts.append((jax.device_put(sig_h), jax.device_put(keys_h)))
             continue
-        sig, keys = minhash_and_keys(_put_chunk(items[i:i + step], pack),
-                                     a, b, params.n_bands, **kw)
-        # D2H for durability: the persisted shard IS the resume state.
+        cd = _put_chunk(full[i:i + step], pack)
+        chunks_d[idx] = cd
+        sig, keys = minhash_and_keys(cd, a, b, params.n_bands, **kw)
         ckpt.save_chunk(idx, np.asarray(sig), np.asarray(keys))
         parts.append((sig, keys))
-    sig = jnp.concatenate([p[0] for p in parts])
-    keys = jnp.concatenate([p[1] for p in parts])
-    labels = np.asarray(_cluster_from_sig_jit(sig, keys, params.threshold,
-                                              params.n_iters))
+    didx = n_full_chunks
+    if ckpt.chunk_done(didx):
+        dsig_h, dkeys_h = ckpt.load_chunk(didx)
+        dpart = (jax.device_put(dsig_h), jax.device_put(dkeys_h))
+    else:
+        # Delta decode needs the full lane device-resident; chunks whose
+        # shards were loaded from disk never shipped their rows this run,
+        # so put them now (raw rows only — their signatures are done).
+        for idx, i in enumerate(range(0, full.shape[0], step)):
+            if chunks_d[idx] is None:
+                chunks_d[idx] = _put_chunk(full[i:i + step], pack)
+        full_d = (chunks_d[0] if len(chunks_d) == 1
+                  else jnp.concatenate(chunks_d))
+        rep_d = jax.device_put(enc.rep_in_full)
+        counts_d = jax.device_put(enc.counts)
+        pos_d = jax.device_put(enc.pos_flat)
+        if pack:
+            delta_items = _decode_delta_packed(
+                full_d, rep_d, counts_d, pos_d,
+                jax.device_put(_pack24_host(enc.val_flat)))
+        else:
+            delta_items = _decode_delta_raw(full_d, rep_d, counts_d, pos_d,
+                                            jax.device_put(enc.val_flat))
+        dsig, dkeys = minhash_and_keys(delta_items, a, b, params.n_bands,
+                                       **kw)
+        ckpt.save_chunk(didx, np.asarray(dsig), np.asarray(dkeys))
+        dpart = (dsig, dkeys)
+    sig = jnp.concatenate([p[0] for p in parts] + [dpart[0]])
+    keys = jnp.concatenate([p[1] for p in parts] + [dpart[1]])
+    labels = np.asarray(_cluster_encoded_labels(
+        sig, keys, jax.device_put(enc.mask_bits), n, params.threshold,
+        params.n_iters))
     if cleanup:
         ckpt.cleanup()
     return labels

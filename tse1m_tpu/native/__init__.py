@@ -1,12 +1,17 @@
-"""Native host-decode layer (SURVEY §2.4's C++ seat).
+"""Native host layer (SURVEY §2.4's C++ seat).
 
-``fetch_table()`` — when available — streams a sqlite query into typed
-numpy columns in one C++ pass (see ``decode.cc``).  The extension is
-compiled on first use with the system ``g++`` and cached next to the
-source; every failure mode (no compiler, no libsqlite3, unparseable data)
-degrades to ``None`` so callers fall back to the pandas path.  The rebuild
-therefore never *requires* native code — it is a throughput lever for the
-1.19M-build extraction stage, not a correctness dependency.
+Two compile-on-first-use CPython extensions, each cached next to its
+source and rebuilt when the source is newer:
+
+- ``fetch_table()`` / ``decode.cc`` — streams a sqlite query into typed
+  numpy columns in one C++ pass (the 1.19M-build extraction stage).
+- ``group_delta_native()`` / ``encode.cc`` — the base-delta grouping pass
+  feeding the cluster pipeline's H2D encoding (cluster/encode.py).
+
+Every failure mode (no compiler, no libsqlite3, unparseable data)
+degrades to ``None`` so callers fall back to the pure-Python path.  The
+rebuild therefore never *requires* native code — it is a throughput
+lever, not a correctness dependency.
 """
 
 from __future__ import annotations
@@ -21,58 +26,62 @@ from ..utils.logging import get_logger
 
 log = get_logger("native")
 
-_SRC = os.path.join(os.path.dirname(__file__), "decode.cc")
-_SO = os.path.join(os.path.dirname(__file__), "_tse1m_decode.so")
+_DIR = os.path.dirname(__file__)
+
+
+def _build_and_load(name: str, src: str, so: str, stds: tuple,
+                    link_flags: tuple, fallback_note: str):
+    """Compile ``src`` -> ``so`` (if stale) and import it.  Returns the
+    module or None; never raises — the caller's pure-Python path is the
+    recovery strategy for every failure mode."""
+    import numpy as np
+
+    try:
+        stale = (not os.path.exists(so)
+                 or os.path.getmtime(so) < os.path.getmtime(src))
+        if stale:
+            # Atomic replace so concurrent first-callers never import a
+            # half-written object; the temp file must live on the same
+            # filesystem for rename.
+            fd, tmp = tempfile.mkstemp(suffix=".so", dir=_DIR)
+            os.close(fd)
+            try:
+                errors = []
+                for std in stds:
+                    proc = subprocess.run(
+                        ["g++", "-O2", std, "-shared", "-fPIC",
+                         "-I" + sysconfig.get_paths()["include"],
+                         "-I" + np.get_include(), src, *link_flags,
+                         "-o", tmp],
+                        capture_output=True, text=True, timeout=300)
+                    if proc.returncode == 0:
+                        break
+                    tail = (proc.stderr.strip().splitlines()[-1]
+                            if proc.stderr.strip() else proc.returncode)
+                    errors.append(f"{std}: {tail}")
+                else:
+                    # Every attempt's diagnostic is kept — the first one
+                    # usually names the real problem, the retry's would
+                    # mask it.
+                    log.info("native %s build failed (%s): %s", name,
+                             fallback_note, " | ".join(map(str, errors)))
+                    return None
+                os.replace(tmp, so)
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+        spec = importlib.util.spec_from_file_location(name, so)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        log.info("native %s loaded (%s)", name, so)
+        return mod
+    except Exception as e:  # no g++, sandboxed exec, import failure, ...
+        log.info("native %s unavailable (%s); %s", name, e, fallback_note)
+        return None
+
 
 _module = None
 _tried = False
-
-
-def _compile() -> bool:
-    import numpy as np
-
-    def cmd(std: str) -> list:
-        return [
-            "g++", "-O2", std, "-shared", "-fPIC",
-            "-I" + sysconfig.get_paths()["include"],
-            "-I" + np.get_include(),
-            _SRC,
-            "-l:libsqlite3.so.0",
-        ]
-
-    # Atomic replace so concurrent first-callers never import a half-written
-    # object; the temp file must live on the same filesystem for rename.
-    fd, tmp = tempfile.mkstemp(suffix=".so", dir=os.path.dirname(_SO))
-    os.close(fd)
-    try:
-        # C++20 first (heterogeneous string_view map lookup in the hot
-        # per-cell scan — decode.cc SvMap); toolchains without it (g++ <11)
-        # retry C++17, where decode.cc compiles its std::string-temporary
-        # lookup form — slower per cell but the native path stays alive.
-        errors = []
-        for std in ("-std=c++20", "-std=c++17"):
-            proc = subprocess.run(cmd(std) + ["-o", tmp],
-                                  capture_output=True, text=True,
-                                  timeout=300)
-            if proc.returncode == 0:
-                break
-            tail = (proc.stderr.strip().splitlines()[-1]
-                    if proc.stderr.strip() else proc.returncode)
-            errors.append(f"{std}: {tail}")
-        else:
-            # Every attempt's diagnostic is kept — the first one usually
-            # names the real problem, the retry's would mask it.
-            log.info("native decode build failed (falling back to pandas "
-                     "path): %s", " | ".join(map(str, errors)))
-            return False
-        os.replace(tmp, _SO)
-        return True
-    except Exception as e:  # no g++, sandboxed exec, ...
-        log.info("native decode unavailable (%s); using pandas path", e)
-        return False
-    finally:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
 
 
 def _load():
@@ -80,20 +89,46 @@ def _load():
     if _tried:
         return _module
     _tried = True
-    try:
-        stale = (not os.path.exists(_SO)
-                 or os.path.getmtime(_SO) < os.path.getmtime(_SRC))
-        if stale and not _compile():
-            return None
-        spec = importlib.util.spec_from_file_location("_tse1m_decode", _SO)
-        mod = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(mod)
-        _module = mod
-        log.info("native sqlite decoder loaded (%s)", _SO)
-    except Exception as e:
-        log.info("native decode import failed (%s); using pandas path", e)
-        _module = None
+    # C++20 first (heterogeneous string_view map lookup in the hot
+    # per-cell scan — decode.cc SvMap); toolchains without it (g++ <11)
+    # retry C++17, where decode.cc compiles its std::string-temporary
+    # lookup form — slower per cell but the native path stays alive.
+    _module = _build_and_load(
+        "_tse1m_decode", os.path.join(_DIR, "decode.cc"),
+        os.path.join(_DIR, "_tse1m_decode.so"),
+        stds=("-std=c++20", "-std=c++17"),
+        link_flags=("-l:libsqlite3.so.0",),
+        fallback_note="using pandas path")
     return _module
+
+
+_enc_module = None
+_enc_tried = False
+
+
+def _load_encode():
+    """Separate object from the decoder: encode.cc has no sqlite
+    dependency, so a missing libsqlite3 cannot take the encoder down
+    with it."""
+    global _enc_module, _enc_tried
+    if _enc_tried:
+        return _enc_module
+    _enc_tried = True
+    _enc_module = _build_and_load(
+        "_tse1m_encode", os.path.join(_DIR, "encode.cc"),
+        os.path.join(_DIR, "_tse1m_encode.so"),
+        stds=("-std=c++17",), link_flags=(),
+        fallback_note="using numpy encoder")
+    return _enc_module
+
+
+def group_delta_native(items, max_diffs: int, n_probes: int):
+    """C++ grouping pass for cluster/encode.py, or None when the native
+    path is unavailable — the caller falls back to the numpy encoder."""
+    mod = _load_encode()
+    if mod is None:
+        return None
+    return mod.group_delta(items, int(max_diffs), int(n_probes))
 
 
 def fetch_table(db_path: str, sql: str, params, spec: str, key_values):
