@@ -137,6 +137,19 @@ def test_mesh_sharded_cluster_matches_single(small_sets):
     assert adjusted_rand_index(labels, truth) >= 0.98
 
 
+def test_mesh_band_padding_matches_single(small_sets):
+    """n_bands not divisible by the mesh size: the band-sharded tail pads
+    with per-row-unique dummy bands (singleton buckets, no edges) — labels
+    must still match the single-device path exactly."""
+    items, _ = small_sets
+    devices = np.array(jax.devices()[:8]).reshape(8)
+    mesh = jax.sharding.Mesh(devices, ("data",))
+    prm = ClusterParams(use_pallas="never", n_hashes=32, n_bands=4)
+    np.testing.assert_array_equal(
+        cluster_sessions(items, prm, mesh=mesh),
+        cluster_sessions(items, prm))
+
+
 def test_mesh_sharded_cluster_with_padding():
     items, truth = synth_session_sets(1003, set_size=16, seed=11)
     devices = np.array(jax.devices()[:8]).reshape(8)

@@ -118,7 +118,8 @@ def test_encoded_labels_bit_identical_raw_values():
 def test_auto_policy_skips_small_inputs(dup_items):
     cluster_sessions(dup_items[:512],
                      ClusterParams(use_pallas="never", encoding="auto"))
-    assert pipeline_mod.last_run_info["encoding"] == "pack24"
+    # the two-step no-pallas path ships raw uint32 — the report says so
+    assert pipeline_mod.last_run_info["encoding"] == "raw"
 
 
 def test_auto_policy_engages_on_large_compressible(dup_items, monkeypatch):

@@ -146,6 +146,12 @@ def encode_delta(items: np.ndarray, *, max_diffs: int = 16,
     n, s = items.shape if items.ndim == 2 else (0, 0)
     if n < 2 or s == 0 or s > 255 or max_diffs > 255:
         return None
+    # Break-even clamp: a delta row must beat a full row on the wire even
+    # in the 24-bit-pack case (4 B base ref + 1 B count + nd*(1 B pos +
+    # 3 B value) < 3*s B full row).  Without it, small set sizes make the
+    # exact-diff verification vacuous and chance sketch collisions would
+    # *grow* the transfer.
+    max_diffs = min(max_diffs, max(1, (3 * s - 6) // 4))
     rep_of = None
     if use_native:
         from ..native import group_delta_native

@@ -36,22 +36,33 @@ def bucket_representatives(keys: jax.Array, orig: jax.Array | None = None,
     unencoded path's — buckets are order-invariant sets, so electing by
     original index yields the same hub, hence the same verified edges.
     """
-    n, n_bands = keys.shape
+    n = keys.shape[0]
     vals = jnp.arange(n, dtype=jnp.int32) if orig is None else orig
+    return jax.vmap(lambda k: band_hub_election(k, vals, lane_of),
+                    in_axes=1, out_axes=1)(keys.astype(jnp.uint32))
 
-    def one_band(k):
-        order = jnp.argsort(k)  # [N]
-        ks = k[order]
-        new_run = jnp.concatenate(
-            [jnp.ones((1,), jnp.bool_), ks[1:] != ks[:-1]])
-        seg = jnp.cumsum(new_run.astype(jnp.int32)) - 1  # [N] run ids
-        run_min = jax.ops.segment_min(vals[order], seg, num_segments=n)
-        rep_sorted = run_min[seg]  # min original index in my bucket
-        if lane_of is not None:
-            rep_sorted = lane_of[rep_sorted]
-        return jnp.zeros((n,), jnp.int32).at[order].set(rep_sorted)
 
-    return jax.vmap(one_band, in_axes=1, out_axes=1)(keys.astype(jnp.uint32))
+def band_hub_election(k: jax.Array, vals: jax.Array,
+                      lane_of: jax.Array | None = None) -> jax.Array:
+    """One band's hub election: [N] keys -> [N] rep row index.
+
+    argsort the keys, mark run boundaries, segment-min ``vals`` (the
+    election value — original indices) within runs, scatter back.  Shared
+    by the single-device vmap above and the band-sharded kernel
+    (cluster/sharded.py), which feeds one owned band at a time — keeping
+    the two paths' elections one implementation, hence bit-identical.
+    """
+    n = k.shape[0]
+    order = jnp.argsort(k)  # [N]
+    ks = k[order]
+    new_run = jnp.concatenate(
+        [jnp.ones((1,), jnp.bool_), ks[1:] != ks[:-1]])
+    seg = jnp.cumsum(new_run.astype(jnp.int32)) - 1  # [N] run ids
+    run_min = jax.ops.segment_min(vals[order], seg, num_segments=n)
+    rep_sorted = run_min[seg]  # min election value in my bucket
+    if lane_of is not None:
+        rep_sorted = lane_of[rep_sorted]
+    return jnp.zeros((n,), jnp.int32).at[order].set(rep_sorted)
 
 
 def estimated_jaccard(sig: jax.Array, reps: jax.Array) -> jax.Array:
@@ -73,13 +84,21 @@ def estimated_jaccard(sig: jax.Array, reps: jax.Array) -> jax.Array:
         0, n_bands, body, jnp.zeros((n, n_bands), jnp.float32))
 
 
-@partial(jax.jit, static_argnames=("n_iters",))
+@partial(jax.jit, static_argnames=("n_iters", "axis_name"))
 def propagate_labels(reps: jax.Array, valid: jax.Array,
-                     n_iters: int = 64) -> jax.Array:
+                     n_iters: int = 64,
+                     axis_name: str | None = None) -> jax.Array:
     """Min-label propagation over verified star edges, to convergence.
 
     reps: [N, B] rep item index per band; valid: [N, B] accepted edges.
     Returns [N] int32 labels = min item index reachable in each component.
+
+    ``axis_name``: when the band axis is sharded over a mesh (each device
+    holds B/d bands of the same N rows — cluster/sharded.py), labels stay
+    replicated and each pull/push reduces across devices with `pmin`.
+    Since min is associative/commutative, every iterate equals the
+    single-device trajectory exactly: bit-identical labels, same trip
+    count.
 
     Labels are monotonically non-increasing and bounded, and the fixpoint
     (the true component minima) is unique and schedule-independent — so the
@@ -101,10 +120,15 @@ def propagate_labels(reps: jax.Array, valid: jax.Array,
     def step(labels):
         # pull: my label can drop to my reps' labels
         pulled = jnp.min(labels[reps], axis=1)
+        if axis_name is not None:
+            pulled = jax.lax.pmin(pulled, axis_name)
         labels = jnp.minimum(labels, pulled)
         # push: my reps' labels can drop to mine (scatter-min)
-        labels = labels.at[reps.reshape(-1)].min(
+        pushed = labels.at[reps.reshape(-1)].min(
             jnp.broadcast_to(labels[:, None], reps.shape).reshape(-1))
+        if axis_name is not None:
+            pushed = jax.lax.pmin(pushed, axis_name)
+        labels = jnp.minimum(labels, pushed)
         # pointer jumping: compress chains label -> label[label]
         return jnp.minimum(labels, labels[labels])
 

@@ -1,14 +1,15 @@
 """End-to-end device clustering pipeline with mesh sharding.
 
 Single-device: one jitted chain items -> signatures -> band keys -> bucket
-reps -> verified edges -> propagated labels.
+reps -> verified edges -> propagated labels, fed over the H2D link by the
+base-delta wire encoding (cluster/encode.py) when it pays.
 
-Multi-device: the FLOP/bandwidth-heavy stage (MinHash + band keys) is
-sharded over the item axis of a `jax.sharding.Mesh` via sharding
-constraints under jit — XLA's SPMD partitioner runs it collective-free
-(embarrassingly data-parallel) and inserts the all-gather where the
-clustering stage's global sort needs full visibility.  This mirrors the
-scaling-book recipe: annotate shardings, let XLA place collectives on ICI.
+Multi-device: MinHash + band keys stay row-sharded (embarrassingly
+data-parallel); the bucket/verify/propagate tail is band-sharded with an
+explicit `shard_map` kernel (cluster/sharded.py) — `all_to_all` re-shards
+the keys so each device sorts only B/d bands, and label propagation
+reduces across devices with `pmin`.  Labels are bit-identical to the
+single-device path in both cases.
 """
 
 from __future__ import annotations
@@ -78,15 +79,6 @@ _cluster_from_sig_jit = jax.jit(
     _cluster_from_sig, static_argnames=("threshold", "n_iters"))
 
 
-@partial(jax.jit, static_argnames=("sharding", "n_bands", "threshold", "n_iters"))
-def _cluster_sharded(items_d, a, b, sharding, n_bands: int, threshold: float,
-                     n_iters: int):
-    items_d = jax.lax.with_sharding_constraint(items_d, sharding)
-    sig = minhash_signatures(items_d, a, b)
-    keys = band_keys(sig, n_bands)
-    return _cluster_from_sig(sig, keys, threshold, n_iters)
-
-
 @jax.jit
 def _decode_delta_packed(full_d, rep_d, counts_d, pos_d, val3_d):
     """Delta lane -> [D, S] uint32 rows, on device.
@@ -142,11 +134,15 @@ def _cluster_encoded_labels(sig, keys, mask_bytes, n: int, threshold: float,
     return cmin[lab][lane_of]
 
 
-def _maybe_encode(items: np.ndarray, params: ClusterParams):
-    """Apply the ClusterParams.encoding policy; None = ship plain lanes."""
+def _validate_encoding(params: ClusterParams) -> None:
     if params.encoding not in ("auto", "delta", "pack24"):
         raise ValueError(f"unknown encoding {params.encoding!r}; "
                          "expected auto | delta | pack24")
+
+
+def _maybe_encode(items: np.ndarray, params: ClusterParams):
+    """Apply the ClusterParams.encoding policy; None = ship plain lanes."""
+    _validate_encoding(params)
     if params.encoding == "pack24":
         return None
     if params.encoding == "auto" and items.nbytes < _AUTO_MIN_BYTES:
@@ -168,7 +164,7 @@ def _cluster_encoded(items: np.ndarray, enc, a, b, params: ClusterParams,
     n = items.shape[0]
     kw = dict(use_pallas=params.use_pallas, block_n=params.block_n)
     full = enc.full_rows
-    step, _ = _stream_plan(full, params)
+    step, _ = _stream_plan(full, params, pack)
     chunks_d, parts = [], []
     for i in range(0, full.shape[0], step):
         cd = _put_chunk(full[i:i + step], pack)
@@ -209,7 +205,9 @@ def cluster_sessions(items, params: ClusterParams | None = None,
     if mesh is not None:
         # The base-delta wire encoding is a single-host H2D optimisation;
         # mesh feeding ships raw shards (multi-host rows never transit one
-        # host's link), so params.encoding does not apply here.
+        # host's link), so params.encoding does not apply here — but a
+        # typo'd value must still fail here, not only in local testing.
+        _validate_encoding(params)
         last_run_info.clear()
         last_run_info.update(encoding="mesh-raw")
         from ..parallel.mesh import pad_to_devices
@@ -233,8 +231,13 @@ def cluster_sessions(items, params: ClusterParams | None = None,
             n = items.shape[0]
             items, _ = pad_to_devices(items, mesh)
             items_d = jax.device_put(items, sharding)
-        labels = _cluster_sharded(items_d, a, b, sharding, params.n_bands,
-                                  params.threshold, params.n_iters)
+        from .sharded import _sharded_cluster_kernel
+
+        # Band-sharded tail (cluster/sharded.py): distributes the
+        # bucket/verify/propagate stages, not just MinHash.
+        kernel = _sharded_cluster_kernel(mesh, axis, params.n_bands,
+                                         params.threshold, params.n_iters)
+        labels = kernel(items_d, a, b)
         if jax.process_count() > 1:
             # Multi-host: shards live on non-addressable devices, so a
             # plain np.asarray would fail — allgather across processes
@@ -258,19 +261,22 @@ def cluster_sessions(items, params: ClusterParams | None = None,
             n_full=enc.n_full, n_delta=enc.n_delta,
             wire_mb=round(enc.wire_bytes(pack) / 2**20, 1))
         return _cluster_encoded(items, enc, a, b, params, pack)
-    last_run_info.update(
-        encoding="pack24" if pack else "raw",
-        wire_mb=round(items.shape[0] * items.shape[1]
-                      * (3 if pack else 4) / 2**20, 1))
 
     if params.use_pallas != "never":
-        sig, keys = _minhash_streamed(items, a, b, params)
+        last_run_info.update(
+            encoding="pack24" if pack else "raw",
+            wire_mb=round(items.shape[0] * items.shape[1]
+                          * (3 if pack else 4) / 2**20, 1))
+        sig, keys = _minhash_streamed(items, a, b, params, pack)
         labels = _cluster_from_sig_jit(sig, keys, params.threshold,
                                        params.n_iters)
         return np.asarray(labels)
 
     # Explicit H2D placement up front (no device argument — keeps the array
     # uncommitted so callers can still steer with jax.default_device).
+    # This two-step path ships raw uint32 (no 24-bit pack) — report it so.
+    last_run_info.update(encoding="raw",
+                         wire_mb=round(items.nbytes / 2**20, 1))
     return np.asarray(_cluster_jax(jax.device_put(items), a, b,
                                    params.n_bands, params.threshold,
                                    params.n_iters))
@@ -299,17 +305,20 @@ def should_pack24(items: np.ndarray) -> bool:
     return bool(items.size) and bool(items.max() < _PACK_LIMIT)
 
 
-def _stream_plan(items: np.ndarray, params: ClusterParams) -> tuple[int, bool]:
+def _stream_plan(items: np.ndarray, params: ClusterParams,
+                 pack: bool | None = None) -> tuple[int, bool]:
     """(chunk step, pack?) — THE chunking policy, shared by the streamed
     and resumable paths so their chunks always align.  step >= n means
     single-shot (chunking off or input too small to double-buffer); chunks
     land on block_n boundaries so the pallas path pads at most the final
-    chunk."""
+    chunk.  ``pack`` skips the O(N*S) should_pack24 max scan when the
+    caller already decided it."""
     n = items.shape[0]
     n_chunks = params.h2d_chunks
     if n_chunks == 0:
         n_chunks = int(min(_MAX_CHUNKS, max(1, items.nbytes // _CHUNK_BYTES)))
-    pack = should_pack24(items)
+    if pack is None:
+        pack = should_pack24(items)
     if n_chunks <= 1 or n < 2 * params.block_n:
         return max(n, 1), pack
     step = -(-n // n_chunks)
@@ -395,8 +404,8 @@ def cluster_sessions_resumable(items, params: ClusterParams | None = None,
     import hashlib
 
     full = enc.full_rows
-    step, _ = _stream_plan(full, params)
     pack = should_pack24(items)  # one width for both lanes
+    step, _ = _stream_plan(full, params, pack)
     n_full_chunks = max(1, -(-full.shape[0] // step))
     lane_fp = hashlib.blake2b(
         enc.mask_bits.tobytes() + enc.counts.tobytes(),
@@ -454,7 +463,8 @@ def cluster_sessions_resumable(items, params: ClusterParams | None = None,
     return labels
 
 
-def _minhash_streamed(items: np.ndarray, a, b, params: ClusterParams):
+def _minhash_streamed(items: np.ndarray, a, b, params: ClusterParams,
+                      pack: bool | None = None):
     """items -> (signatures, band keys), overlapping H2D with compute.
 
     The ~N*S*4-byte items transfer is the dominant wall-time cost on a
@@ -466,7 +476,7 @@ def _minhash_streamed(items: np.ndarray, a, b, params: ClusterParams):
     the unchunked path because MinHash is row-independent.
     """
     n = items.shape[0]
-    step, pack = _stream_plan(items, params)
+    step, pack = _stream_plan(items, params, pack)
     kw = dict(use_pallas=params.use_pallas, block_n=params.block_n)
     if step >= n:
         return minhash_and_keys(_put_chunk(items, pack), a, b,
