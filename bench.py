@@ -165,6 +165,30 @@ def bench_rq_suite(arrays, cfg, extract_wall_s: float, iters: int = 3) -> dict:
     close(j.g1_percentiles, p.g1_percentiles, err_msg="rq4b.g1")
     close(j.g2_percentiles, p.g2_percentiles, err_msg="rq4b.g2")
 
+    # Fused suite (backend.rq_suite): the device backend runs all six RQ
+    # bodies in ONE dispatch + ONE packed fetch (jax_backend.
+    # _rq_suite_kernel), so the whole suite costs ~1 link round-trip; the
+    # host backend's rq_suite is the six sequential calls.  Parity of the
+    # fused results vs the per-RQ calls is asserted in
+    # tests/test_rq_suite.py; here we spot-check the flagship fields.
+    min_p, limit = min_projects, limit_ns
+    for key, be in backends.items():
+        suite_res = be.rq_suite(arrays, limit, min_p, g1, g2)  # warm
+        runs = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            suite_res = be.rq_suite(arrays, limit, min_p, g1, g2)
+            runs.append(time.perf_counter() - t0)
+        out[f"rq_suite_fused_{key}_wall_s"] = round(statistics.median(runs),
+                                                    4)
+        eq(suite_res["rq1"].iterations, res[("rq1", key)].iterations,
+           err_msg=f"fused/{key} rq1.iterations")
+        eq(suite_res["rq4a"].iterations, res[("rq4a", key)].iterations,
+           err_msg=f"fused/{key} rq4a.iterations")
+    out["rq_suite_fused_winner"] = (
+        "jax_tpu" if out["rq_suite_fused_jax_wall_s"]
+        <= out["rq_suite_fused_pandas_wall_s"] else "pandas")
+
     jax_s = out["rq1_jax_wall_s"]
     pd_s = out["rq1_pandas_wall_s"]
     winner = "jax_tpu" if jax_s <= pd_s else "pandas"

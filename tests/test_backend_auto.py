@@ -38,15 +38,18 @@ def test_auto_routes_per_rq_on_tunneled_link(monkeypatch):
     monkeypatch.setattr(backend_mod, "_dispatch_rtt_s", lambda: 0.11)
     be = get_backend(Config(backend="auto"))
     assert isinstance(be, AutoBackend)
-    # 1M-build-scale row counts (BENCH_r04): loop-heavy RQs go to the
-    # device even at 110 ms RTT; vectorized ones stay on host.
-    assert isinstance(be._engine("rq2cp", 713_000), JaxBackend)
-    assert isinstance(be._engine("rq3", 1_140_000), JaxBackend)
-    assert isinstance(be._engine("rq1", 1_000_000), PandasBackend)
-    assert isinstance(be._engine("rq4a", 1_000_000), PandasBackend)
+    # First-call priors at 1M-build-scale row counts (BENCH_r04):
+    # loop-heavy RQs go to the device even at 110 ms RTT; vectorized ones
+    # stay on host.
+    assert be._pick("rq2cp", 713_000)[0] == "jax"
+    assert be._pick("rq3", 1_140_000)[0] == "jax"
+    assert be._pick("rq1", 1_000_000)[0] == "pandas"
+    assert be._pick("rq4a", 1_000_000)[0] == "pandas"
     # Small-study rows: everything stays on host.
     for key in ("rq1", "rq2cp", "rq2tr", "rq3", "rq4a", "rq4b"):
-        assert isinstance(be._engine(key, 20_000), PandasBackend)
+        assert be._pick(key, 20_000)[0] == "pandas"
+    assert isinstance(be._pick("rq2cp", 713_000)[1], JaxBackend)
+    assert isinstance(be._pick("rq1", 1_000_000)[1], PandasBackend)
 
 
 def test_auto_routes_everything_to_device_when_local(monkeypatch):
@@ -54,7 +57,55 @@ def test_auto_routes_everything_to_device_when_local(monkeypatch):
     for key, rows in (("rq1", 1_000_000), ("rq2cp", 713_000),
                       ("rq2tr", 415_000), ("rq3", 1_140_000),
                       ("rq4a", 1_000_000), ("rq4b", 415_000)):
-        assert isinstance(be._engine(key, rows), JaxBackend), key
+        assert be._pick(key, rows)[0] == "jax", key
+
+
+def test_slow_host_measurement_flips_routing():
+    """The round-4 verdict's ask: routing must derive from measurements on
+    the running machine.  A measured-slow host flips the next call to the
+    device even where the bootstrap prior said host."""
+    be = AutoBackend(rtt_s=0.11)
+    assert be._pick("rq1", 100_000)[0] == "pandas"  # prior: host wins
+    be._observe("rq1", "pandas", 100_000, wall_s=5.0)  # this host is slow
+    assert be._pick("rq1", 100_000)[0] == "jax"
+
+
+def test_slow_device_measurement_flips_back():
+    be = AutoBackend(rtt_s=0.0002)
+    assert be._pick("rq2cp", 713_000)[0] == "jax"
+    be._observe("rq2cp", "jax", 713_000, wall_s=30.0)  # congested device
+    assert be._pick("rq2cp", 713_000)[0] == "pandas"
+
+
+def test_first_device_call_excluded_from_calibration(study_cfg, study_db):
+    """The first device call per RQ pays jit compilation and must not be
+    recorded as that engine's steady-state cost."""
+    from tse1m_tpu.data.columnar import StudyArrays
+
+    arrays = StudyArrays.from_db(study_db, study_cfg)
+    limit_ns = int(np.datetime64(study_cfg.limit_date, "ns")
+                   .astype(np.int64))
+    be = AutoBackend(rtt_s=1e-9)  # device always predicted to win
+    be.rq1_detection(arrays, limit_ns, 1)
+    assert ("rq1", "jax") not in be._cost  # compile call skipped
+    be.rq1_detection(arrays, limit_ns, 1)
+    assert ("rq1", "jax") in be._cost      # warm call recorded
+
+
+def test_calibration_surfaces_in_manifest():
+    from tse1m_tpu.utils.manifest import RunManifest
+
+    be = AutoBackend(rtt_s=0.11)
+    be._observe("rq1", "pandas", 1000, 0.01)
+    m = RunManifest("rq1", be.name)
+    m.record_backend(be)
+    cal = m.extra["router_calibration"]
+    assert cal["dispatch_rtt_s"] == 0.11
+    assert "rq1:pandas" in cal["cost_per_row"]
+    # plain engines are a no-op
+    m2 = RunManifest("rq1", "pandas")
+    m2.record_backend(PandasBackend())
+    assert "router_calibration" not in m2.extra
 
 
 def test_auto_probe_cached_per_process(monkeypatch):

@@ -1,0 +1,116 @@
+"""Fused all-six-RQ dispatch (backend.rq_suite): one device round-trip for
+the whole analysis suite.  The fused kernel shares its bodies and cached
+CSR lanes with the per-RQ kernels, so every field must be bit-identical to
+the individual calls — and both backends must agree on the suite dict."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tse1m_tpu.backend.jax_backend import JaxBackend
+from tse1m_tpu.backend.pandas_backend import PandasBackend
+from tse1m_tpu.data.columnar import StudyArrays
+
+
+@pytest.fixture(scope="module")
+def arrays(study_db, study_cfg):
+    return StudyArrays.from_db(study_db, study_cfg)
+
+
+@pytest.fixture(scope="module")
+def suite_args(arrays, study_cfg):
+    limit_ns = int(np.datetime64(study_cfg.limit_date, "ns").astype(np.int64))
+    g1 = np.arange(0, arrays.n_projects, 2)
+    g2 = np.arange(1, arrays.n_projects, 2)
+    return dict(arrays=arrays, limit_date_ns=limit_ns, min_projects=1,
+                g1_idx=g1, g2_idx=g2)
+
+
+def _assert_results_equal(a, b, rq: str):
+    assert type(a) is type(b), rq
+    for f in a.__dataclass_fields__:
+        x, y = getattr(a, f), getattr(b, f)
+        if isinstance(x, np.ndarray):
+            np.testing.assert_array_equal(x, y, err_msg=f"{rq}.{f}")
+        else:
+            assert x == y, f"{rq}.{f}"
+
+
+def test_fused_suite_matches_individual_calls(suite_args):
+    be = JaxBackend(mesh=None)
+    fused = be.rq_suite(**suite_args)
+    a = suite_args
+    individual = {
+        "rq1": be.rq1_detection(a["arrays"], a["limit_date_ns"],
+                                a["min_projects"]),
+        "rq2cp": be.rq2_change_points(a["arrays"], a["limit_date_ns"]),
+        "rq2tr": be.rq2_trends(a["arrays"], a["limit_date_ns"]),
+        "rq3": be.rq3_coverage_at_detection(a["arrays"], a["limit_date_ns"]),
+        "rq4a": be.rq4a_detection_trend(a["arrays"], a["limit_date_ns"],
+                                        a["g1_idx"], a["g2_idx"],
+                                        a["min_projects"]),
+        "rq4b": be.rq4b_group_trends(a["arrays"], a["limit_date_ns"],
+                                     a["g1_idx"], a["g2_idx"]),
+    }
+    assert set(fused) == set(individual)
+    for rq in individual:
+        _assert_results_equal(fused[rq], individual[rq], rq)
+
+
+def test_fused_suite_matches_pandas_backend(suite_args):
+    """Cross-engine parity on the suite surface (the same fields bench.py
+    gates on per RQ)."""
+    fused = JaxBackend(mesh=None).rq_suite(**suite_args)
+    host = PandasBackend().rq_suite(**suite_args)
+    eq = np.testing.assert_array_equal
+    close = np.testing.assert_allclose
+    for f in ("iterations", "total_projects", "detected_counts"):
+        eq(getattr(fused["rq1"], f), getattr(host["rq1"], f), err_msg=f)
+    eq(fused["rq2cp"].end_i, host["rq2cp"].end_i)
+    close(fused["rq2cp"].covered_i, host["rq2cp"].covered_i)
+    eq(fused["rq2tr"].counts, host["rq2tr"].counts)
+    close(fused["rq2tr"].percentiles, host["rq2tr"].percentiles,
+          rtol=2e-5, atol=2e-5)
+    eq(fused["rq3"].det_issue_idx, host["rq3"].det_issue_idx)
+    close(fused["rq3"].det_diff_percent, host["rq3"].det_diff_percent)
+    for f in ("iterations", "g1_total", "g1_detected", "g2_total",
+              "g2_detected"):
+        eq(getattr(fused["rq4a"], f), getattr(host["rq4a"], f), err_msg=f)
+    close(fused["rq4b"].g1_percentiles, host["rq4b"].g1_percentiles)
+    close(fused["rq4b"].g2_percentiles, host["rq4b"].g2_percentiles)
+
+
+def test_suite_fallback_on_empty_study(study_cfg, tmp_path):
+    """Degenerate shapes route through the six individual calls (their
+    guards), not the fused kernel."""
+    from tse1m_tpu.config import Config
+    from tse1m_tpu.data.synth import SynthSpec, generate_study
+    from tse1m_tpu.db.connection import DB
+
+    cfg = Config(engine="sqlite", sqlite_path=str(tmp_path / "tiny.sqlite"),
+                 limit_date="2020-01-01")  # cutoff before any data
+    db = DB(config=cfg).connect()
+    generate_study(SynthSpec(n_projects=3, days=30, seed=1)).to_db(db)
+    arrays = StudyArrays.from_db(db, cfg)
+    limit_ns = int(np.datetime64("2020-01-01", "ns").astype(np.int64))
+    empty = np.empty(0, dtype=np.int64)
+    out = JaxBackend(mesh=None).rq_suite(arrays, limit_ns, 1, empty, empty)
+    assert set(out) == {"rq1", "rq2cp", "rq2tr", "rq3", "rq4a", "rq4b"}
+    db.closeConnection()
+
+
+def test_suite_on_mesh_backend_delegates(suite_args):
+    """A mesh-bearing backend uses the sequential path (mesh kernels have
+    their own collectives) and still returns the full dict."""
+    import jax
+
+    from tse1m_tpu.parallel import make_mesh
+
+    if jax.device_count() < 2:
+        pytest.skip("needs multi-device")
+    be = JaxBackend(mesh=make_mesh(2))
+    out = be.rq_suite(**suite_args)
+    fused = JaxBackend(mesh=None).rq_suite(**suite_args)
+    for rq in out:
+        _assert_results_equal(out[rq], fused[rq], rq)

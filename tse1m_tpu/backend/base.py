@@ -211,3 +211,22 @@ class Backend(abc.ABC):
                           percentiles: tuple = (25, 50, 75)
                           ) -> RQ4bTrendsResult:
         ...
+
+    def rq_suite(self, arrays: StudyArrays, limit_date_ns: int,
+                 min_projects: int, g1_idx: np.ndarray, g2_idx: np.ndarray,
+                 percentiles: tuple = (25, 50, 75)) -> dict:
+        """All six RQs over one study: {'rq1', 'rq2cp', 'rq2tr', 'rq3',
+        'rq4a', 'rq4b'} -> result objects.  Default: six sequential calls.
+        The device backend overrides this with a single fused dispatch
+        (jax_backend._rq_suite_kernel) so the whole suite costs one
+        round-trip on a remote link."""
+        return {
+            "rq1": self.rq1_detection(arrays, limit_date_ns, min_projects),
+            "rq2cp": self.rq2_change_points(arrays, limit_date_ns),
+            "rq2tr": self.rq2_trends(arrays, limit_date_ns),
+            "rq3": self.rq3_coverage_at_detection(arrays, limit_date_ns),
+            "rq4a": self.rq4a_detection_trend(arrays, limit_date_ns,
+                                              g1_idx, g2_idx, min_projects),
+            "rq4b": self.rq4b_group_trends(arrays, limit_date_ns,
+                                           g1_idx, g2_idx, percentiles),
+        }

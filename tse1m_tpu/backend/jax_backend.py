@@ -233,6 +233,123 @@ def _dev_rq3_targets(arrays: StudyArrays, cache: dict):
     return _cached(cache, "rq3_targets", build)
 
 
+def _rq2cp_bounds(arrays: StudyArrays, cache: dict, limit_date_ns: int):
+    """Host group-boundary structure for RQ2 change points (the reference's
+    shift/cumsum grouping, rq2_coverage_and_added.py:129-149) + the staged
+    device query lanes for the date join.  Deterministic per (study,
+    cutoff), so cached like the CSR views — this is the dominant host cost
+    of an rq2cp call (~0.25 s at the 1M bench scale)."""
+    def build():
+        covb_t = arrays.covb.columns["time_ns"]
+        ghash = arrays.covb.columns["grouphash"]
+        seg_all = np.repeat(np.arange(arrays.n_projects),
+                            arrays.covb.counts())
+        _, cov_offsets = _host_cov_cut(arrays, cache, limit_date_ns)
+        has_cov = np.diff(cov_offsets) > 0
+        keep = ((covb_t < limit_date_ns) & arrays.covb.columns["ok"]
+                & has_cov[seg_all])
+        rows = np.flatnonzero(keep)
+        if rows.size == 0:
+            return None
+        seg = seg_all[rows]
+        g = ghash[rows]
+        new_group = np.concatenate(
+            [[True], (g[1:] != g[:-1]) | (seg[1:] != seg[:-1])])
+        start_pos = np.flatnonzero(new_group)
+        starts = rows[start_pos]
+        ends = rows[np.concatenate([start_pos[1:] - 1, [rows.size - 1]])]
+        gseg = seg[start_pos]
+        pair = np.flatnonzero(gseg[:-1] == gseg[1:])
+        end_i = ends[pair]
+        start_ip1 = starts[pair + 1]
+        proj = gseg[pair]
+        if end_i.size == 0:
+            return None
+        q_days = np.concatenate([floor_day_ns(covb_t[end_i]),
+                                 floor_day_ns(covb_t[start_ip1])])
+        q_seg = np.concatenate([proj, proj]).astype(np.int32)
+        qs, qns = ns_to_device_pair(q_days)
+        return {"end_i": end_i, "start_ip1": start_ip1, "proj": proj,
+                "q_days": q_days, "q_seg": q_seg,
+                "qs_d": jax.device_put(qs), "qns_d": jax.device_put(qns),
+                "qseg_d": jax.device_put(q_seg)}
+    return _cached(cache, f"rq2cp_bounds:{limit_date_ns}", build)
+
+
+def _trend_matrix(arrays: StudyArrays, sel: np.ndarray,
+                  values: np.ndarray):
+    """Scatter selected coverage rows into a padded [P, S] matrix + mask
+    (the reference's ragged coverage_by_session_index aggregation,
+    rq2_coverage_count.py:330-333)."""
+    P = arrays.n_projects
+    seg_all = np.repeat(np.arange(P), arrays.cov.counts())
+    lens = np.bincount(seg_all[sel], minlength=P)
+    S = int(lens.max()) if lens.size else 0
+    matrix = np.full((P, S), np.nan)
+    mask = np.zeros((P, S), dtype=bool)
+    if S:
+        kept_seg = seg_all[sel]
+        pos_in_proj = np.arange(int(sel.sum())) - np.repeat(
+            np.concatenate([[0], np.cumsum(lens)[:-1]]), lens)
+        matrix[kept_seg, pos_in_proj] = values[sel]
+        mask[kept_seg, pos_in_proj] = True
+    return matrix, mask
+
+
+def _rq2tr_prep(arrays: StudyArrays, cache: dict, limit_date_ns: int):
+    """RQ2-trends host+device prep, cached per (study, cutoff): the padded
+    trend matrix, its device copies, and the percentile order-statistic
+    index plan (lo/hi/frac) the fused kernel consumes."""
+    def build():
+        P = arrays.n_projects
+        cov = arrays.cov
+        coverage = cov.columns["coverage"]
+        covered = cov.columns["covered"]
+        total = cov.columns["total"]
+        sel = ((~np.isnan(coverage)) & (coverage != 0) & (total != 0)
+               & ~np.isnan(total) & ~np.isnan(covered)
+               & (cov.columns["date_ns"] < limit_date_ns))
+        with np.errstate(invalid="ignore", divide="ignore"):
+            vals = covered / total * 100.0
+        matrix, mask = _trend_matrix(arrays, sel, vals)
+        S = matrix.shape[1]
+        q = np.array(RQ2TrendsResult.PCTS, dtype=np.float32)
+        n_valid = mask.sum(axis=0).astype(np.int32)
+        pos = (n_valid.astype(np.float32) - np.float32(1.0)) \
+            * q[:, None] / np.float32(100.0)
+        lo = np.clip(np.floor(pos).astype(np.int32), 0, max(P - 1, 0))
+        hi = np.clip(lo + 1, 0, max(P - 1, 0))
+        frac = pos - lo.astype(np.float32)
+        return {"matrix": matrix, "mask": mask, "n_valid": n_valid,
+                "lo": lo, "hi": hi, "frac": frac, "S": S}
+    return _cached(cache, f"rq2tr_prep:{limit_date_ns}", build)
+
+
+def _rq2tr_dev(arrays: StudyArrays, cache: dict, limit_date_ns: int):
+    """Device copies of the trend matrix + index plan — built only on the
+    single-device path (the mesh kernels consume the host matrix), so a
+    mesh run never ships these [P, S] lanes over the link."""
+    def build():
+        prep = _rq2tr_prep(arrays, cache, limit_date_ns)
+        return (jax.device_put(prep["matrix"].astype(np.float32)),
+                jax.device_put(prep["mask"]),
+                jax.device_put(prep["lo"]), jax.device_put(prep["hi"]))
+    return _cached(cache, f"rq2tr_dev:{limit_date_ns}", build)
+
+
+def _rq4b_matrix(arrays: StudyArrays, cache: dict, limit_date_ns: int):
+    """RQ4b's padded coverage matrix, cached per (study, cutoff) — the
+    scatter is identical across g1/g2 calls; only the float64 group
+    percentile reductions (host) depend on the group split."""
+    def build():
+        cov = arrays.cov
+        coverage = cov.columns["coverage"]
+        sel = ((~np.isnan(coverage)) & (coverage > 0)
+               & (cov.columns["date_ns"] < limit_date_ns))
+        return _trend_matrix(arrays, sel, coverage)
+    return _cached(cache, f"rq4b_matrix:{limit_date_ns}", build)
+
+
 # ---------------------------------------------------------------------------
 # Fused kernels (one dispatch + one packed D2H fetch per RQ call)
 # ---------------------------------------------------------------------------
@@ -282,9 +399,8 @@ def _rq1_kernel_packed(fuzz_s, fuzz_ns, fuzz_offsets, ok_s, ok_ns, ok_offsets,
                             totals, detected])
 
 
-@jax.jit
-def _rq3_kernel(fts, ftn, f_off, cts, ctn, c_off, dts, dtn, v_off,
-                is_, ins, seg, qts, qtn):
+def _rq3_body(fts, ftn, f_off, cts, ctn, c_off, dts, dtn, v_off,
+              is_, ins, seg, qts, qtn):
     """RQ3's three per-issue linear scans (rq3:269,273,287-293) as one fused
     dispatch: last ok fuzz build before rts, first coverage build after rts,
     and the day-after coverage row — stacked [3, Q] for a single fetch."""
@@ -297,9 +413,11 @@ def _rq3_kernel(fts, ftn, f_off, cts, ctn, c_off, dts, dtn, v_off,
     return jnp.stack([pos_f, pos_c, pos_d])
 
 
-@partial(jax.jit, static_argnames=("n_projects", "max_iter"))
-def _rq4a_kernel(fts, ftn, f_off, is_, ins, seg, gid, sel1, sel2,
-                 n_projects: int, max_iter: int):
+_rq3_kernel = jax.jit(_rq3_body)
+
+
+def _rq4a_body(fts, ftn, f_off, is_, ins, seg, gid, sel1, sel2,
+               n_projects: int, max_iter: int):
     """RQ4a's G1/G2 loop (rq4a_bug.py:324-346) in one dispatch: one
     searchsorted maps every grouped issue to its iteration; per-group
     survival curves come from a weighted bincount (weight = group
@@ -326,8 +444,11 @@ def _rq4a_kernel(fts, ftn, f_off, is_, ins, seg, gid, sel1, sel2,
     return jnp.concatenate([ks, t1, d1, t2, d2])
 
 
-@jax.jit
-def _rq2_trends_kernel(mj, kj, lo, hi):
+_rq4a_kernel = jax.jit(_rq4a_body, static_argnames=("n_projects",
+                                                    "max_iter"))
+
+
+def _rq2tr_body(mj, kj, lo, hi):
     """RQ2 trends' device work in one dispatch: per-project Spearman, the
     per-session sort + two order-statistic gathers (the rounding-free part
     of masked_percentile — the float32 lerp replays on host, same op order,
@@ -343,6 +464,99 @@ def _rq2_trends_kernel(mj, kj, lo, hi):
     vhi = jnp.take_along_axis(srt, hi.T, axis=-1).T
     mean = masked_mean(cols, colmask)
     return jnp.concatenate([spear, vlo.ravel(), vhi.ravel(), mean])
+
+
+_rq2_trends_kernel = jax.jit(_rq2tr_body)
+
+
+def _pack_cp_lane(cp_pos, cp16: bool):
+    """rq2cp's boundary-join lane is ~2 int32 per change point — the fat
+    D2H lane of both the fused suite and the standalone rq2cp call.  When
+    every coverage segment is shorter than 2^15 rows (caller-checked) the
+    positions fit int16: pack pairs into int32, halving the fetch."""
+    if not cp16:
+        return cp_pos.astype(jnp.int32)
+    nb = cp_pos.shape[0]
+    cp = cp_pos.astype(jnp.int16)
+    if nb % 2:
+        cp = jnp.concatenate([cp, jnp.zeros(1, jnp.int16)])
+    return jax.lax.bitcast_convert_type(cp.reshape(-1, 2), jnp.int32)
+
+
+def _unpack_cp_lane(lane: np.ndarray, nb: int, cp16: bool) -> np.ndarray:
+    if not cp16:
+        return lane
+    return lane.view(np.int16)[:nb].astype(np.int64)
+
+
+@partial(jax.jit, static_argnames=("cp16",))
+def _rq2cp_join_kernel(ds, dns, off, qs, qns, qseg, cp16: bool):
+    """Standalone rq2cp date join: one searchsorted + the packed lane."""
+    pos = segment_searchsorted(ds, off, qs, qseg, side="left",
+                               values_lo=dns, queries_lo=qns)
+    return _pack_cp_lane(pos, cp16)
+
+
+@partial(jax.jit, static_argnames=("n_projects", "max_iter1", "max_iter4",
+                                   "cp16"))
+def _rq_suite_kernel(fs, fns, foff, oks, okns, okoff, okpos, is_, ins, seg,
+                     cts, ctn, coff, dts, dtn, voff, qts, qtn,
+                     f4s, f4ns, f4off, i4s, i4ns, seg4, gid4, sel1, sel2,
+                     cps, cpns, cpoff, cqs, cqns, cqseg,
+                     mj, kj, lo, hi,
+                     n_projects: int, max_iter1: int, max_iter4: int,
+                     cp16: bool):
+    """ALL SIX RQ device bodies in ONE dispatch returning ONE packed int32
+    buffer — on a tunneled PJRT link each dispatch + fetch costs a ~0.11 s
+    round-trip, so running the suite as six calls pays that six times for
+    kernels that each compute in microseconds.  Shares the same cached CSR
+    lanes and the same bodies as the per-RQ kernels, so results are
+    bit-identical (asserted by bench parity + tests/test_rq_suite.py).
+    Layout: [rq1: it(Q) link(Q) totals(M1) det(M1) | rq3: 3Q |
+    rq4a: Q4+4*M4 | rq2cp: NB | rq2tr (float32 bitcast): P+2KS+S]."""
+    it, li, totals, detected = _rq1_body(
+        fs, fns, foff, oks, okns, okoff, okpos, is_, ins, seg,
+        n_projects, max_iter1)
+    rq3 = _rq3_body(oks, okns, okoff, cts, ctn, coff, dts, dtn, voff,
+                    is_, ins, seg, qts, qtn)
+    rq4a = _rq4a_body(f4s, f4ns, f4off, i4s, i4ns, seg4, gid4, sel1, sel2,
+                      n_projects, max_iter4)
+    cp_pos = segment_searchsorted(cps, cpoff, cqs, cqseg, side="left",
+                                  values_lo=cpns, queries_lo=cqns)
+    cp_lane = _pack_cp_lane(cp_pos, cp16)
+    tr = _rq2tr_body(mj, kj, lo, hi)
+    return jnp.concatenate([
+        it.astype(jnp.int32), li.astype(jnp.int32), totals, detected,
+        rq3.reshape(-1).astype(jnp.int32), rq4a.astype(jnp.int32), cp_lane,
+        jax.lax.bitcast_convert_type(tr, jnp.int32)])
+
+
+def _rq1_post(it, li, totals, detected, min_projects: int) -> RQ1Result:
+    """RQ1 host tail (the >=min_projects filter, rq1:232-239) — shared by
+    the per-RQ call and the fused suite."""
+    totals = np.asarray(totals, dtype=np.int64)
+    detected = np.asarray(detected, dtype=np.int64)
+    keep = totals >= min_projects
+    return RQ1Result(
+        iterations=np.flatnonzero(keep) + 1,
+        total_projects=totals[keep],
+        detected_counts=detected[keep],
+        iteration_of_issue=np.asarray(it, dtype=np.int64),
+        link_idx=np.asarray(li, dtype=np.int64),
+    )
+
+
+def _rq4a_post(g1_tot, g1_det, g2_tot, g2_det,
+               min_projects: int) -> RQ4aTrendResult:
+    """RQ4a host tail (the both-groups >=min_projects filter,
+    rq4a_bug.py:171-179) — shared by the per-RQ call and the fused suite."""
+    valid = (g1_tot >= min_projects) & (g2_tot >= min_projects)
+    keep = np.flatnonzero(valid)
+    return RQ4aTrendResult(
+        iterations=keep + 1,
+        g1_total=g1_tot[keep], g1_detected=g1_det[keep],
+        g2_total=g2_tot[keep], g2_detected=g2_det[keep],
+    )
 
 
 class JaxBackend(Backend):
@@ -417,16 +631,7 @@ class JaxBackend(Backend):
             li = packed[n_issues:2 * n_issues].astype(np.int64)
             totals = packed[2 * n_issues:2 * n_issues + max_iter]
             detected = packed[2 * n_issues + max_iter:]
-        totals = np.asarray(totals, dtype=np.int64)
-        detected = np.asarray(detected, dtype=np.int64)
-        keep = totals >= min_projects
-        return RQ1Result(
-            iterations=np.flatnonzero(keep) + 1,
-            total_projects=totals[keep],
-            detected_counts=detected[keep],
-            iteration_of_issue=it,
-            link_idx=li,
-        )
+        return _rq1_post(it, li, totals, detected, min_projects)
 
     def rq2_change_points(self, arrays: StudyArrays,
                           limit_date_ns: int) -> RQ2ChangePointsResult:
@@ -435,70 +640,54 @@ class JaxBackend(Backend):
         coverage-date arrays — sharded over the boundary axis when a mesh
         is active — and the final float64 gathers stay on host so values
         are bit-exact vs the pandas backend."""
-        covb_t = arrays.covb.columns["time_ns"]
-        ghash = arrays.covb.columns["grouphash"]
-        seg_all = np.repeat(np.arange(arrays.n_projects), arrays.covb.counts())
-        # cov rows are fetched to limit+1 day; restrict the join (and the
-        # project-has-coverage guard) to pre-cutoff rows via a masked CSR
-        # (dates ascend within a segment, so the mask keeps a prefix).
         cache = _study_cache(arrays)
         _touch_limit(cache, limit_date_ns)
-        cov_date_all = arrays.cov.columns["date_ns"]
-        cov_pos, cov_offsets = _host_cov_cut(arrays, cache, limit_date_ns)
-        has_cov = np.diff(cov_offsets) > 0
-        keep = ((covb_t < limit_date_ns) & arrays.covb.columns["ok"]
-                & has_cov[seg_all])
-        rows = np.flatnonzero(keep)
-        if rows.size == 0:
+        bounds = _rq2cp_bounds(arrays, cache, limit_date_ns)
+        if bounds is None:
             e = np.empty(0, np.int64)
             f = np.empty(0, np.float64)
             return RQ2ChangePointsResult(e, e, e, f, f, f, f)
-        seg = seg_all[rows]
-        g = ghash[rows]
-        new_group = np.concatenate(
-            [[True], (g[1:] != g[:-1]) | (seg[1:] != seg[:-1])])
-        start_pos = np.flatnonzero(new_group)
-        starts = rows[start_pos]
-        ends = rows[np.concatenate([start_pos[1:] - 1, [rows.size - 1]])]
-        gseg = seg[start_pos]
-        pair = np.flatnonzero(gseg[:-1] == gseg[1:])
-
-        end_i = ends[pair]
-        start_ip1 = starts[pair + 1]
-        proj = gseg[pair]
-        if end_i.size == 0:
-            e = np.empty(0, np.int64)
-            f = np.empty(0, np.float64)
-            return RQ2ChangePointsResult(e, e, e, f, f, f, f)
-
-        cov_days = cov_date_all[cov_pos]
-        cov_covered = arrays.cov.columns["covered"][cov_pos]
-        cov_total = arrays.cov.columns["total"][cov_pos]
-        q_days = np.concatenate([floor_day_ns(covb_t[end_i]),
-                                 floor_day_ns(covb_t[start_ip1])])
-        q_seg = np.concatenate([proj, proj])
-        qs, qns = ns_to_device_pair(q_days)
         if self._mesh is not None:
-            ds, dns = ns_to_device_pair(cov_days)
-            pos = self._seg_searchsorted(ds, cov_offsets, qs,
-                                         q_seg.astype(np.int32), "left",
-                                         dns, qns)
+            cov_pos, cov_offsets = _host_cov_cut(arrays, cache,
+                                                 limit_date_ns)
+            ds, dns = ns_to_device_pair(
+                arrays.cov.columns["date_ns"][cov_pos])
+            pos = self._seg_searchsorted(ds, cov_offsets, bounds["qs_d"],
+                                         bounds["q_seg"], "left",
+                                         dns, bounds["qns_d"])
         else:
             ds_d, dns_d, covoff_d = _dev_cov_cut(arrays, cache, limit_date_ns)
-            pos = np.asarray(_seg_searchsorted_jit(
-                ds_d, covoff_d, qs, q_seg.astype(np.int32), side="left",
-                values_lo=dns_d, queries_lo=qns))
+            _, cov_off_h = _host_cov_cut(arrays, cache, limit_date_ns)
+            cp16 = bool(np.diff(cov_off_h).max(initial=0) < (1 << 15))
+            nb = bounds["q_seg"].size
+            pos = _unpack_cp_lane(
+                np.asarray(_rq2cp_join_kernel(
+                    ds_d, dns_d, covoff_d, bounds["qs_d"], bounds["qns_d"],
+                    bounds["qseg_d"], cp16=cp16)), nb, cp16)
+        return self._rq2cp_post(arrays, cache, limit_date_ns, bounds, pos)
+
+    def _rq2cp_post(self, arrays: StudyArrays, cache: dict,
+                    limit_date_ns: int, bounds: dict,
+                    pos: np.ndarray) -> RQ2ChangePointsResult:
+        """Host tail of RQ2 change points: gather the joined coverage rows
+        (float64, bit-exact vs pandas) — shared by the per-RQ call and the
+        fused suite."""
+        cov_pos, cov_offsets = _host_cov_cut(arrays, cache, limit_date_ns)
+        cov_days = arrays.cov.columns["date_ns"][cov_pos]
+        cov_covered = arrays.cov.columns["covered"][cov_pos]
+        cov_total = arrays.cov.columns["total"][cov_pos]
+        q_seg, q_days = bounds["q_seg"], bounds["q_days"]
         gidx = cov_offsets[q_seg] + pos
         in_seg = gidx < cov_offsets[q_seg + 1]
         safe = np.clip(gidx, 0, max(cov_pos.size - 1, 0))
         matched = in_seg & (cov_days[safe] == q_days)
         covered = np.where(matched, cov_covered[safe], np.nan)
         total = np.where(matched, cov_total[safe], np.nan)
-        n = end_i.size
+        n = bounds["end_i"].size
         return RQ2ChangePointsResult(
-            project_idx=proj.astype(np.int64),
-            end_i=end_i.astype(np.int64),
-            start_ip1=start_ip1.astype(np.int64),
+            project_idx=bounds["proj"].astype(np.int64),
+            end_i=bounds["end_i"].astype(np.int64),
+            start_ip1=bounds["start_ip1"].astype(np.int64),
             covered_i=covered[:n], total_i=total[:n],
             covered_ip1=covered[n:], total_ip1=total[n:],
         )
@@ -513,8 +702,6 @@ class JaxBackend(Backend):
         oracle.  Same three documented deviations as the pandas backend."""
         P = arrays.n_projects
         issue_t = arrays.issues.columns["time_ns"]
-        n_issues = issue_t.size
-        cutoff_plus1 = limit_date_ns + DAY_NS
         cache = _study_cache(arrays)
         _touch_limit(cache, limit_date_ns)
 
@@ -522,20 +709,13 @@ class JaxBackend(Backend):
         f_pos, f_off = _host_fuzz_ok(arrays, cache, limit_date_ns)
         covb_t = arrays.covb.columns["time_ns"]
         c_pos, c_off = _host_covb_cut(arrays, cache, limit_date_ns)
-        v_pos, v_off = _host_cov_valid(arrays, cache)
-        days = arrays.cov.columns["date_ns"][v_pos]
-        covered = arrays.cov.columns["covered"][v_pos]
-        total = arrays.cov.columns["total"][v_pos]
 
         issue_seg = np.repeat(np.arange(P), arrays.issues.counts())
-        # Projects must have all three inputs (rq3:266).
-        has_all = ((np.diff(f_off) > 0) & (np.diff(c_off) > 0)
-                   & (np.diff(v_off) > 0))
-
-        can_detect = bool(n_issues and f_pos.size and c_pos.size and v_pos.size)
         seg32 = issue_seg.astype(np.int32)
         target = floor_day_ns(issue_t) + DAY_NS
         if self._mesh is not None:
+            v_pos, v_off = _host_cov_valid(arrays, cache)
+            days = arrays.cov.columns["date_ns"][v_pos]
             is_, ins = ns_to_device_pair(issue_t)
             fts, ftn = ns_to_device_pair(fuzz_t[f_pos])
             cts, ctn = ns_to_device_pair(covb_t[c_pos])
@@ -561,6 +741,33 @@ class JaxBackend(Backend):
                 fts_d, ftn_d, foff_d, cts_d, ctn_d, coff_d,
                 dts_d, dtn_d, voff_d, is_d, ins_d, seg_d, qts_d, qtn_d))
             pos_f, pos_c, pos_d = pos3[0], pos3[1], pos3[2]
+        return self._rq3_post(arrays, cache, limit_date_ns,
+                              pos_f, pos_c, pos_d)
+
+    def _rq3_post(self, arrays: StudyArrays, cache: dict, limit_date_ns: int,
+                  pos_f, pos_c, pos_d) -> RQ3Result:
+        """Host tail of RQ3 (the candidate gates of rq3:266-302 + the
+        non-detected day pairs of rq3:246-257) — shared by the per-RQ call
+        and the fused suite.  All float math is float64 on host, bit-exact
+        vs the pandas oracle."""
+        P = arrays.n_projects
+        issue_t = arrays.issues.columns["time_ns"]
+        n_issues = issue_t.size
+        fuzz_t = arrays.fuzz.columns["time_ns"]
+        covb_t = arrays.covb.columns["time_ns"]
+        f_pos, f_off = _host_fuzz_ok(arrays, cache, limit_date_ns)
+        c_pos, c_off = _host_covb_cut(arrays, cache, limit_date_ns)
+        v_pos, v_off = _host_cov_valid(arrays, cache)
+        days = arrays.cov.columns["date_ns"][v_pos]
+        covered = arrays.cov.columns["covered"][v_pos]
+        total = arrays.cov.columns["total"][v_pos]
+        issue_seg = np.repeat(np.arange(P), arrays.issues.counts())
+        target = floor_day_ns(issue_t) + DAY_NS
+        # Projects must have all three inputs (rq3:266).
+        has_all = ((np.diff(f_off) > 0) & (np.diff(c_off) > 0)
+                   & (np.diff(v_off) > 0))
+        can_detect = bool(n_issues and f_pos.size and c_pos.size
+                          and v_pos.size)
 
         if can_detect:
             cand = (has_all[issue_seg] & (pos_f > 0)
@@ -686,13 +893,7 @@ class JaxBackend(Backend):
             g2_tot = packed[q + 2 * max_iter:q + 3 * max_iter].astype(np.int64)
             g2_det = packed[q + 3 * max_iter:].astype(np.int64)
 
-        valid = (g1_tot >= min_projects) & (g2_tot >= min_projects)
-        keep = np.flatnonzero(valid)
-        return RQ4aTrendResult(
-            iterations=keep + 1,
-            g1_total=g1_tot[keep], g1_detected=g1_det[keep],
-            g2_total=g2_tot[keep], g2_detected=g2_det[keep],
-        )
+        return _rq4a_post(g1_tot, g1_det, g2_tot, g2_det, min_projects)
 
     def rq4b_group_trends(self, arrays: StudyArrays, limit_date_ns: int,
                           g1_idx: np.ndarray, g2_idx: np.ndarray,
@@ -703,22 +904,10 @@ class JaxBackend(Backend):
         percentile reductions run as float64 nanpercentile columns — host,
         not device, so win-count comparisons downstream are bit-exact vs the
         pandas oracle (see the float32 note below)."""
-        P = arrays.n_projects
-        cov = arrays.cov
-        coverage = cov.columns["coverage"]
-        sel = ((~np.isnan(coverage)) & (coverage > 0)
-               & (cov.columns["date_ns"] < limit_date_ns))
-        seg_all = np.repeat(np.arange(P), cov.counts())
-        lens = np.bincount(seg_all[sel], minlength=P)
-        S = int(lens.max()) if lens.size else 0
-        matrix = np.full((P, S), np.nan)
-        mask = np.zeros((P, S), dtype=bool)
-        if S:
-            kept_seg = seg_all[sel]
-            pos_in_proj = np.arange(int(sel.sum())) - np.repeat(
-                np.concatenate([[0], np.cumsum(lens)[:-1]]), lens)
-            matrix[kept_seg, pos_in_proj] = coverage[sel]
-            mask[kept_seg, pos_in_proj] = True
+        cache = _study_cache(arrays)
+        _touch_limit(cache, limit_date_ns)
+        matrix, mask = _rq4b_matrix(arrays, cache, limit_date_ns)
+        S = matrix.shape[1]
 
         import warnings
 
@@ -752,31 +941,106 @@ class JaxBackend(Backend):
             g2_percentiles=out["g2"][0], g2_counts=out["g2"][1],
         )
 
+    def rq_suite(self, arrays: StudyArrays, limit_date_ns: int,
+                 min_projects: int, g1_idx: np.ndarray, g2_idx: np.ndarray,
+                 percentiles: tuple = (25, 50, 75)) -> dict:
+        """All six RQs as ONE device dispatch + ONE packed fetch
+        (`_rq_suite_kernel`) — the per-RQ path pays the ~0.11 s tunneled
+        round-trip six times; this pays it once.  RQ4b's host-only float64
+        percentiles run while the device dispatch is in flight.  Falls back
+        to six sequential calls on a mesh or on degenerate shapes (empty
+        study, no grouped projects) where the individual methods' guards
+        apply."""
+        P = arrays.n_projects
+        n_issues = len(arrays.issues)
+        max_iter1 = int(arrays.fuzz.counts().max()) if len(arrays.fuzz) else 0
+        if self._mesh is not None or max_iter1 == 0 or n_issues == 0:
+            return super().rq_suite(arrays, limit_date_ns, min_projects,
+                                    g1_idx, g2_idx, percentiles)
+        cache = _study_cache(arrays)
+        _touch_limit(cache, limit_date_ns)
+        bounds = _rq2cp_bounds(arrays, cache, limit_date_ns)
+        prep2 = _rq2tr_prep(arrays, cache, limit_date_ns)
+        f_pos4, f_off4 = _host_fuzz_cut(arrays, cache, limit_date_ns)
+        counts4 = np.diff(f_off4)
+        in_g = np.zeros(P, dtype=np.int8)
+        in_g[np.asarray(g1_idx, dtype=np.int64)] = 1
+        in_g[np.asarray(g2_idx, dtype=np.int64)] = 2
+        max_iter4 = int(counts4[in_g > 0].max()) if (in_g > 0).any() else 0
+        if bounds is None or prep2["S"] == 0 or max_iter4 == 0:
+            return super().rq_suite(arrays, limit_date_ns, min_projects,
+                                    g1_idx, g2_idx, percentiles)
+        issue_seg = np.repeat(np.arange(P), arrays.issues.counts())
+        qi = np.flatnonzero(in_g[issue_seg] > 0)
+        i4s, i4ns = ns_to_device_pair(arrays.issues.columns["time_ns"][qi])
+        seg4 = issue_seg[qi].astype(np.int32)
+        gid4 = in_g[issue_seg[qi]].astype(np.int32)
+
+        fs_d, fns_d, foff_d = _dev_fuzz(arrays, cache)
+        oks_d, okns_d, okoff_d, okpos_d = _dev_fuzz_ok(arrays, cache,
+                                                       limit_date_ns)
+        is_d, ins_d, seg_d = _dev_issues(arrays, cache)
+        cts_d, ctn_d, coff_d = _dev_covb_cut(arrays, cache, limit_date_ns)
+        dts_d, dtn_d, voff_d = _dev_cov_valid(arrays, cache)
+        qts_d, qtn_d = _dev_rq3_targets(arrays, cache)
+        f4s_d, f4ns_d, f4off_d = _dev_fuzz_cut(arrays, cache, limit_date_ns)
+        ds_d, dns_d, covoff_d = _dev_cov_cut(arrays, cache, limit_date_ns)
+        _, cov_off_h = _host_cov_cut(arrays, cache, limit_date_ns)
+        cp16 = bool(np.diff(cov_off_h).max(initial=0) < (1 << 15))
+        packed_d = _rq_suite_kernel(
+            fs_d, fns_d, foff_d, oks_d, okns_d, okoff_d, okpos_d,
+            is_d, ins_d, seg_d,
+            cts_d, ctn_d, coff_d, dts_d, dtn_d, voff_d, qts_d, qtn_d,
+            f4s_d, f4ns_d, f4off_d, i4s, i4ns, seg4, gid4,
+            (in_g == 1), (in_g == 2),
+            ds_d, dns_d, covoff_d,
+            bounds["qs_d"], bounds["qns_d"], bounds["qseg_d"],
+            *_rq2tr_dev(arrays, cache, limit_date_ns),
+            n_projects=P, max_iter1=max_iter1, max_iter4=max_iter4,
+            cp16=cp16)
+        # The dispatch is async: overlap RQ4b's host-side float64
+        # percentile reductions with the device execution + fetch latency.
+        rq4b = self.rq4b_group_trends(arrays, limit_date_ns, g1_idx, g2_idx,
+                                      percentiles)
+        packed = np.asarray(packed_d)
+
+        q, m1, q4, m4 = n_issues, max_iter1, qi.size, max_iter4
+        nb = bounds["q_seg"].size
+        o = 0
+
+        def take(k):
+            nonlocal o
+            out = packed[o:o + k]
+            o += k
+            return out
+
+        it, li = take(q), take(q)
+        totals, detected = take(m1), take(m1)
+        pos_f, pos_c, pos_d = take(q), take(q), take(q)
+        take(q4)  # rq4a's per-issue iteration lane; unused downstream
+        g1_tot, g1_det = take(m4).astype(np.int64), take(m4).astype(np.int64)
+        g2_tot, g2_det = take(m4).astype(np.int64), take(m4).astype(np.int64)
+        cp_pos = _unpack_cp_lane(take((nb + 1) // 2 if cp16 else nb),
+                                 nb, cp16)
+        tr = packed[o:].view(np.float32)
+        return {
+            "rq1": _rq1_post(it, li, totals, detected, min_projects),
+            "rq2cp": self._rq2cp_post(arrays, cache, limit_date_ns, bounds,
+                                      cp_pos),
+            "rq2tr": self._rq2tr_post(prep2, tr),
+            "rq3": self._rq3_post(arrays, cache, limit_date_ns,
+                                  pos_f, pos_c, pos_d),
+            "rq4a": _rq4a_post(g1_tot, g1_det, g2_tot, g2_det, min_projects),
+            "rq4b": rq4b,
+        }
+
     def rq2_trends(self, arrays: StudyArrays,
                    limit_date_ns: int) -> RQ2TrendsResult:
         P = arrays.n_projects
-        cov = arrays.cov
-        coverage = cov.columns["coverage"]
-        covered = cov.columns["covered"]
-        total = cov.columns["total"]
-        sel = ((~np.isnan(coverage)) & (coverage != 0) & (total != 0)
-               & ~np.isnan(total) & ~np.isnan(covered)
-               & (cov.columns["date_ns"] < limit_date_ns))
-        seg_all = np.repeat(np.arange(P), cov.counts())
-        lens = np.bincount(seg_all[sel], minlength=P)
-        S = int(lens.max()) if lens.size else 0
-        matrix = np.full((P, S), np.nan)
-        mask = np.zeros((P, S), dtype=bool)
-        # dense re-index: position of each kept row within its project
-        if S:
-            kept_seg = seg_all[sel]
-            pos_in_proj = np.arange(sel.sum()) - np.repeat(
-                np.concatenate([[0], np.cumsum(lens)[:-1]]), lens)
-            with np.errstate(invalid="ignore", divide="ignore"):
-                matrix[kept_seg, pos_in_proj] = (
-                    covered[sel] / total[sel] * 100.0)
-            mask[kept_seg, pos_in_proj] = True
-
+        cache = _study_cache(arrays)
+        _touch_limit(cache, limit_date_ns)
+        prep = _rq2tr_prep(arrays, cache, limit_date_ns)
+        matrix, mask, S = prep["matrix"], prep["mask"], prep["S"]
         q = np.array(RQ2TrendsResult.PCTS, dtype=np.float32)
         if S == 0 or P == 0:
             # Empty study (e.g. no eligible projects): zero-width device
@@ -796,30 +1060,30 @@ class JaxBackend(Backend):
                 matrix.T, mask.T, q, self._mesh)
             mean = rq_mesh.mean_by_session_mesh(matrix.T, mask.T, self._mesh)
             counts = rq_mesh.counts_by_project_psum(mask, self._mesh)
-        else:
-            # One fused dispatch; the percentile's float32 index math + lerp
-            # replay on host with the exact op order of the eager
-            # masked_percentile kernel (same scheme as the mesh path), so
-            # single-device, mesh, and eager all agree bit-for-bit.
-            K = q.shape[0]
-            n_valid = mask.sum(axis=0).astype(np.int32)            # [S]
-            pos = (n_valid.astype(np.float32) - np.float32(1.0)) \
-                * q[:, None] / np.float32(100.0)                   # [K, S]
-            lo = np.clip(np.floor(pos).astype(np.int32), 0, P - 1)
-            hi = np.clip(lo + 1, 0, P - 1)
-            frac = pos - lo.astype(np.float32)
-            packed = np.asarray(_rq2_trends_kernel(
-                jnp.asarray(matrix, dtype=jnp.float32), jnp.asarray(mask),
-                lo, hi))
-            spear = packed[:P].astype(np.float64)
-            vlo = packed[P:P + K * S].reshape(K, S)
-            vhi = packed[P + K * S:P + 2 * K * S].reshape(K, S)
-            hi_valid = (lo + 1) <= (n_valid[None, :] - 1)
-            pcts = vlo + np.where(hi_valid, frac * (vhi - vlo),
-                                  np.float32(0.0))
-            pcts = np.where(n_valid[None, :] > 0, pcts,
-                            np.float32(np.nan)).astype(np.float64)
-            mean = packed[P + 2 * K * S:].astype(np.float64)
-            counts = n_valid.astype(np.int64)
+            return RQ2TrendsResult(matrix=matrix, mask=mask, spearman=spear,
+                                   percentiles=pcts, mean=mean, counts=counts)
+        # One fused dispatch over the cached device copies.
+        packed = np.asarray(_rq2_trends_kernel(
+            *_rq2tr_dev(arrays, cache, limit_date_ns)))
+        return self._rq2tr_post(prep, packed)
+
+    def _rq2tr_post(self, prep: dict, packed: np.ndarray) -> RQ2TrendsResult:
+        """Host tail of RQ2 trends: the float32 lerp replays with the exact
+        op order of the eager masked_percentile kernel (same scheme as the
+        mesh path), so single-device, mesh, and eager all agree
+        bit-for-bit.  Shared by the per-RQ call and the fused suite."""
+        matrix, mask = prep["matrix"], prep["mask"]
+        P, S = matrix.shape
+        K = len(RQ2TrendsResult.PCTS)
+        n_valid, lo, frac = prep["n_valid"], prep["lo"], prep["frac"]
+        spear = packed[:P].astype(np.float64)
+        vlo = packed[P:P + K * S].reshape(K, S)
+        vhi = packed[P + K * S:P + 2 * K * S].reshape(K, S)
+        hi_valid = (lo + 1) <= (n_valid[None, :] - 1)
+        pcts = vlo + np.where(hi_valid, frac * (vhi - vlo), np.float32(0.0))
+        pcts = np.where(n_valid[None, :] > 0, pcts,
+                        np.float32(np.nan)).astype(np.float64)
+        mean = packed[P + 2 * K * S:].astype(np.float64)
         return RQ2TrendsResult(matrix=matrix, mask=mask, spearman=spear,
-                               percentiles=pcts, mean=mean, counts=counts)
+                               percentiles=pcts, mean=mean,
+                               counts=n_valid.astype(np.int64))

@@ -150,8 +150,11 @@ def encode_delta(items: np.ndarray, *, max_diffs: int = 16,
     # in the 24-bit-pack case (4 B base ref + 1 B count + nd*(1 B pos +
     # 3 B value) < 3*s B full row).  Without it, small set sizes make the
     # exact-diff verification vacuous and chance sketch collisions would
-    # *grow* the transfer.
-    max_diffs = min(max_diffs, max(1, (3 * s - 6) // 4))
+    # *grow* the transfer.  Sets of <= 3 elements can never break even.
+    break_even = (3 * s - 6) // 4
+    if break_even < 1:
+        return None
+    max_diffs = min(max_diffs, break_even)
     rep_of = None
     if use_native:
         from ..native import group_delta_native

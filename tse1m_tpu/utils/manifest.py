@@ -39,6 +39,14 @@ class RunManifest:
         except Exception:  # jax absent or uninitialised — manifest still valid
             return {}
 
+    def record_backend(self, backend) -> None:
+        """Record a routing backend's learned calibration (backend/auto.py
+        ``calibration()``) so the manifest shows which engine each RQ ran
+        on this machine and why.  No-op for plain engines."""
+        cal = getattr(backend, "calibration", None)
+        if callable(cal):
+            self.record(router_calibration=cal())
+
     def save(self, out_dir: str, timings: dict[str, float] | None = None) -> str:
         os.makedirs(out_dir, exist_ok=True)
         payload = {
