@@ -1,0 +1,23 @@
+"""Build-log metadata fetcher entry point — drop-in replacement for the reference's
+``program/preparation/2_get_buildlog_metadata.py`` (reference :71 main(): page the GCS JSON API for oss-fuzz-gcb-logs, batch CSVs every 10 pages, merge to buildlog_metadata.csv).  The engine lives in
+``tse1m_tpu.collect`` and is driven through ``tse1m_tpu.cli collect``
+with the reference's output layout (``data/processed_data/csv/``,
+repo clone at ``data/collect_data/repos/oss-fuzz``); extra CLI flags
+(e.g. --data-dir, --workers) pass through."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from tse1m_tpu.cli import main as _cli_main  # noqa: E402
+
+
+def main(argv=None):
+    extra = list(sys.argv[1:] if argv is None else argv)
+    return _cli_main(["collect", "gcs-metadata", *extra])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,23 @@
+"""Coverage scraper entry point — drop-in replacement for the reference's
+``program/preparation/3_get_coverage_data.py`` (reference :226 main(): daily per-project coverage reports with per-language parsing, resume-from-last-date, merge to total_coverage.csv).  The engine lives in
+``tse1m_tpu.collect`` and is driven through ``tse1m_tpu.cli collect``
+with the reference's output layout (``data/processed_data/csv/``,
+repo clone at ``data/collect_data/repos/oss-fuzz``); extra CLI flags
+(e.g. --data-dir, --workers) pass through."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from tse1m_tpu.cli import main as _cli_main  # noqa: E402
+
+
+def main(argv=None):
+    extra = list(sys.argv[1:] if argv is None else argv)
+    return _cli_main(["collect", "coverage", *extra])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

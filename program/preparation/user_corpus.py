@@ -1,0 +1,23 @@
+"""Corpus analyzer entry point — drop-in replacement for the reference's
+``program/preparation/user_corpus.py`` (reference analyze_repository :157 / main: per-project seed-corpus introduction times via git log -S + GitHub PR merge times, write project_corpus_analysis.csv).  The engine lives in
+``tse1m_tpu.collect`` and is driven through ``tse1m_tpu.cli collect``
+with the reference's output layout (``data/processed_data/csv/``,
+repo clone at ``data/collect_data/repos/oss-fuzz``); extra CLI flags
+(e.g. --data-dir, --workers) pass through."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+from tse1m_tpu.cli import main as _cli_main  # noqa: E402
+
+
+def main(argv=None):
+    extra = list(sys.argv[1:] if argv is None else argv)
+    return _cli_main(["collect", "corpus", *extra])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,172 @@
+"""SQL-dump restore (db/restore.py + `cli restore`) — the reference's
+`psql ... < backup_clean.sql` bootstrap (README.md:55) for holders of the
+real dump, against either engine."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tse1m_tpu.config import Config
+from tse1m_tpu.db.connection import DB
+from tse1m_tpu.db.restore import restore_sql_dump
+
+# A miniature pg_dump in its default (COPY) format: DDL/SET noise that
+# must be skipped, COPY blocks for three study tables + one unknown
+# table, escapes, NULLs, and array literals.
+_PG_DUMP = r"""--
+-- PostgreSQL database dump
+--
+SET statement_timeout = 0;
+SET client_encoding = 'UTF8';
+CREATE TABLE public.buildlog_data (
+    name text NOT NULL,
+    project text,
+    timecreated timestamp with time zone
+);
+ALTER TABLE public.buildlog_data OWNER TO myuser;
+
+COPY public.project_info (project, first_commit_datetime, language) FROM stdin;
+zlib	2013-01-01 00:00:00	c
+brotli	2014-02-03 10:00:00	c++
+\.
+
+COPY public.buildlog_data (name, project, timecreated, build_type, result, modules, revisions) FROM stdin;
+log-1.txt	zlib	2023-06-01 01:00:00	Fuzzing	Finish	{zlib,libfuzzer}	{abc123,350000}
+log-2.txt	zlib	2023-06-01 13:11:00	Coverage	Finish	{zlib,libfuzzer}	{abc123,350000}
+log-3.txt	brotli	2023-06-02 02:00:00	Fuzzing	Error	\N	\N
+log-4.txt	brotli	2023-06-02 03:00:00	Fuzzing	Finish	{brotli}	{tab\tin\tvalue,1}
+\.
+
+COPY public.issues (project, number, rts, status, crash_type, severity, type, regressed_build, new_id) FROM stdin;
+zlib	1001	2023-06-01 05:00:00	Fixed	Heap-buffer-overflow READ	High	Vulnerability	{zlib-regress-1}	42001001
+brotli	1002	2023-06-02 06:00:00	WontFix	Timeout	Low	Bug	\N	42001002
+\.
+
+COPY public.some_internal_table (a, b) FROM stdin;
+1	2
+\.
+
+COPY public.total_coverage (project, date, coverage, covered_line, total_line) FROM stdin;
+zlib	2023-06-01	45.5	4550	10000
+brotli	2023-06-02	60.25	6025	10000
+\.
+"""
+
+_INSERT_DUMP = """
+SET search_path = public;
+INSERT INTO project_info (project, first_commit_datetime, language)
+    VALUES ('zlib', '2013-01-01 00:00:00', 'c');
+INSERT INTO buildlog_data (name, project, timecreated, build_type, result)
+    VALUES ('log-9.txt', 'zlib', '2023-06-05 01:00:00', 'Fuzzing', 'Finish');
+CREATE INDEX ignored_idx ON buildlog_data(name);
+"""
+
+
+@pytest.fixture()
+def db(tmp_path):
+    cfg = Config(engine="sqlite", sqlite_path=str(tmp_path / "r.sqlite"))
+    conn = DB(config=cfg).connect()
+    yield conn
+    conn.closeConnection()
+
+
+def test_restore_pg_dump_copy_format(db, tmp_path):
+    dump = tmp_path / "backup_clean.sql"
+    dump.write_text(_PG_DUMP)
+    counts = restore_sql_dump(db, str(dump))
+    assert counts["buildlog_data"] == 4
+    assert counts["issues"] == 2
+    assert counts["total_coverage"] == 2
+    assert counts["project_info"] == 2
+    # projects derived from buildlog rows (the table is derived data)
+    assert counts["projects"] == 4
+    # NULL decoding and COPY escapes
+    rows = dict(db.query(
+        "SELECT name, revisions FROM buildlog_data ORDER BY name"))
+    assert rows["log-3.txt"] is None
+    assert rows["log-4.txt"] == "{tab\tin\tvalue,1}"
+    # the unknown table's block was skipped entirely
+    assert db.count("SELECT * FROM issues", ()) == 2
+
+
+def test_restored_dump_feeds_the_analysis_stack(db, tmp_path):
+    """End to end: restore -> columnar extraction -> RQ1 on both engines."""
+    dump = tmp_path / "backup_clean.sql"
+    dump.write_text(_PG_DUMP)
+    restore_sql_dump(db, str(dump))
+    from tse1m_tpu.backend.jax_backend import JaxBackend
+    from tse1m_tpu.backend.pandas_backend import PandasBackend
+    from tse1m_tpu.data.columnar import StudyArrays
+
+    cfg = Config(engine="sqlite", sqlite_path=db.config.sqlite_path,
+                 limit_date="2024-01-01", min_coverage_days=1)
+    arrays = StudyArrays.from_db(db, cfg)
+    limit_ns = int(np.datetime64("2024-01-01", "ns").astype(np.int64))
+    a = PandasBackend().rq1_detection(arrays, limit_ns, 1)
+    b = JaxBackend(mesh=None).rq1_detection(arrays, limit_ns, 1)
+    np.testing.assert_array_equal(a.detected_counts, b.detected_counts)
+
+
+def test_restore_insert_format(db, tmp_path):
+    dump = tmp_path / "inserts.sql"
+    dump.write_text(_INSERT_DUMP)
+    counts = restore_sql_dump(db, str(dump))
+    assert counts["project_info"] == 1
+    assert counts["buildlog_data"] == 1
+    assert counts["skipped_statements"] >= 2  # SET + CREATE INDEX
+    assert db.count("SELECT * FROM buildlog_data", ()) == 1
+
+
+def test_restore_canonicalizes_result_enum(db, tmp_path):
+    """A dump produced by the reference's analyzer carries result='Success'
+    (4_get_buildlog_analysis.py:230-237) where every query filters
+    ('Finish','Halfway') — restore must map it like ingest does."""
+    dump = tmp_path / "legacy.sql"
+    dump.write_text(
+        "COPY public.buildlog_data (name, project, timecreated, build_type,"
+        " result) FROM stdin;\n"
+        "log-a.txt\tzlib\t2023-06-01 01:00:00\tFuzzing\tSuccess\n"
+        "log-b.txt\tzlib\t2023-06-01 02:00:00\tFuzzing\tError\n"
+        "\\.\n")
+    restore_sql_dump(db, str(dump))
+    rows = dict(db.query("SELECT name, result FROM buildlog_data"))
+    assert rows["log-a.txt"] == "Finish"
+    assert rows["log-b.txt"] == "Error"
+
+
+def test_restore_insert_edge_cases(db, tmp_path):
+    """INSERT-format edge cases: multi-row VALUES lists count rows (not
+    statements), literal '%'/'?' in data survive verbatim (execute_raw —
+    no driver interpolation), and a ';' at a line end inside a string
+    literal doesn't split the statement."""
+    dump = tmp_path / "edges.sql"
+    dump.write_text(
+        "INSERT INTO buildlog_data (name, project, timecreated, build_type,"
+        " result) VALUES\n"
+        "  ('log-1.txt', 'zlib', '2023-06-01 01:00:00', 'Fuzzing',"
+        " 'Finish'),\n"
+        "  ('log-2.txt', 'zlib', '2023-06-01 02:00:00', 'Fuzzing',"
+        " 'Finish');\n"
+        "INSERT INTO issues (project, number, rts, status, crash_type)"
+        " VALUES ('zlib', '7', '2023-06-01 05:00:00', 'Fixed',"
+        " 'dropped 5% after fix?;\n"
+        "second line');\n")
+    counts = restore_sql_dump(db, str(dump))
+    assert counts["buildlog_data"] == 2
+    assert counts["issues"] == 1
+    (ct,) = db.query("SELECT crash_type FROM issues")[0]
+    assert ct == "dropped 5% after fix?;\nsecond line"
+
+
+def test_cli_restore(tmp_path):
+    from tse1m_tpu.cli import main
+
+    dump = tmp_path / "backup_clean.sql"
+    dump.write_text(_PG_DUMP)
+    db_path = str(tmp_path / "cli.sqlite")
+    assert main(["restore", str(dump), "--db", db_path]) == 0
+    cfg = Config(engine="sqlite", sqlite_path=db_path)
+    conn = DB(config=cfg).connect()
+    assert conn.count("SELECT * FROM buildlog_data", ()) == 4
+    conn.closeConnection()

@@ -1,0 +1,55 @@
+"""Pinned-value golden regression (tests/goldens/): the frozen-seed synth
+study's six RQ artifact CSVs must reproduce the committed values on BOTH
+engines — the rebuild's analogue of the reference's published-numbers
+oracle (rq1_detection_rate.py:354-412), catching numeric drift that
+test_golden_format.py's shape/format checks cannot."""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+_GEN = os.path.join(os.path.dirname(__file__), "goldens",
+                    "generate_goldens.py")
+spec = importlib.util.spec_from_file_location("generate_goldens", _GEN)
+gen = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(gen)
+
+
+def _compare_csv(got_path: str, want_path: str, rel: str) -> None:
+    got = pd.read_csv(got_path)
+    want = pd.read_csv(want_path)
+    assert list(got.columns) == list(want.columns), rel
+    assert len(got) == len(want), rel
+    for col in want.columns:
+        g, w = got[col], want[col]
+        if pd.api.types.is_float_dtype(w):
+            # The device engine's rq2-trend percentiles sort in float32;
+            # everything else is bit-exact.  2e-5 relative is the same
+            # tolerance bench.py's cross-engine parity gate uses.
+            np.testing.assert_allclose(
+                g.to_numpy(dtype=np.float64), w.to_numpy(dtype=np.float64),
+                rtol=2e-5, atol=2e-5, equal_nan=True,
+                err_msg=f"{rel}:{col}")
+        else:
+            np.testing.assert_array_equal(g.fillna("").to_numpy(),
+                                          w.fillna("").to_numpy(),
+                                          err_msg=f"{rel}:{col}")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("backend", ["pandas", "jax_tpu"])
+def test_frozen_study_reproduces_golden_values(backend, tmp_path):
+    result = str(tmp_path / "result")
+    gen.run_frozen_study(result, backend, str(tmp_path))
+    for rel in gen.FILES:
+        got = os.path.join(result, rel)
+        want = os.path.join(gen.GOLDEN_DIR, rel)
+        assert os.path.exists(got), f"artifact missing: {rel}"
+        assert os.path.exists(want), (
+            f"golden missing: {rel} — run python {_GEN}")
+        _compare_csv(got, want, rel)

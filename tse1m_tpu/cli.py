@@ -99,6 +99,22 @@ def _cmd_ingest(args) -> int:
     return 0
 
 
+def _cmd_restore(args) -> int:
+    """Restore a SQL dump (the reference's `psql ... < backup_clean.sql`
+    bootstrap, README.md:55) into the configured engine — pg_dump COPY
+    blocks or INSERT statements, either dialect (db/restore.py)."""
+    from .db.restore import restore_sql_dump
+
+    cfg = load_config()
+    if args.db:
+        cfg.sqlite_path = args.db
+    db = DB(config=cfg).connect()
+    counts = restore_sql_dump(db, args.dump)
+    db.closeConnection()
+    log.info("restored: %s", counts)
+    return 0
+
+
 def _cmd_rq(args) -> int:
     cfg = load_config()
     if args.db:
@@ -315,6 +331,13 @@ def main(argv=None) -> int:
     p.add_argument("--db", default=None)
     p.add_argument("--csv-dir", required=True)
     p.set_defaults(fn=_cmd_ingest)
+
+    p = sub.add_parser("restore",
+                       help="restore a SQL dump (reference backup_clean.sql "
+                            "workflow) into the configured DB")
+    p.add_argument("dump", help="path to the .sql dump")
+    p.add_argument("--db", default=None)
+    p.set_defaults(fn=_cmd_restore)
 
     for name in ("rq1", "rq2a", "rq2b", "rq3", "rq4a", "rq4b", "all"):
         p = sub.add_parser(name, help=f"run {name} analysis")

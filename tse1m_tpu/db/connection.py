@@ -121,6 +121,16 @@ class DB:
     def execute(self, sql: str, params: Sequence[Any] = ()) -> None:
         self.cursor.execute(self._adapt(sql), tuple(params))
 
+    def execute_raw(self, sql: str) -> int:
+        """Execute one complete statement verbatim — no qmark adaptation,
+        no parameter interpolation.  The restore path needs this: dump
+        statements may carry ``?`` or ``%`` inside string literals, which
+        ``_adapt`` + driver interpolation would corrupt or crash on.
+        Returns the driver-reported affected-row count (0 when unknown)."""
+        self.cursor.execute(sql)
+        n = self.cursor.rowcount
+        return int(n) if n and n > 0 else 0
+
     def query(self, sql: str, params: Sequence[Any] = ()) -> list[tuple]:
         self.cursor.execute(self._adapt(sql), tuple(params))
         return self.cursor.fetchall()

@@ -1,0 +1,160 @@
+"""Restore a SQL dump of the study database.
+
+The reference's canonical DB bootstrap is a pg_dump restored with
+``psql -U user -d dbname < backup_clean.sql`` (reference README.md:55);
+the dump itself is gitignored there (.gitignore:7) and absent from the
+snapshot.  This module gives holders of the real dump a first-class path
+into EITHER engine:
+
+- pg_dump's default format carries data as COPY blocks::
+
+      COPY public.buildlog_data (name, project, ...) FROM stdin;
+      <tab-separated rows, \\N for NULL>
+      \\.
+
+  The restorer applies OUR canonical DDL (db/schema.py — the five-table
+  schema with the Success/Finish enum unified, SURVEY §2.2) and streams
+  each known table's COPY rows in as parameterized inserts.  pg_dump's
+  DDL/SET/ALTER/sequence noise is skipped, so the same dump restores
+  into sqlite and Postgres alike.
+- ``INSERT INTO <study table> ...`` statements (pg_dump --inserts, or a
+  hand-written fixture) execute as-is.
+
+Array columns (modules/revisions/regressed_build) keep their Postgres
+text literal form (``{a,b}``) — exactly what the columnar extraction
+layer parses (data/columnar.py).
+"""
+
+from __future__ import annotations
+
+import re
+
+from ..utils.logging import get_logger
+from .schema import SCHEMA_TABLES, create_schema
+
+log = get_logger("db.restore")
+
+_COPY_RE = re.compile(
+    r"^COPY\s+(?:[\w\"]+\.)?(\w+)\s*\(([^)]*)\)\s+FROM\s+stdin;\s*$",
+    re.IGNORECASE)
+_INSERT_RE = re.compile(r"^INSERT\s+INTO\s+(?:[\w\"]+\.)?(\w+)",
+                        re.IGNORECASE)
+
+# COPY text-format escapes (https://www.postgresql.org/docs/current/
+# sql-copy.html#id-1.9.3.55.9.2) — the ones pg_dump emits.
+_UNESCAPE = {"\\\\": "\\", "\\b": "\b", "\\f": "\f", "\\n": "\n",
+             "\\r": "\r", "\\t": "\t", "\\v": "\v"}
+_ESC_RE = re.compile(r"\\[\\bfnrtv]")
+
+
+def _copy_cell(cell: str):
+    if cell == "\\N":
+        return None
+    if "\\" in cell:
+        cell = _ESC_RE.sub(lambda m: _UNESCAPE[m.group(0)], cell)
+    return cell
+
+
+def _scan_quotes(text: str, in_string: bool) -> bool:
+    """Track single-quote string state across a statement fragment so a
+    ``;`` at a line end inside a text literal (pg_dump emits embedded
+    newlines verbatim) doesn't terminate the statement early.  The SQL
+    ``''`` escape toggles twice — a no-op, as required."""
+    for ch in text:
+        if ch == "'":
+            in_string = not in_string
+    return in_string
+
+
+def restore_sql_dump(db, path: str, create: bool = True,
+                     batch: int = 5000) -> dict:
+    """Load ``path`` (pg_dump or INSERT-style SQL) into ``db``.
+
+    Returns per-table inserted row counts.  Unknown tables and non-data
+    statements are skipped (counted under ``"skipped_statements"``); the
+    ``projects`` table is re-derived from buildlog rows when the dump
+    doesn't carry it (db/ingest.derive_projects — it is derived data).
+    """
+    if create:
+        create_schema(db)
+    counts: dict = {t: 0 for t in SCHEMA_TABLES}
+    skipped = 0
+
+    with open(path, encoding="utf-8") as f:
+        in_copy = None  # (table, insert sql, pending rows)
+        stmt_parts: list = []
+        in_string = False
+        for raw in f:
+            line = raw.rstrip("\n")
+            if in_copy is not None:
+                table, sql, rows = in_copy
+                if line == "\\.":
+                    if rows:
+                        db.executeMany(sql, rows)
+                        counts[table] += len(rows)
+                    in_copy = None
+                    continue
+                if sql is None:
+                    continue  # data of an unknown table — skipped
+                rows.append([_copy_cell(c) for c in line.split("\t")])
+                if len(rows) >= batch:
+                    db.executeMany(sql, rows)
+                    counts[table] += len(rows)
+                    rows.clear()
+                continue
+
+            m = _COPY_RE.match(line)
+            if m:
+                table = m.group(1).lower()
+                cols = [c.strip().strip('"') for c in m.group(2).split(",")]
+                if table in counts:
+                    ph = ", ".join("?" * len(cols))
+                    sql = (f"INSERT INTO {table} ({', '.join(cols)}) "
+                           f"VALUES ({ph})")
+                    in_copy = (table, sql, [])
+                else:
+                    log.info("restore: skipping COPY into unknown table %s",
+                             table)
+                    in_copy = ("__skip__", None, None)
+                    counts.setdefault("__skip__", 0)
+                continue
+
+            # Accumulate ;-terminated statements (quote-aware: a ';' at a
+            # line end inside a string literal doesn't end the statement);
+            # execute only the study tables' INSERTs verbatim, drop
+            # everything else (SET/CREATE/ALTER/...).
+            stmt_parts.append(line)
+            in_string = _scan_quotes(line, in_string)
+            if not in_string and line.rstrip().endswith(";"):
+                stmt = "\n".join(stmt_parts).strip()
+                stmt_parts = []
+                m = _INSERT_RE.match(stmt)
+                if m and m.group(1).lower() in counts:
+                    table = m.group(1).lower()
+                    # rowcount, not statement count: pg_dump --inserts can
+                    # pack many rows per VALUES list.
+                    counts[table] += db.execute_raw(
+                        stmt.rstrip(";").replace(f"public.{table}", table))
+                elif stmt and not stmt.startswith("--"):
+                    skipped += 1
+    # A COPY block for a skipped table collects under "__skip__": drop it.
+    counts.pop("__skip__", None)
+    # Canonicalise the result enum at the door (db/ingest._RESULT_CANON):
+    # a dump produced by the reference's analyzer carries 'Success' where
+    # every analysis query filters ('Finish','Halfway') — left unmapped,
+    # those sessions would silently vanish from every RQ.
+    if counts.get("buildlog_data", 0):
+        from .ingest import _RESULT_CANON
+
+        for src, dst in _RESULT_CANON.items():
+            db.execute("UPDATE buildlog_data SET result = ? "
+                       "WHERE result = ?", (dst, src))
+    if counts.get("projects", 0) == 0 and counts.get("buildlog_data", 0):
+        from .ingest import derive_projects
+
+        derive_projects(db)
+        counts["projects"] = db.count("SELECT * FROM projects", ())
+    db.commit()
+    counts["skipped_statements"] = skipped
+    log.info("restore: %s", counts)
+    return counts
