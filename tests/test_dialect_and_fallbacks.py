@@ -41,19 +41,40 @@ def test_postgres_ddl_differs_where_it_must():
     assert "timestamptz" not in lite.lower()
 
 
-def test_postgres_without_driver_falls_back_to_sqlite(tmp_path):
+def test_postgres_without_driver_falls_back_to_sqlite(tmp_path, monkeypatch):
+    from tse1m_tpu.db import pglib
+
     cfg = Config(engine="postgres",
                  sqlite_path=str(tmp_path / "fallback.sqlite"))
+    # Simulate a box with neither psycopg2 nor libpq: the wrapper must
+    # degrade to sqlite rather than fail at import time (Config keeps the
+    # requested engine; only the resolved dialect changes).
+    monkeypatch.setattr(pglib, "available", lambda: False)
     db = DB(config=cfg)
-    # psycopg2 is absent in this image: the wrapper must degrade to sqlite
-    # rather than fail at import time (Config keeps the requested engine;
-    # only the resolved dialect changes).
     assert db.dialect == "sqlite"
     db.connect()
     db.execute("CREATE TABLE t (x INTEGER)")
     db.execute("INSERT INTO t VALUES (?)", (3,))
     assert db.query("SELECT x FROM t", ()) == [(3,)]
     db.closeConnection()
+
+
+def test_postgres_resolves_to_pglib_without_psycopg2(tmp_path):
+    """With libpq present (this image) and psycopg2 absent, engine=postgres
+    resolves to the ctypes driver instead of silently degrading."""
+    from tse1m_tpu.db import pglib
+
+    try:
+        import psycopg2  # noqa: F401
+
+        pytest.skip("psycopg2 present; resolution prefers it")
+    except ImportError:
+        pass
+    if not pglib.available():
+        pytest.skip("libpq not present")
+    db = DB(config=Config(engine="postgres"))
+    assert db.dialect == "postgres"
+    assert db._pg_driver == "pglib"
 
 
 # -- native decoder degrade ladder -------------------------------------------

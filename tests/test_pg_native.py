@@ -1,0 +1,116 @@
+"""Native Postgres COPY-binary decoder (native/pg_decode.cc) — the
+server-independent half: the stream parser against crafted frames per the
+documented format, and the COPY wrapper SQL builder.  The transport +
+end-to-end parity run under test_postgres_live.py where a server exists."""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+import pytest
+
+from tse1m_tpu.data.columnar import _inline_params, _pg_copy_sql
+from tse1m_tpu.native import parse_copy_binary
+
+PG_EPOCH_NS = 946684800 * 10**9
+
+
+def _stream(rows, ncol):
+    out = b"PGCOPY\n\xff\r\n\x00" + struct.pack(">ii", 0, 0)
+    for row in rows:
+        out += struct.pack(">h", ncol)
+        for cell in row:
+            if cell is None:
+                out += struct.pack(">i", -1)
+            else:
+                out += struct.pack(">i", len(cell)) + cell
+    return out + struct.pack(">h", -1)
+
+
+def _ts(us):
+    return struct.pack(">q", us)
+
+
+def _f8(v):
+    return struct.pack(">d", v)
+
+
+def _d4(days):
+    return struct.pack(">i", days)
+
+
+@pytest.fixture(autouse=True)
+def _need_native():
+    try:
+        out = parse_copy_binary(b"", "p", [])
+    except RuntimeError:
+        return  # module built — the empty stream is rejected, as expected
+    if out is None:  # module didn't build (no g++ etc.)
+        pytest.skip("native pg decoder unavailable")
+
+
+def test_parse_all_spec_chars():
+    rows = [
+        [b"alpha", _ts(1_000_000), _f8(42.5), b"Finish", b"{a,b}",
+         b"log-1.txt", b"123"],
+        [b"beta", _ts(0), None, b"Finish", None, b"log-2.txt", None],
+        [b"alpha", _d4(3), _f8(-1.0), None, b"{c}", None, b"9"],
+    ]
+    proj, t, f, s, c, b, o = parse_copy_binary(
+        _stream(rows, 7), "ptfscbo", ["alpha", "beta"])
+    np.testing.assert_array_equal(proj, [0, 1, 0])
+    assert t[0] == PG_EPOCH_NS + 1_000_000_000
+    assert t[1] == PG_EPOCH_NS
+    assert t[2] == PG_EPOCH_NS + 3 * 86400 * 10**9  # DATE width
+    assert f[0] == 42.5 and np.isnan(f[1]) and f[2] == -1.0
+    assert list(s) == ["Finish", "Finish", None]
+    codes, vocab = c
+    np.testing.assert_array_equal(codes, [0, -1, 1])
+    assert vocab == ["{a,b}", "{c}"]
+    arena, starts, lens = b
+    assert bytes(arena[starts[0]:starts[0] + lens[0]]) == b"log-1.txt"
+    assert lens[2] == -1
+    assert list(o) == ["123", None, "9"]
+
+
+def test_parse_rejects_malformed():
+    good = _stream([[b"alpha"]], 1)
+    cases = [
+        (b"NOTPGCOPY" + good[9:], "signature"),
+        (good[:-2], "trailer"),
+        (_stream([[b"zulu"]], 1), "key value"),
+        (_stream([[b"alpha", b"x"]], 2), "field count"),
+        (_stream([[struct.pack(">h", 1)]], 1), "timestamp width"),
+    ]
+    specs = ["p", "p", "p", "p", "t"]
+    for (data, msg), spec in zip(cases, specs):
+        with pytest.raises(RuntimeError, match=msg):
+            parse_copy_binary(data, spec, ["alpha"])
+
+
+def test_parse_rejects_infinity_timestamp():
+    inf = struct.pack(">q", 2**63 - 1)
+    with pytest.raises(RuntimeError, match="infinity"):
+        parse_copy_binary(_stream([[inf]], 1), "t", [])
+
+
+def test_inline_params():
+    sql = "SELECT * FROM t WHERE a IN (?, ?) AND b < ? AND c = ?"
+    out = _inline_params(sql, ("x", "o'brien", 5, None))
+    assert out == ("SELECT * FROM t WHERE a IN ('x', 'o''brien') "
+                   "AND b < 5 AND c = NULL")
+    with pytest.raises(ValueError):
+        _inline_params("SELECT ?", ("a", "b"))
+
+
+def test_pg_copy_sql_casts_and_aliases():
+    sql = _pg_copy_sql("SELECT project, covered_line FROM t WHERE p = ?",
+                       ("x",), "pf")
+    # positional aliases decouple the wrapper from inner column names;
+    # text-spec'd columns cast ::text, numeric ones stay binary
+    assert 'AS q("c0", "c1")' in sql
+    assert 'q."c0"::text' in sql and 'q."c1"::text' not in sql
+    assert sql.startswith("COPY (SELECT")
+    assert sql.endswith("TO STDOUT (FORMAT binary)")
+    assert "'x'" in sql

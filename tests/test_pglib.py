@@ -1,0 +1,86 @@
+"""Offline units of the ctypes libpq driver (db/pglib.py): placeholder
+rewrite, parameter adaption, OID conversion, array literal round-trip.
+The transport itself needs a live server (test_postgres_live.py)."""
+
+from __future__ import annotations
+
+import datetime as dt
+
+from tse1m_tpu.db import pglib
+
+
+def test_format_to_dollar_basic():
+    assert pglib.format_to_dollar(
+        "SELECT * FROM t WHERE a = %s AND b = %s") == \
+        "SELECT * FROM t WHERE a = $1 AND b = $2"
+
+
+def test_format_to_dollar_skips_literals_and_comments():
+    sql = ("SELECT '%s literal', 'it''s %s' -- trailing %s comment\n"
+           "FROM t WHERE x = %s AND y = '100%%' AND z = %s")
+    out = pglib.format_to_dollar(sql)
+    assert "$1" in out and "$2" in out and "$3" not in out
+    assert "'%s literal'" in out and "'it''s %s'" in out
+    assert "-- trailing %s comment" in out
+
+
+def test_format_to_dollar_percent_escape():
+    # %% outside literals unescapes; inside a literal it stays verbatim
+    assert pglib.format_to_dollar("SELECT %s, 100%%") == "SELECT $1, 100%"
+    assert pglib.format_to_dollar("LIKE 'x' || %s || '%%'") \
+        == "LIKE 'x' || $1 || '%%'"
+
+
+def test_adapt_param():
+    assert pglib.adapt_param(None) is None
+    assert pglib.adapt_param(True) == b"t"
+    assert pglib.adapt_param(False) == b"f"
+    assert pglib.adapt_param(42) == b"42"
+    assert pglib.adapt_param(1.5) == b"1.5"
+    assert pglib.adapt_param("x'y") == b"x'y"
+    assert pglib.adapt_param(dt.datetime(2023, 6, 1, 12, 30)) \
+        == b"2023-06-01T12:30:00"
+    assert pglib.adapt_param(["a", 'b"c', None]) == b'{"a","b\\"c",NULL}'
+
+
+def test_array_literal_roundtrip():
+    items = ["plain", "with,comma", 'with"quote', "with\\back", ""]
+    lit = pglib.compose_array(items)
+    assert pglib.parse_text_array(lit) == items
+    assert pglib.parse_text_array("{}") == []
+    assert pglib.parse_text_array("{a,NULL,c}") == ["a", None, "c"]
+
+
+def test_convert_cell_by_oid():
+    c = pglib.convert_cell
+    assert c(23, "7") == 7 and isinstance(c(20, "9"), int)
+    assert c(701, "1.25") == 1.25
+    assert c(1700, "10.5") == 10.5
+    assert c(16, "t") is True and c(16, "f") is False
+    assert c(25, "text stays") == "text stays"
+    assert c(1082, "2023-06-01") == dt.date(2023, 6, 1)
+    ts = c(1114, "2023-06-01 12:30:45.5")
+    assert ts == dt.datetime(2023, 6, 1, 12, 30, 45, 500000)
+    tstz = c(1184, "2023-06-01 12:30:45+02")
+    assert tstz.utcoffset() == dt.timedelta(hours=2)
+    tstz2 = c(1184, "2023-06-01 12:30:45-05:30")
+    assert tstz2.utcoffset() == -dt.timedelta(hours=5, minutes=30)
+    assert c(1009, '{a,"b,c"}') == ["a", "b,c"]
+
+
+def test_libpq_loads_on_this_image():
+    """The image ships libpq.so.5; the binding must come up (this is what
+    unlocks engine=postgres without psycopg2)."""
+    assert pglib.available()
+
+
+def test_connect_refused_raises_cleanly():
+    """No server on this box: connect must raise pglib.Error promptly (the
+    live-test gate depends on this failing fast, not hanging)."""
+    import pytest
+
+    if not pglib.available():
+        pytest.skip("libpq not present")
+    with pytest.raises(pglib.Error):
+        pglib.connect(database="nope", user="nope", password="nope",
+                      host="127.0.0.1", port=59999)

@@ -10,8 +10,9 @@ server can: ``execute_values`` bulk inserts, driver-native
 datetime/timestamptz rows through ``to_epoch_ns``'s mixed path, and
 ``TEXT[]`` array round-trips through ``parse_array``.
 
-Gating: needs psycopg2 AND a reachable server.  Point ``TSE1M_PG_DSN`` at
-one (libpq keyword form, e.g.
+Gating: needs a Postgres driver (psycopg2, or the ctypes libpq driver
+db/pglib.py — present wherever ``libpq.so.5`` is) AND a reachable server.
+Point ``TSE1M_PG_DSN`` at one (libpq keyword form, e.g.
 ``host=127.0.0.1 port=5432 dbname=replication_db user=replication_user
 password=replication_pass``); with the repo's docker-compose db service up,
 the default matches ``program/envFile.ini``.  Skipped otherwise.
@@ -24,7 +25,15 @@ import os
 import numpy as np
 import pytest
 
-psycopg2 = pytest.importorskip("psycopg2")
+from tse1m_tpu.db import pglib
+
+try:
+    import psycopg2  # noqa: F401
+except ImportError:
+    psycopg2 = None
+    if not pglib.available():
+        pytest.skip("no Postgres driver (psycopg2 or libpq)",
+                    allow_module_level=True)
 
 from tse1m_tpu.backend.pandas_backend import PandasBackend  # noqa: E402
 from tse1m_tpu.config import Config, PostgresConfig  # noqa: E402
@@ -53,16 +62,14 @@ def _pg_config() -> PostgresConfig:
 @pytest.fixture(scope="module")
 def pg_db():
     pg = _pg_config()
+    cfg = Config(engine="postgres", postgres=pg, limit_date="2026-01-01")
     try:
-        probe = psycopg2.connect(database=pg.database, user=pg.user,
-                                 password=pg.password, host=pg.host,
-                                 port=pg.port, connect_timeout=3)
-        probe.close()
+        # Probe through the connection layer itself — whichever driver it
+        # resolved (psycopg2 or the ctypes libpq driver).
+        db = DB(config=cfg).connect()
     except Exception as e:  # no server — the gate, not a failure
         pytest.skip(f"no live Postgres at {pg.host}:{pg.port} ({e}); "
                     "set TSE1M_PG_DSN or `docker compose up db`")
-    cfg = Config(engine="postgres", postgres=pg, limit_date="2026-01-01")
-    db = DB(config=cfg).connect()
     assert db.dialect == "postgres"
     for t in SCHEMA_TABLES:  # idempotent re-runs
         db.execute(f"DROP TABLE IF EXISTS {t} CASCADE")
@@ -122,6 +129,17 @@ def test_columnar_parity_with_sqlite(pg_arrays, sqlite_arrays):
     gb = sqlite_arrays.covb.columns["grouphash"]
     assert ga.shape == gb.shape
     np.testing.assert_array_equal(ga[1:] == ga[:-1], gb[1:] == gb[:-1])
+
+
+def test_native_pg_extraction_carried_the_fetch(pg_arrays):
+    """With a live server and the COPY-binary decoder built, the Postgres
+    extraction must ride the native path (extract_native true in bench
+    terms), not the pandas fallback."""
+    from tse1m_tpu.native import _load_pg
+
+    if _load_pg() is None:
+        pytest.skip("native pg decoder unavailable")
+    assert pg_arrays.native_decode
 
 
 def test_text_array_roundtrip(pg_arrays, sqlite_arrays):

@@ -121,6 +121,55 @@ def _native_db_path(db: DB) -> str | None:
     return path
 
 
+def _native_pg_conninfo(db: DB) -> str | None:
+    """libpq conninfo for the native Postgres COPY-binary decoder
+    (native/pg_decode.cc), or None off-Postgres.  The decoder opens its
+    own connection — same pattern as the sqlite decoder's private
+    read-only handle."""
+    if getattr(db, "dialect", None) != "postgres":
+        return None
+    from ..db import pglib
+
+    pg = db.config.postgres
+    return pglib.conninfo(pg.database, pg.user, pg.password, pg.host,
+                          pg.port)
+
+
+def _inline_params(sql: str, params) -> str:
+    """qmark SQL + params -> literal SQL.  COPY statements cannot take
+    out-of-band parameters, so the native pg path inlines them; values
+    are study-internal strings/numbers (project names, ISO dates) and
+    strings escape by ''-doubling.  The query builders never emit a
+    literal '?' in SQL text, so the split is exact."""
+    parts = sql.split("?")
+    if len(parts) != len(params) + 1:
+        raise ValueError("placeholder/param count mismatch")
+    out = [parts[0]]
+    for p, nxt in zip(params, parts[1:]):
+        if p is None:
+            lit = "NULL"
+        elif isinstance(p, (int, float)):
+            lit = str(p)
+        else:
+            lit = "'" + str(p).replace("'", "''") + "'"
+        out.append(lit)
+        out.append(nxt)
+    return "".join(out)
+
+
+def _pg_copy_sql(sql: str, params, spec: str) -> str:
+    """Wrap a bulk query in COPY ... TO STDOUT (FORMAT binary), aliasing
+    the subquery columns positionally and casting text-spec'd columns
+    ``::text`` so array columns arrive as their Postgres literal form
+    (what parse_array consumes) instead of the binary array layout."""
+    inner = _inline_params(sql, params)
+    alias = ", ".join(f'"c{i}"' for i in range(len(spec)))
+    sel = ", ".join(f'q."c{i}"::text' if sp in "pscubo" else f'q."c{i}"'
+                    for i, sp in enumerate(spec))
+    return (f"COPY (SELECT {sel} FROM ({inner}) AS q({alias})) "
+            "TO STDOUT (FORMAT binary)")
+
+
 class CodedColumn:
     """Dictionary-encoded text column: int32 codes + object vocab.
 
@@ -300,6 +349,21 @@ class StudyArrays:
                     # Strict native parsers reject rather than guess
                     # (timezone suffixes, non-text timestamps, ...).
                     log.info("native decode fell back (%s): %s", k, e)
+                    prefetched[k] = None
+        elif (pg_conninfo := _native_pg_conninfo(db)) is not None:
+            # Postgres: stream each bulk query as COPY binary through the
+            # native decoder (pg_decode.cc) — the reference's real
+            # topology (dbFile.py:26-38) gets the same object-free
+            # extraction the sqlite path has.
+            from ..native import fetch_table_pg
+
+            for k, ((sql, params), _cols, spec) in plan.items():
+                try:
+                    prefetched[k] = fetch_table_pg(
+                        pg_conninfo, _pg_copy_sql(sql, params, spec), spec,
+                        projects)
+                except RuntimeError as e:
+                    log.info("native pg decode fell back (%s): %s", k, e)
                     prefetched[k] = None
 
         def fetch(table):

@@ -65,27 +65,46 @@ class DB:
         self.cursor = None
 
     def _resolve_dialect(self) -> str:
+        self._pg_driver = None
         if self.config.engine == "postgres" or self._legacy_pg:
             try:
                 import psycopg2  # noqa: F401
 
+                self._pg_driver = "psycopg2"
                 return "postgres"
             except ImportError:
-                log.warning("psycopg2 unavailable; falling back to sqlite at %s",
-                            self.config.sqlite_path)
+                pass
+            # psycopg2 missing: drive libpq directly (db/pglib.py) so
+            # `engine = postgres` works wherever the C library exists.
+            from . import pglib
+
+            if pglib.available():
+                self._pg_driver = "pglib"
+                log.info("psycopg2 unavailable; using the ctypes libpq "
+                         "driver (db/pglib.py)")
+                return "postgres"
+            log.warning("psycopg2 and libpq unavailable; falling back to "
+                        "sqlite at %s", self.config.sqlite_path)
         return "sqlite"
 
     # -- lifecycle ---------------------------------------------------------
 
     def connect(self):
         if self.dialect == "postgres":
-            import psycopg2
-
             pg = self.config.postgres
-            self.connection = psycopg2.connect(
-                database=pg.database, user=pg.user, password=pg.password,
-                host=pg.host, port=pg.port,
-            )
+            if self._pg_driver == "pglib":
+                from . import pglib
+
+                self.connection = pglib.connect(
+                    database=pg.database, user=pg.user,
+                    password=pg.password, host=pg.host, port=pg.port)
+            else:
+                import psycopg2
+
+                self.connection = psycopg2.connect(
+                    database=pg.database, user=pg.user, password=pg.password,
+                    host=pg.host, port=pg.port,
+                )
         else:
             path = self.config.sqlite_path
             if path != ":memory:":
@@ -180,7 +199,20 @@ class DB:
         rows = [tuple(r) for r in rows]
         if not rows:
             return
-        if self.dialect == "postgres":
+        if self.dialect == "postgres" and self._pg_driver == "pglib":
+            # execute_values equivalent: one multi-VALUES statement per
+            # page, parameters still out of band.
+            width = len(rows[0])
+            for i in range(0, len(rows), page_size):
+                page = rows[i:i + page_size]
+                tuples = ",".join(
+                    "(" + ",".join("%s" for _ in range(width)) + ")"
+                    for _ in page)
+                flat = [v for r in page for v in r]
+                self.cursor.execute(
+                    self._adapt(sql).replace("VALUES %s",
+                                             f"VALUES {tuples}"), flat)
+        elif self.dialect == "postgres":
             from psycopg2.extras import execute_values
 
             execute_values(self.cursor, self._adapt(sql), rows, page_size=page_size)

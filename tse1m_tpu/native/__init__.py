@@ -30,15 +30,18 @@ _DIR = os.path.dirname(__file__)
 
 
 def _build_and_load(name: str, src: str, so: str, stds: tuple,
-                    link_flags: tuple, fallback_note: str):
+                    link_flags: tuple, fallback_note: str,
+                    deps: tuple = ()):
     """Compile ``src`` -> ``so`` (if stale) and import it.  Returns the
     module or None; never raises — the caller's pure-Python path is the
-    recovery strategy for every failure mode."""
+    recovery strategy for every failure mode.  ``deps`` are additional
+    source files (headers) whose changes must trigger a rebuild."""
     import numpy as np
 
     try:
+        newest = max(os.path.getmtime(p) for p in (src, *deps))
         stale = (not os.path.exists(so)
-                 or os.path.getmtime(so) < os.path.getmtime(src))
+                 or os.path.getmtime(so) < newest)
         if stale:
             # Atomic replace so concurrent first-callers never import a
             # half-written object; the temp file must live on the same
@@ -98,7 +101,8 @@ def _load():
         os.path.join(_DIR, "_tse1m_decode.so"),
         stds=("-std=c++20", "-std=c++17"),
         link_flags=("-l:libsqlite3.so.0",),
-        fallback_note="using pandas path")
+        fallback_note="using pandas path",
+        deps=(os.path.join(_DIR, "columns.h"),))
     return _module
 
 
@@ -120,6 +124,49 @@ def _load_encode():
         stds=("-std=c++17",), link_flags=(),
         fallback_note="using numpy encoder")
     return _enc_module
+
+
+_pg_module = None
+_pg_tried = False
+
+
+def _load_pg():
+    """Postgres COPY-binary decoder (pg_decode.cc); links against
+    libpq.so.5 directly (inline prototypes — this image ships the library
+    without headers)."""
+    global _pg_module, _pg_tried
+    if _pg_tried:
+        return _pg_module
+    _pg_tried = True
+    _pg_module = _build_and_load(
+        "_tse1m_pgdecode", os.path.join(_DIR, "pg_decode.cc"),
+        os.path.join(_DIR, "_tse1m_pgdecode.so"),
+        stds=("-std=c++20", "-std=c++17"),
+        link_flags=("-l:libpq.so.5",),
+        fallback_note="using driver-row path",
+        deps=(os.path.join(_DIR, "columns.h"),))
+    return _pg_module
+
+
+def parse_copy_binary(data: bytes, spec: str, key_values):
+    """Decode a Postgres COPY-binary stream per ``spec`` (decode.cc's spec
+    language), or None when the native path is unavailable."""
+    mod = _load_pg()
+    if mod is None:
+        return None
+    return mod.parse_copy_binary(data, spec, list(key_values))
+
+
+def fetch_table_pg(conninfo: str, copy_sql: str, spec: str, key_values):
+    """Run ``copy_sql`` (a ``COPY ... TO STDOUT (FORMAT binary)``
+    statement) against ``conninfo`` and decode per ``spec``.  Returns a
+    tuple of numpy arrays, or None when the native path is unavailable;
+    raises RuntimeError for streams the strict parsers reject — callers
+    catch and fall back, same ladder as the sqlite decoder."""
+    mod = _load_pg()
+    if mod is None:
+        return None
+    return mod.fetch_table_pg(conninfo, copy_sql, spec, list(key_values))
 
 
 def group_delta_native(items, max_diffs: int, n_probes: int):
