@@ -1,0 +1,75 @@
+"""Subprocess drivers for the SIGKILL chaos tests (test_chaos.py).
+
+Each driver runs a *production* code path (CsvBatchCheckpointer collector
+loop; cluster_sessions_resumable) with checkpoint/resume semantics.  The
+kill comes from the fault plane: the parent test points TSE1M_FAULT_PLAN
+at a plan whose rule is ``kind=kill`` at a checkpoint site, so the
+process SIGKILLs itself mid-write — a real hard kill at a deterministic
+point, with zero test-only branches in the code under test.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def run_csv(args) -> int:
+    """Collector-shaped loop: emit records 0..n-1 through the batch
+    checkpointer, skipping ids already durable in batch files (the
+    processed-id resume pattern), then merge."""
+    from tse1m_tpu.collect.checkpoint import (CsvBatchCheckpointer,
+                                              processed_ids_from_csvs)
+
+    done = processed_ids_from_csvs(args.dir, id_column="id")
+    ck = CsvBatchCheckpointer(args.dir, "chaos", batch_size=args.batch,
+                              fieldnames=["id", "value"])
+    for i in range(args.n):
+        if i in done:
+            continue
+        ck.add({"id": i, "value": f"v{i * i}"})
+    ck.merge(args.final)
+    return 0
+
+
+def run_cluster(args) -> int:
+    """Resumable clustering over a deterministic synthetic study; labels
+    land in ``--out`` as .npy for the parent to compare."""
+    import numpy as np
+
+    from tse1m_tpu.cluster import ClusterParams, cluster_sessions_resumable
+    from tse1m_tpu.data.synth import synth_session_sets
+
+    items = synth_session_sets(args.n, set_size=16, seed=args.seed)[0]
+    params = ClusterParams(n_hashes=32, n_bands=4, use_pallas="never",
+                           h2d_chunks=4)
+    labels = cluster_sessions_resumable(items, params,
+                                        checkpoint_dir=args.dir)
+    np.save(args.out, labels)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("csv")
+    p.add_argument("--dir", required=True)
+    p.add_argument("--final", required=True)
+    p.add_argument("--n", type=int, default=20)
+    p.add_argument("--batch", type=int, default=4)
+    p.set_defaults(fn=run_csv)
+
+    p = sub.add_parser("cluster")
+    p.add_argument("--dir", required=True)
+    p.add_argument("--out", required=True)
+    p.add_argument("--n", type=int, default=2048)
+    p.add_argument("--seed", type=int, default=13)
+    p.set_defaults(fn=run_cluster)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,118 @@
+"""SIGKILL chaos tests (ISSUE satellite): a subprocess running the
+production checkpoint paths is hard-killed mid-write by the fault plane
+(``kind=kill`` at a checkpoint site via TSE1M_FAULT_PLAN), then resumed
+without the plan.  The resumed run must produce byte-identical output to
+an uninterrupted run — including when a shard file was additionally torn
+(truncated) on disk between the kill and the resume.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tse1m_tpu.resilience import FaultPlan, FaultRule
+
+DRIVER = os.path.join(os.path.dirname(__file__), "chaos_drivers.py")
+
+
+def run_driver(args, fault_plan_path=None, expect_kill=False):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("TSE1M_FAULT_PLAN", None)
+    if fault_plan_path:
+        env["TSE1M_FAULT_PLAN"] = fault_plan_path
+    proc = subprocess.run([sys.executable, DRIVER, *args], env=env,
+                          capture_output=True, text=True, timeout=300,
+                          cwd="/root/repo")
+    if expect_kill:
+        assert proc.returncode == -signal.SIGKILL, (
+            f"expected SIGKILL, got rc={proc.returncode}\n"
+            f"stderr: {proc.stderr[-2000:]}")
+    else:
+        assert proc.returncode == 0, proc.stderr[-2000:]
+    return proc
+
+
+def test_csv_checkpointer_sigkill_resume_equals_uninterrupted(tmp_path):
+    # Uninterrupted oracle.
+    clean_dir = str(tmp_path / "clean")
+    clean_final = str(tmp_path / "clean.csv")
+    run_driver(["csv", "--dir", clean_dir, "--final", clean_final])
+
+    # Chaos run: SIGKILL during the 3rd batch write (tmp written, not yet
+    # renamed) — batches 1-2 are durable, batch 3 is a torn tmp file.
+    plan_path = str(tmp_path / "plan.json")
+    FaultPlan([FaultRule(site="checkpoint.csv.flush", kind="kill",
+                         after_calls=2)]).save(plan_path)
+    chaos_dir = str(tmp_path / "chaos")
+    chaos_final = str(tmp_path / "chaos.csv")
+    run_driver(["csv", "--dir", chaos_dir, "--final", chaos_final],
+               fault_plan_path=plan_path, expect_kill=True)
+    assert not os.path.exists(chaos_final)
+    assert len(glob.glob(os.path.join(chaos_dir, "chaos_batch_*.csv"))) == 2
+    assert glob.glob(os.path.join(chaos_dir, "*.tmp"))  # the torn write
+
+    # Resume without the plan: re-emits only non-durable ids, merges.
+    run_driver(["csv", "--dir", chaos_dir, "--final", chaos_final])
+    resumed = pd.read_csv(chaos_final)
+    pd.testing.assert_frame_equal(resumed, pd.read_csv(clean_final))
+    # the torn tmp never leaked into the merge, and cleanup swept it
+    assert not glob.glob(os.path.join(chaos_dir, "*.tmp"))
+
+
+def test_cluster_checkpoint_sigkill_resume_equals_uninterrupted(tmp_path):
+    clean_out = str(tmp_path / "clean.npy")
+    run_driver(["cluster", "--dir", str(tmp_path / "ck_clean"),
+                "--out", clean_out])
+    want = np.load(clean_out)
+
+    plan_path = str(tmp_path / "plan.json")
+    FaultPlan([FaultRule(site="checkpoint.cluster.save", kind="kill",
+                         after_calls=2)]).save(plan_path)
+    ck_dir = str(tmp_path / "ck_chaos")
+    out = str(tmp_path / "chaos.npy")
+    run_driver(["cluster", "--dir", ck_dir, "--out", out],
+               fault_plan_path=plan_path, expect_kill=True)
+    assert not os.path.exists(out)
+    shards = sorted(s for s in glob.glob(os.path.join(ck_dir, "shard_*.npz"))
+                    if not s.endswith(".tmp.npz"))
+    assert len(shards) == 2  # two durable chunks before the kill
+
+    # Torn-shard case: truncate one durable shard on disk (the npz is now
+    # unreadable) — resume must detect it and recompute that chunk too.
+    with open(shards[1], "rb+") as f:
+        f.truncate(os.path.getsize(shards[1]) // 2)
+
+    run_driver(["cluster", "--dir", ck_dir, "--out", out])
+    np.testing.assert_array_equal(np.load(out), want)
+    # successful resume cleaned the checkpoint directory
+    assert not glob.glob(os.path.join(ck_dir, "shard_*"))
+
+
+@pytest.mark.slow
+def test_cluster_sigkill_twice_then_resume(tmp_path):
+    """Two consecutive kills at different chunks, then a clean resume —
+    the accumulated-shards path, closer to a flaky long-run reality."""
+    clean_out = str(tmp_path / "clean.npy")
+    run_driver(["cluster", "--dir", str(tmp_path / "ck_clean"),
+                "--out", clean_out])
+
+    ck_dir = str(tmp_path / "ck")
+    out = str(tmp_path / "out.npy")
+    for after in (1, 2):
+        plan_path = str(tmp_path / f"plan{after}.json")
+        FaultPlan([FaultRule(site="checkpoint.cluster.save", kind="kill",
+                             after_calls=after)]).save(plan_path)
+        run_driver(["cluster", "--dir", ck_dir, "--out", out],
+                   fault_plan_path=plan_path, expect_kill=True)
+    run_driver(["cluster", "--dir", ck_dir, "--out", out])
+    np.testing.assert_array_equal(np.load(out), np.load(clean_out))

@@ -77,6 +77,55 @@ def test_cli_all_runs_every_rq(synth_db, workdir):
 
 
 @pytest.mark.slow
+def test_cli_all_survives_permanent_rq_fault(synth_db, workdir):
+    """ISSUE acceptance: with a permanent fault in one RQ's inputs
+    (corpus CSV missing => rq4a/rq4b raise), `cli all` still runs the
+    remaining RQs, records the failures in run_manifest.json, and exits
+    nonzero."""
+    out = os.path.join(workdir, "results_faulty")
+    proc = run_cli(["all", "--db", synth_db, "--result-dir", out],
+                   cwd="/root/repo",
+                   env_extra={"TSE1M_CORPUS_CSV":
+                              os.path.join(workdir, "nope", "missing.csv")})
+    assert proc.returncode != 0
+    with open(os.path.join(out, "run_manifest.json")) as f:
+        payload = json.load(f)
+    by_name = {s["name"]: s for s in payload["steps"]}
+    assert payload["ok"] is False
+    # the corpus-dependent RQs failed; every other RQ still completed
+    assert by_name["rq4a"]["status"] == "failed"
+    assert by_name["rq4a"]["traceback"]
+    for name in ("rq1", "rq2a", "rq2b", "rq3"):
+        assert by_name[name]["status"] == "ok", by_name[name]
+    assert os.path.exists(
+        os.path.join(out, "rq1", "rq1_detection_rate_stats.csv"))
+
+
+@pytest.mark.slow
+def test_cli_all_under_transient_db_faults_matches_fault_free(synth_db,
+                                                              workdir):
+    """ISSUE acceptance: transient injected failures at the DB seat leave
+    `cli all` output identical to a fault-free run (exit 0, all steps ok)."""
+    plan = os.path.join(workdir, "plan.json")
+    with open(plan, "w") as f:
+        json.dump({"seed": 1, "rules": [
+            {"site": "db.execute", "times": 3},
+            {"site": "db.connect", "times": 1, "kind": "connection_drop",
+             "after_calls": 1},
+        ]}, f)
+    out = os.path.join(workdir, "results_injected")
+    proc = run_cli(["all", "--db", synth_db, "--result-dir", out],
+                   cwd="/root/repo",
+                   env_extra={"TSE1M_FAULT_PLAN": plan,
+                              "TSE1M_RETRY_BASE_DELAY": "0.01"})
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    with open(os.path.join(out, "run_manifest.json")) as f:
+        payload = json.load(f)
+    assert payload["ok"] is True
+    assert all(s["status"] == "ok" for s in payload["steps"])
+
+
+@pytest.mark.slow
 def test_cli_cluster_demo():
     proc = run_cli(["cluster", "--n", "4096", "--ari-sample", "1024"],
                    cwd="/root/repo")

@@ -14,7 +14,11 @@ import jax.numpy as jnp
 import numpy as np
 import pandas as pd
 import pytest
-from hypothesis import given, settings, strategies as st
+
+pytest.importorskip(
+    "hypothesis",
+    reason="property tests need hypothesis (pip install -e .[test])")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from tse1m_tpu.cluster import adjusted_rand_index  # noqa: F401 (env check)
 from tse1m_tpu.collect.buildlogs import _windowed_map

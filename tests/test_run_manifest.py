@@ -1,0 +1,167 @@
+"""Run-to-completion orchestration (resilience/runner.py + cli all):
+one RQ failing no longer aborts the rest, missing steps are recorded
+instead of silently dropped, and the exit code reflects partial failure
+— with every step's status/attempts/traceback in run_manifest.json.
+"""
+
+from __future__ import annotations
+
+import importlib
+import json
+import os
+import sys
+import types
+
+import pytest
+
+from tse1m_tpu.resilience import RetryPolicy, StepRunner
+
+
+# -- StepRunner unit ----------------------------------------------------------
+
+def _read(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def test_step_runner_records_ok_and_failed(tmp_path):
+    man = str(tmp_path / "m.json")
+    r = StepRunner(man)
+    r.run("good", lambda: 42)
+    r.run("bad", lambda: 1 / 0)
+    r.record_missing("ghost", "module not importable")
+    payload = _read(man)
+    assert payload["ok"] is False
+    assert payload["summary"] == {"ok": 1, "failed": 1, "missing": 1}
+    by_name = {s["name"]: s for s in payload["steps"]}
+    assert by_name["good"]["status"] == "ok"
+    assert by_name["good"]["attempts"] == 1
+    assert by_name["bad"]["status"] == "failed"
+    assert "ZeroDivisionError" in by_name["bad"]["error"]
+    assert "1 / 0" in by_name["bad"]["traceback"]
+    assert by_name["ghost"]["status"] == "missing"
+    assert r.exit_code() == 1
+
+
+def test_step_runner_all_ok_exit_zero(tmp_path):
+    man = str(tmp_path / "m.json")
+    r = StepRunner(man)
+    r.run("a", lambda: None)
+    r.run("b", lambda: None)
+    assert r.exit_code() == 0
+    assert _read(man)["ok"] is True
+
+
+def test_step_runner_manifest_written_after_every_step(tmp_path):
+    """A kill mid-run must leave an accurate partial record."""
+    man = str(tmp_path / "m.json")
+    r = StepRunner(man)
+    r.run("first", lambda: None)
+    midway = _read(man)
+    assert [s["name"] for s in midway["steps"]] == ["first"]
+    r.run("second", lambda: None)
+    assert [s["name"] for s in _read(man)["steps"]] == ["first", "second"]
+
+
+def test_step_runner_retries_when_policy_allows(tmp_path):
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+
+    r = StepRunner(str(tmp_path / "m.json"),
+                   policy=RetryPolicy(max_attempts=5, base_delay=0))
+    rec = r.run("flaky", flaky)
+    assert rec.status == "ok"
+    assert rec.attempts == 3
+    assert r.exit_code() == 0
+
+
+def test_step_runner_empty_run_is_a_failure(tmp_path):
+    assert StepRunner(str(tmp_path / "m.json")).exit_code() == 1
+
+
+# -- cli all ------------------------------------------------------------------
+
+RQ_SPECS = {
+    "tse1m_tpu.analysis.rq1": "run_rq1",
+    "tse1m_tpu.analysis.rq2_changepoints": "run_rq2_changepoints",
+    "tse1m_tpu.analysis.rq2_trends": "run_rq2_trends",
+    "tse1m_tpu.analysis.rq3": "run_rq3",
+    "tse1m_tpu.analysis.rq4a": "run_rq4a",
+    "tse1m_tpu.analysis.rq4b": "run_rq4b",
+}
+
+
+@pytest.fixture
+def stub_rqs(monkeypatch):
+    """Replace every RQ module with a stub that drops a marker file; rq3
+    raises (permanent fault), rq4a is unimportable (missing module)."""
+    real_import = importlib.import_module
+
+    def make_module(mod_name, fn_name):
+        mod = types.ModuleType(mod_name)
+
+        def run(cfg, _name=mod_name):
+            short = _name.rsplit(".", 1)[1]
+            if short == "rq3":
+                raise RuntimeError("permanent rq fault")
+            os.makedirs(cfg.result_dir, exist_ok=True)
+            with open(os.path.join(cfg.result_dir, short + ".ran"), "w"):
+                pass
+
+        setattr(mod, fn_name, run)
+        return mod
+
+    def fake_import(name, *a, **kw):
+        if name == "tse1m_tpu.analysis.rq4a":
+            raise ModuleNotFoundError(f"No module named {name!r}", name=name)
+        if name in RQ_SPECS:
+            return make_module(name, RQ_SPECS[name])
+        return real_import(name, *a, **kw)
+
+    monkeypatch.setattr(importlib, "import_module", fake_import)
+    return fake_import
+
+
+def test_cli_all_runs_survivors_and_reports_failures(tmp_path, stub_rqs,
+                                                     monkeypatch):
+    from tse1m_tpu import cli
+
+    out = str(tmp_path / "results")
+    monkeypatch.setenv("TSE1M_RESULT_DIR", out)
+    rc = cli.main(["all"])
+    assert rc == 1  # rq3 failed, rq4a missing
+    # survivors all completed
+    for short in ("rq1", "rq2_changepoints", "rq2_trends", "rq4b"):
+        assert os.path.exists(os.path.join(out, short + ".ran")), short
+    payload = _read(os.path.join(out, "run_manifest.json"))
+    by_name = {s["name"]: s for s in payload["steps"]}
+    assert set(by_name) == {"rq1", "rq2a", "rq2b", "rq3", "rq4a", "rq4b"}
+    assert by_name["rq3"]["status"] == "failed"
+    assert "permanent rq fault" in by_name["rq3"]["error"]
+    assert "permanent rq fault" in by_name["rq3"]["traceback"]
+    assert by_name["rq4a"]["status"] == "missing"
+    assert all(by_name[k]["status"] == "ok"
+               for k in ("rq1", "rq2a", "rq2b", "rq4b"))
+
+
+def test_cli_single_rq_failure_is_nonzero_and_recorded(tmp_path, stub_rqs,
+                                                       monkeypatch):
+    from tse1m_tpu import cli
+
+    out = str(tmp_path / "results")
+    monkeypatch.setenv("TSE1M_RESULT_DIR", out)
+    assert cli.main(["rq3"]) == 1
+    payload = _read(os.path.join(out, "run_manifest.json"))
+    assert payload["steps"][0]["status"] == "failed"
+    assert cli.main(["rq1"]) == 0
+
+
+def test_cli_missing_single_rq_exits_nonzero(tmp_path, stub_rqs, monkeypatch):
+    from tse1m_tpu import cli
+
+    monkeypatch.setenv("TSE1M_RESULT_DIR", str(tmp_path / "r"))
+    assert cli.main(["rq4a"]) == 1

@@ -116,6 +116,13 @@ def _cmd_restore(args) -> int:
 
 
 def _cmd_rq(args) -> int:
+    """Run one RQ — or, under ``all``, run every RQ to completion.
+
+    Each step runs isolated (resilience/runner.py): one RQ blowing up no
+    longer aborts the remaining five, a missing module is recorded (it
+    previously vanished with exit 0), every step's status/attempts/
+    traceback lands in ``<result_dir>/run_manifest.json``, and the exit
+    code is nonzero iff any requested step failed or was missing."""
     cfg = load_config()
     if args.db:
         cfg.sqlite_path = args.db
@@ -124,8 +131,10 @@ def _cmd_rq(args) -> int:
     if args.result_dir:
         cfg.result_dir = args.result_dir
     import importlib
+    import os
 
-    runners = {}
+    from .resilience import StepRunner
+
     specs = {
         "rq1": ("tse1m_tpu.analysis.rq1", "run_rq1"),
         "rq2a": ("tse1m_tpu.analysis.rq2_changepoints", "run_rq2_changepoints"),
@@ -135,26 +144,29 @@ def _cmd_rq(args) -> int:
         "rq4b": ("tse1m_tpu.analysis.rq4b", "run_rq4b"),
     }
     wanted = list(specs) if args.cmd == "all" else [args.cmd]
-    missing = []
+    manifest_path = os.path.join(cfg.result_dir, "run_manifest.json")
+    runner = StepRunner(manifest_path)
     for name in wanted:
         mod_name, fn_name = specs[name]
         try:
-            runners[name] = getattr(importlib.import_module(mod_name), fn_name)
+            fn = getattr(importlib.import_module(mod_name), fn_name)
         except ModuleNotFoundError as e:
             if e.name == mod_name:
-                missing.append(name)
-                log.warning("%s is not implemented yet (%s missing)", name, mod_name)
-            else:
-                raise  # a real dependency failure inside the module — surface it
-    if not runners:
-        log.error("nothing to run: %s not implemented", ", ".join(missing))
-        return 1
-    if missing and args.cmd != "all":
-        return 1
-    for name, fn in runners.items():
+                log.warning("%s is not implemented yet (%s missing)",
+                            name, mod_name)
+                runner.record_missing(name, f"{mod_name} not importable")
+                continue
+            raise  # a real dependency failure inside the module — surface it
         log.info("=== %s (backend=%s) ===", name, cfg.backend)
-        fn(cfg)
-    return 0
+        runner.run(name, fn, cfg)
+    if runner.failed:
+        log.error("run finished with failures: %s (manifest: %s)",
+                  ", ".join(f"{s.name}[{s.status}]" for s in runner.failed),
+                  manifest_path)
+    else:
+        log.info("all %d step(s) ok (manifest: %s)", len(runner.steps),
+                 manifest_path)
+    return runner.exit_code()
 
 
 def _cmd_collect(args) -> int:

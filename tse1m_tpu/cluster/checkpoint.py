@@ -26,6 +26,7 @@ import os
 
 import numpy as np
 
+from ..resilience import fault_point, io_retry_policy, retry_call
 from ..utils.logging import get_logger
 
 log = get_logger("cluster.checkpoint")
@@ -113,23 +114,58 @@ class ClusterCheckpoint:
         return os.path.join(self.directory, f"shard_{index:05d}.npz")
 
     def chunk_done(self, index: int) -> bool:
-        return index in self.done and os.path.exists(self._shard_path(index))
+        return index in self.done and self._shard_ok(index)
+
+    def _shard_ok(self, index: int) -> bool:
+        """True when the shard file exists AND loads — a torn/truncated
+        npz on disk (partial copy, filesystem loss after rename) must
+        read as 'not done' so resume recomputes it instead of crashing
+        or silently clustering garbage."""
+        path = self._shard_path(index)
+        if not os.path.exists(path):
+            return False
+        try:
+            with np.load(path) as z:
+                return "sig" in z.files and "keys" in z.files
+        except Exception as e:
+            log.warning("shard %s unreadable (%s); will recompute", path, e)
+            return False
 
     def save_chunk(self, index: int, sig: np.ndarray,
                    keys: np.ndarray) -> None:
         """Persist one chunk's shard atomically (tmp + rename), then mark
         it done in the manifest — a crash mid-write leaves the chunk
-        'not done' and it recomputes on resume."""
+        'not done' and it recomputes on resume.  The write itself runs
+        under the shared retry engine: a transient I/O failure (or an
+        injected torn write) rewrites the tmp file from scratch."""
         path = self._shard_path(index)
         tmp = path + ".tmp.npz"
-        np.savez(tmp, sig=sig, keys=keys)
-        os.replace(tmp, path)
+
+        def write_shard() -> None:
+            np.savez(tmp, sig=sig, keys=keys)
+            fault_point("checkpoint.cluster.save", path=tmp)
+            os.replace(tmp, path)
+
+        retry_call(write_shard, policy=io_retry_policy(),
+                   site="checkpoint.cluster.save")
         self.done.add(index)
         self._write_manifest()
 
     def load_chunk(self, index: int) -> tuple[np.ndarray, np.ndarray]:
         with np.load(self._shard_path(index)) as z:
             return z["sig"], z["keys"]
+
+    def load_chunk_or_none(self, index: int):
+        """(sig, keys) or None when the shard is missing/torn — the
+        pipeline's resume path falls back to recomputing the chunk."""
+        try:
+            with np.load(self._shard_path(index)) as z:
+                return z["sig"], z["keys"]
+        except Exception as e:
+            log.warning("shard %d unreadable at load (%s); recomputing",
+                        index, e)
+            self.done.discard(index)
+            return None
 
     def cleanup(self) -> None:
         """Remove shards + manifest after a completed run — including any

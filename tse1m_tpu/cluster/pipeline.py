@@ -379,9 +379,14 @@ def cluster_sessions_resumable(items, params: ClusterParams | None = None,
         ckpt = ClusterCheckpoint(checkpoint_dir, items, params, step)
         parts = []
         for idx, i in enumerate(range(0, n, step)):
-            if ckpt.chunk_done(idx):
-                sig_h, keys_h = ckpt.load_chunk(idx)
-                parts.append((jax.device_put(sig_h), jax.device_put(keys_h)))
+            # A shard that exists but is torn (truncated npz) reads as
+            # not-done and the chunk recomputes — resume must produce the
+            # same labels as an uninterrupted run, never crash on it.
+            shard = (ckpt.load_chunk_or_none(idx)
+                     if ckpt.chunk_done(idx) else None)
+            if shard is not None:
+                parts.append((jax.device_put(shard[0]),
+                              jax.device_put(shard[1])))
                 continue
             sig, keys = minhash_and_keys(_put_chunk(items[i:i + step], pack),
                                          a, b, params.n_bands, **kw)
@@ -417,9 +422,11 @@ def cluster_sessions_resumable(items, params: ClusterParams | None = None,
     parts = []
     chunks_d: list = [None] * n_full_chunks
     for idx, i in enumerate(range(0, full.shape[0], step)):
-        if ckpt.chunk_done(idx):
-            sig_h, keys_h = ckpt.load_chunk(idx)
-            parts.append((jax.device_put(sig_h), jax.device_put(keys_h)))
+        shard = (ckpt.load_chunk_or_none(idx)
+                 if ckpt.chunk_done(idx) else None)
+        if shard is not None:
+            parts.append((jax.device_put(shard[0]),
+                          jax.device_put(shard[1])))
             continue
         cd = _put_chunk(full[i:i + step], pack)
         chunks_d[idx] = cd
@@ -427,9 +434,9 @@ def cluster_sessions_resumable(items, params: ClusterParams | None = None,
         ckpt.save_chunk(idx, np.asarray(sig), np.asarray(keys))
         parts.append((sig, keys))
     didx = n_full_chunks
-    if ckpt.chunk_done(didx):
-        dsig_h, dkeys_h = ckpt.load_chunk(didx)
-        dpart = (jax.device_put(dsig_h), jax.device_put(dkeys_h))
+    dshard = ckpt.load_chunk_or_none(didx) if ckpt.chunk_done(didx) else None
+    if dshard is not None:
+        dpart = (jax.device_put(dshard[0]), jax.device_put(dshard[1]))
     else:
         # Delta decode needs the full lane device-resident; chunks whose
         # shards were loaded from disk never shipped their rows this run,

@@ -32,8 +32,9 @@ from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
+
+from ..utils.compat import shard_map
 
 from .lsh import bucket_representatives, estimated_jaccard, propagate_labels
 from .minhash import band_keys, minhash_signatures

@@ -23,6 +23,7 @@ from datetime import date, timedelta
 
 import pandas as pd
 
+from ..resilience import fault_point, io_retry_policy, retry_call
 from ..utils.logging import get_logger
 
 log = get_logger("collect.checkpoint")
@@ -64,10 +65,23 @@ class CsvBatchCheckpointer:
                             f"{self.prefix}_batch_{self._next_index}.csv")
         fields = self.fieldnames or sorted(
             {k for r in self._pending for k in r})
-        with open(path, "w", newline="", encoding="utf-8") as f:
-            w = csv.DictWriter(f, fieldnames=fields, extrasaction="ignore")
-            w.writeheader()
-            w.writerows(self._pending)
+
+        def write_batch() -> None:
+            # tmp + rename: a crash (or injected tear) mid-write can never
+            # surface as a silently short batch file — merge() and resume
+            # only ever see complete batches.  A retried attempt rewrites
+            # the tmp file from the start, so a torn write self-heals.
+            tmp = path + ".tmp"
+            with open(tmp, "w", newline="", encoding="utf-8") as f:
+                w = csv.DictWriter(f, fieldnames=fields,
+                                   extrasaction="ignore")
+                w.writeheader()
+                w.writerows(self._pending)
+            fault_point("checkpoint.csv.flush", path=tmp)
+            os.replace(tmp, path)
+
+        retry_call(write_batch, policy=io_retry_policy(),
+                   site="checkpoint.csv.flush")
         log.info("checkpointed %d records to %s", len(self._pending), path)
         self._pending.clear()
         self._next_index += 1
@@ -97,6 +111,11 @@ class CsvBatchCheckpointer:
                  len(merged), len(files), final_path)
         if cleanup:
             for path in files:
+                os.remove(path)
+            # Orphaned tmp files from a crash mid-flush (the torn write
+            # that atomic rename made invisible) still occupy disk.
+            for path in glob.glob(os.path.join(
+                    self.directory, f"{self.prefix}_batch_*.csv.tmp")):
                 os.remove(path)
         return len(merged)
 

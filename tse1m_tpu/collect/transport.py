@@ -10,6 +10,7 @@ collector runs against a directory-backed fake in tests (no network).
 
 from __future__ import annotations
 
+import email.utils
 import json
 import os
 import threading
@@ -18,6 +19,7 @@ import urllib.parse
 from dataclasses import dataclass
 from typing import Protocol
 
+from ..resilience import RetryError, RetryPolicy, fault_point, retry_call
 from ..utils.logging import get_logger
 
 log = get_logger("collect.transport")
@@ -29,12 +31,15 @@ class FetchPolicy:
 
     retries: int = 3
     backoff_factor: float = 0.5
-    retry_statuses: tuple = (500, 502, 503, 504)
+    retry_statuses: tuple = (429, 500, 502, 503, 504)
     timeout: float = 10.0
     # Fixed sleep between *successive* requests — the reference sleeps 0.5 s
     # per coverage page (3_get_coverage_data.py:135) and 5 s per GCS page
     # (2_get_buildlog_metadata.py:100,152).
     politeness_delay: float = 0.0
+    # Wall-clock budget over ALL attempts for one get() — also the cap on
+    # any server-sent Retry-After hint.  None = attempts-bounded only.
+    deadline: float | None = None
 
 
 @dataclass
@@ -59,7 +64,46 @@ class Fetcher(Protocol):
 
 
 class FetchError(RuntimeError):
-    """A request failed after exhausting the retry budget."""
+    """A request failed after exhausting the retry budget.
+
+    ``retry_after`` (seconds, optional) carries a server ``Retry-After``
+    hint for 429/503 responses; the shared retry engine raises its next
+    backoff to at least that, capped by the policy deadline."""
+
+    def __init__(self, message: str, retry_after: float | None = None):
+        super().__init__(message)
+        self.retry_after = retry_after
+
+
+def parse_retry_after(value) -> float | None:
+    """``Retry-After`` header -> seconds (int form or HTTP-date form),
+    None when absent/unparseable.  Negative values clamp to 0."""
+    if value is None:
+        return None
+    s = str(value).strip()
+    if not s:
+        return None
+    try:
+        return max(0.0, float(s))
+    except ValueError:
+        pass
+    try:
+        dt = email.utils.parsedate_to_datetime(s)
+    except (TypeError, ValueError):
+        return None
+    if dt is None:
+        return None
+    import datetime as _dt
+
+    now = _dt.datetime.now(_dt.timezone.utc)
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=_dt.timezone.utc)
+    return max(0.0, (dt - now).total_seconds())
+
+
+# Without a policy deadline, a server-sent Retry-After still cannot stall
+# a collector indefinitely.
+_RETRY_AFTER_CAP = 60.0
 
 
 def _with_params(url: str, params: dict | None) -> str:
@@ -101,30 +145,42 @@ class HttpFetcher:
 
     def get(self, url: str, params: dict | None = None) -> Response | None:
         p = self.policy
-        last_err: Exception | None = None
-        for attempt in range(p.retries + 1):
+
+        def attempt() -> Response | None:
+            fault_point("http.fetch")
             self._politeness_pause()
+            r = self.session.get(url, params=params, timeout=p.timeout)
+            if r.status_code == 404:
+                return None
+            if r.status_code in p.retry_statuses:
+                # 429/503 servers often say when to come back; honor it,
+                # capped at the policy deadline (or a sane bound).
+                hint = parse_retry_after(
+                    getattr(r, "headers", {}).get("Retry-After"))
+                if hint is not None:
+                    hint = min(hint, p.deadline if p.deadline is not None
+                               else _RETRY_AFTER_CAP)
+                raise FetchError(f"HTTP {r.status_code} for {url}",
+                                 retry_after=hint)
             try:
-                r = self.session.get(url, params=params, timeout=p.timeout)
-            except Exception as e:  # connection/timeout errors
-                last_err = e
-                log.warning("fetch error (%s) attempt %d/%d: %s",
-                            url, attempt + 1, p.retries + 1, e)
-            else:
-                if r.status_code == 404:
-                    return None
-                if r.status_code in p.retry_statuses:
-                    last_err = FetchError(f"HTTP {r.status_code} for {url}")
-                    log.warning("retryable HTTP %d (%s) attempt %d/%d",
-                                r.status_code, url, attempt + 1, p.retries + 1)
-                else:
-                    r.raise_for_status()
-                    return Response(url=url, status=r.status_code,
-                                    content=r.content)
-            if attempt < p.retries:
-                time.sleep(p.backoff_factor * (2 ** attempt))
-        raise FetchError(f"giving up on {url} after {p.retries + 1} attempts"
-                         ) from last_err
+                r.raise_for_status()
+            except Exception as e:
+                e.no_retry = True  # hard 4xx: retrying cannot help
+                raise
+            return Response(url=url, status=r.status_code, content=r.content)
+
+        try:
+            return retry_call(
+                attempt,
+                policy=RetryPolicy(max_attempts=p.retries + 1,
+                                   base_delay=p.backoff_factor,
+                                   deadline=p.deadline),
+                site=f"http.fetch {url}",
+                should_retry=lambda e: not getattr(e, "no_retry", False))
+        except RetryError as e:
+            raise FetchError(
+                f"giving up on {url} after {e.attempts} attempts"
+            ) from e.__cause__
 
 
 class DirFetcher:

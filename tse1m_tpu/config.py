@@ -63,6 +63,18 @@ class Config:
     days_threshold: int = 7
     # Test-mode subset switch (rq1_detection_rate.py:20,155-158,233).
     test_mode: bool = False
+    # -- resilience (resilience/) -----------------------------------------
+    # Path to a FaultPlan JSON; also honored cross-process via
+    # TSE1M_FAULT_PLAN (resilience/faults.py reads the env directly so
+    # config-less seats like the checkpointers see the same plan).
+    fault_plan: str | None = None
+    # Shared retry engine knobs for DB statements/connects.
+    db_retry_attempts: int = 4
+    db_retry_base_delay: float = 0.1
+    db_retry_max_delay: float = 5.0
+    # Per-statement timeout: Postgres `SET statement_timeout`, sqlite
+    # busy_timeout.  0 = engine default (off).
+    db_statement_timeout_ms: int = 0
 
     @property
     def result_ok(self) -> tuple[str, ...]:
@@ -99,6 +111,15 @@ def load_config(ini_path: str | None = None) -> Config:
             cfg.result_dir = fw.get("result_dir", cfg.result_dir)
             cfg.corpus_csv = fw.get("corpus_csv", cfg.corpus_csv)
             cfg.test_mode = fw.getboolean("test_mode", cfg.test_mode)
+            cfg.fault_plan = fw.get("fault_plan", cfg.fault_plan)
+            cfg.db_retry_attempts = fw.getint("db_retry_attempts",
+                                              cfg.db_retry_attempts)
+            cfg.db_retry_base_delay = fw.getfloat("db_retry_base_delay",
+                                                  cfg.db_retry_base_delay)
+            cfg.db_retry_max_delay = fw.getfloat("db_retry_max_delay",
+                                                 cfg.db_retry_max_delay)
+            cfg.db_statement_timeout_ms = fw.getint(
+                "db_statement_timeout_ms", cfg.db_statement_timeout_ms)
 
     cfg.backend = os.environ.get("TSE1M_BACKEND", cfg.backend)
     cfg.engine = os.environ.get("TSE1M_ENGINE", cfg.engine)
@@ -107,6 +128,12 @@ def load_config(ini_path: str | None = None) -> Config:
     cfg.result_dir = os.environ.get("TSE1M_RESULT_DIR", cfg.result_dir)
     if "TSE1M_TEST_MODE" in os.environ:
         cfg.test_mode = os.environ["TSE1M_TEST_MODE"].lower() in ("1", "true", "yes")
+    cfg.fault_plan = os.environ.get("TSE1M_FAULT_PLAN", cfg.fault_plan)
+    if "TSE1M_DB_RETRY_ATTEMPTS" in os.environ:
+        cfg.db_retry_attempts = int(os.environ["TSE1M_DB_RETRY_ATTEMPTS"])
+    if "TSE1M_DB_STATEMENT_TIMEOUT_MS" in os.environ:
+        cfg.db_statement_timeout_ms = int(
+            os.environ["TSE1M_DB_STATEMENT_TIMEOUT_MS"])
     if cfg.backend not in ("pandas", "jax_tpu", "auto"):
         raise ValueError(f"unknown backend {cfg.backend!r}; expected "
                          "'pandas', 'jax_tpu' or 'auto'")

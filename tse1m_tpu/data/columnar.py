@@ -52,6 +52,10 @@ def to_epoch_ns(values) -> np.ndarray:
             # (OSS-Fuzz GCB/issue-tracker times), so interpreting naive
             # rows as UTC is exact, not a guess.
             ts = pd.to_datetime(ser, format="mixed", utc=True)
+    if not pd.api.types.is_datetime64_any_dtype(ts):
+        # Older pandas returns object dtype for mixed naive/aware rows
+        # (with a FutureWarning) instead of raising — same UTC coercion.
+        ts = pd.to_datetime(ser, format="mixed", utc=True)
     if getattr(ts.dt, "tz", None) is not None:
         ts = ts.dt.tz_convert("UTC").dt.tz_localize(None)
     return ts.to_numpy().astype("datetime64[ns]").astype(np.int64)

@@ -17,14 +17,59 @@ from __future__ import annotations
 import os
 import re
 import sqlite3
-from typing import Any, Iterable, Sequence
+from typing import Any, Callable, Iterable, Sequence
 
 from ..config import Config, load_config
+from ..resilience import fault_point, io_retry_policy, retry_call
 from ..utils.logging import get_logger
 
 log = get_logger("db")
 
 _QMARK_RE = re.compile(r"\?")
+
+# Message markers that mean "the server connection is gone" across
+# psycopg2, libpq (db/pglib.py), and sqlite — reconnect-class failures.
+_DISCONNECT_MARKERS = (
+    "server closed the connection", "connection already closed",
+    "terminating connection", "connection reset", "could not connect",
+    "connection refused", "connection timed out", "broken pipe",
+    "ssl connection has been closed", "no connection to the server",
+)
+
+# sqlite-side transient failures: retry on the SAME connection.
+_SQLITE_TRANSIENT_MARKERS = ("database is locked", "disk i/o error",
+                             "database table is locked")
+
+
+def is_disconnect(e: BaseException) -> bool:
+    """True when the exception means the connection itself died (the next
+    attempt needs a fresh connection, not just a re-execute)."""
+    if isinstance(e, ConnectionError):  # incl. InjectedConnectionDrop
+        return True
+    from . import pglib
+
+    if isinstance(e, pglib.OperationalError):
+        return True
+    mod = type(e).__module__ or ""
+    if mod.startswith("psycopg2") and type(e).__name__ in (
+            "OperationalError", "InterfaceError"):
+        return True
+    if isinstance(e, sqlite3.ProgrammingError):
+        return "closed" in str(e).lower()
+    return any(m in str(e).lower() for m in _DISCONNECT_MARKERS)
+
+
+def is_transient(e: BaseException) -> bool:
+    """The retry allowlist for DB statements: dropped connections,
+    lock/busy contention, and injected faults.  SQL/programming errors
+    (syntax, missing table, constraint) surface immediately."""
+    from ..resilience import InjectedFault
+
+    if is_disconnect(e) or isinstance(e, InjectedFault):
+        return True
+    if isinstance(e, (sqlite3.OperationalError, sqlite3.DatabaseError)):
+        return any(m in str(e).lower() for m in _SQLITE_TRANSIENT_MARKERS)
+    return False
 
 
 class DB:
@@ -63,6 +108,11 @@ class DB:
         self.dialect = self._resolve_dialect()
         self.connection = None
         self.cursor = None
+        c = self.config
+        self._retry_policy = io_retry_policy(
+            max_attempts=max(1, c.db_retry_attempts),
+            base_delay=c.db_retry_base_delay,
+            max_delay=c.db_retry_max_delay)
 
     def _resolve_dialect(self) -> str:
         self._pg_driver = None
@@ -90,6 +140,13 @@ class DB:
     # -- lifecycle ---------------------------------------------------------
 
     def connect(self):
+        retry_call(self._connect_once, policy=self._retry_policy,
+                   site="db.connect", should_retry=is_transient)
+        return self
+
+    def _connect_once(self) -> None:
+        fault_point("db.connect")
+        timeout_ms = self.config.db_statement_timeout_ms
         if self.dialect == "postgres":
             pg = self.config.postgres
             if self._pg_driver == "pglib":
@@ -105,15 +162,62 @@ class DB:
                     database=pg.database, user=pg.user, password=pg.password,
                     host=pg.host, port=pg.port,
                 )
+            self.cursor = self.connection.cursor()
+            if timeout_ms > 0:
+                # A hung statement must fail (and be retried/surfaced),
+                # not stall a collector for hours.
+                self.cursor.execute(
+                    f"SET statement_timeout = {int(timeout_ms)}")
         else:
             path = self.config.sqlite_path
             if path != ":memory:":
                 os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-            self.connection = sqlite3.connect(path)
+            self.connection = sqlite3.connect(
+                path, timeout=(timeout_ms / 1000.0) if timeout_ms > 0
+                else 5.0)
             self.connection.execute("PRAGMA journal_mode=WAL")
             self.connection.execute("PRAGMA synchronous=NORMAL")
-        self.cursor = self.connection.cursor()
-        return self
+            if timeout_ms > 0:
+                self.connection.execute(
+                    f"PRAGMA busy_timeout={int(timeout_ms)}")
+            self.cursor = self.connection.cursor()
+
+    def _reconnect(self) -> None:
+        """Drop the (possibly dead) connection and open a fresh one —
+        the recovery hook the retry engine runs after a disconnect."""
+        log.warning("db: reconnecting after dropped connection")
+        try:
+            self.closeConnection()
+        except Exception:
+            self.cursor = self.connection = None
+        self._connect_once()
+
+    def _statement(self, op: Callable, site: str = "db.execute"):
+        """Run ``op()`` (a closure over ``self.cursor``) under the shared
+        retry engine.  Transient faults re-execute on the same connection;
+        disconnect-class failures reconnect first.  Each op here is one
+        autocommit-scoped unit, so the retry is idempotent from the DB's
+        view unless the server committed *and* dropped before replying —
+        the standard at-least-once caveat.
+        """
+
+        def attempt():
+            fault_point(site)
+            if self.connection is None or self.cursor is None:
+                self._connect_once()
+            return op()
+
+        def recover(exc: BaseException, _attempt: int) -> None:
+            if is_disconnect(exc):
+                self._reconnect()
+            else:
+                try:  # clear any aborted-transaction state before re-trying
+                    self.connection.rollback()
+                except Exception:
+                    pass
+
+        return retry_call(attempt, policy=self._retry_policy, site=site,
+                          should_retry=is_transient, on_retry=recover)
 
     def closeConnection(self) -> None:
         if self.cursor is not None:
@@ -138,7 +242,8 @@ class DB:
         return sql
 
     def execute(self, sql: str, params: Sequence[Any] = ()) -> None:
-        self.cursor.execute(self._adapt(sql), tuple(params))
+        self._statement(
+            lambda: self.cursor.execute(self._adapt(sql), tuple(params)))
 
     def execute_raw(self, sql: str) -> int:
         """Execute one complete statement verbatim — no qmark adaptation,
@@ -146,13 +251,20 @@ class DB:
         statements may carry ``?`` or ``%`` inside string literals, which
         ``_adapt`` + driver interpolation would corrupt or crash on.
         Returns the driver-reported affected-row count (0 when unknown)."""
-        self.cursor.execute(sql)
-        n = self.cursor.rowcount
-        return int(n) if n and n > 0 else 0
+
+        def op() -> int:
+            self.cursor.execute(sql)
+            n = self.cursor.rowcount
+            return int(n) if n and n > 0 else 0
+
+        return self._statement(op)
 
     def query(self, sql: str, params: Sequence[Any] = ()) -> list[tuple]:
-        self.cursor.execute(self._adapt(sql), tuple(params))
-        return self.cursor.fetchall()
+        def op() -> list[tuple]:
+            self.cursor.execute(self._adapt(sql), tuple(params))
+            return self.cursor.fetchall()
+
+        return self._statement(op)
 
     def count(self, sql: str, params: Sequence[Any] = ()) -> int:
         """Row count of an arbitrary query without shipping its rows —
@@ -181,15 +293,24 @@ class DB:
     def executeQuery(self, type: str, sql: str, params: Sequence[Any] = ()):
         """``type`` is 'select' (returns rows) or anything else (DML+commit),
         mirroring dbFile.py's select/insert/update switch."""
-        self.cursor.execute(self._adapt(sql), tuple(params))
-        if type == "select":
-            return self.cursor.fetchall()
-        self.connection.commit()
-        return None
+
+        def op():
+            self.cursor.execute(self._adapt(sql), tuple(params))
+            if type == "select":
+                return self.cursor.fetchall()
+            self.connection.commit()
+            return None
+
+        return self._statement(op)
 
     def executeMany(self, sql: str, rows: Iterable[Sequence[Any]]) -> None:
-        self.cursor.executemany(self._adapt(sql), [tuple(r) for r in rows])
-        self.connection.commit()
+        rows = [tuple(r) for r in rows]
+
+        def op() -> None:
+            self.cursor.executemany(self._adapt(sql), rows)
+            self.connection.commit()
+
+        self._statement(op)
 
     def executeValues(self, sql: str, rows: Iterable[Sequence[Any]], page_size: int = 1000) -> None:
         """Bulk insert.  Postgres uses psycopg2.extras.execute_values
@@ -199,25 +320,34 @@ class DB:
         rows = [tuple(r) for r in rows]
         if not rows:
             return
-        if self.dialect == "postgres" and self._pg_driver == "pglib":
-            # execute_values equivalent: one multi-VALUES statement per
-            # page, parameters still out of band.
-            width = len(rows[0])
-            for i in range(0, len(rows), page_size):
-                page = rows[i:i + page_size]
-                tuples = ",".join(
-                    "(" + ",".join("%s" for _ in range(width)) + ")"
-                    for _ in page)
-                flat = [v for r in page for v in r]
-                self.cursor.execute(
-                    self._adapt(sql).replace("VALUES %s",
-                                             f"VALUES {tuples}"), flat)
-        elif self.dialect == "postgres":
-            from psycopg2.extras import execute_values
 
-            execute_values(self.cursor, self._adapt(sql), rows, page_size=page_size)
-        else:
-            width = len(rows[0])
-            placeholders = "(" + ",".join("?" * width) + ")"
-            self.cursor.executemany(sql.replace("VALUES ?", f"VALUES {placeholders}"), rows)
-        self.connection.commit()
+        def op() -> None:
+            # The whole page set is one commit scope, so a retried attempt
+            # (after rollback/reconnect) re-inserts from the start instead
+            # of double-applying a committed prefix.
+            if self.dialect == "postgres" and self._pg_driver == "pglib":
+                # execute_values equivalent: one multi-VALUES statement per
+                # page, parameters still out of band.
+                width = len(rows[0])
+                for i in range(0, len(rows), page_size):
+                    page = rows[i:i + page_size]
+                    tuples = ",".join(
+                        "(" + ",".join("%s" for _ in range(width)) + ")"
+                        for _ in page)
+                    flat = [v for r in page for v in r]
+                    self.cursor.execute(
+                        self._adapt(sql).replace("VALUES %s",
+                                                 f"VALUES {tuples}"), flat)
+            elif self.dialect == "postgres":
+                from psycopg2.extras import execute_values
+
+                execute_values(self.cursor, self._adapt(sql), rows,
+                               page_size=page_size)
+            else:
+                width = len(rows[0])
+                placeholders = "(" + ",".join("?" * width) + ")"
+                self.cursor.executemany(
+                    sql.replace("VALUES ?", f"VALUES {placeholders}"), rows)
+            self.connection.commit()
+
+        self._statement(op)

@@ -220,6 +220,28 @@ class Error(Exception):
     pass
 
 
+class OperationalError(Error):
+    """Connection-level failure (server gone, network drop) — the
+    reconnect-class error ``db/connection.py``'s retry engine looks for
+    (psycopg2 raises its own OperationalError for the same states)."""
+
+
+# libpq error strings that mean the connection itself died.
+_CONN_DEAD_MARKERS = (
+    "server closed the connection", "terminating connection",
+    "connection to server", "no connection to the server",
+    "could not receive data", "could not send data", "connection reset",
+    "ssl connection has been closed",
+)
+
+
+def _classify(message: str) -> type[Error]:
+    low = message.lower()
+    if any(m in low for m in _CONN_DEAD_MARKERS):
+        return OperationalError
+    return Error
+
+
 class Cursor:
     def __init__(self, conn: "Connection"):
         self._conn = conn
@@ -228,6 +250,10 @@ class Cursor:
         self.rowcount = -1
 
     def execute(self, sql: str, params: Sequence[Any] | None = None):
+        from ..resilience import fault_point
+
+        fault_point("pglib.exec")
+        self._conn._check_alive()
         self._conn._begin()
         res = self._conn._exec_params(sql, params or ())
         lib = _libpq()
@@ -254,7 +280,8 @@ class Cursor:
                 t = lib.PQcmdTuples(res)
                 self.rowcount = int(t) if t else -1
             else:
-                raise Error(lib.PQresultErrorMessage(res).decode().strip())
+                msg = lib.PQresultErrorMessage(res).decode().strip()
+                raise _classify(msg)(msg)
         finally:
             lib.PQclear(res)
         return self
@@ -288,6 +315,20 @@ class Connection:
         self._pg = pgconn
         self._in_txn = False
 
+    @property
+    def closed(self) -> bool:
+        """True when the underlying libpq connection is gone or in a bad
+        state (PQstatus != CONNECTION_OK) — psycopg2's ``closed`` shape."""
+        if self._pg is None:
+            return True
+        return _libpq().PQstatus(self._pg) != _CONNECTION_OK
+
+    def _check_alive(self) -> None:
+        if self._pg is None:
+            raise OperationalError("connection already closed")
+        if _libpq().PQstatus(self._pg) != _CONNECTION_OK:
+            raise OperationalError("no connection to the server")
+
     def _begin(self) -> None:
         if not self._in_txn:
             self._command("BEGIN")
@@ -296,10 +337,15 @@ class Connection:
     def _command(self, sql: str) -> None:
         lib = _libpq()
         res = lib.PQexec(self._pg, sql.encode())
+        if not res:  # libpq returns NULL when the connection dropped
+            raise OperationalError(
+                lib.PQerrorMessage(self._pg).decode().strip()
+                or "no connection to the server")
         try:
             if lib.PQresultStatus(res) not in (_PGRES_COMMAND_OK,
                                                _PGRES_TUPLES_OK):
-                raise Error(lib.PQresultErrorMessage(res).decode().strip())
+                msg = lib.PQresultErrorMessage(res).decode().strip()
+                raise _classify(msg)(msg)
         finally:
             lib.PQclear(res)
 
@@ -311,7 +357,9 @@ class Connection:
         res = lib.PQexecParams(self._pg, format_to_dollar(sql).encode(),
                                n, None, values, None, None, 0)
         if not res:
-            raise Error(lib.PQerrorMessage(self._pg).decode().strip())
+            msg = lib.PQerrorMessage(self._pg).decode().strip()
+            raise (_classify(msg) if msg else OperationalError)(
+                msg or "no connection to the server")
         return res
 
     def cursor(self) -> Cursor:

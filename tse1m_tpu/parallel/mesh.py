@@ -14,8 +14,9 @@ from functools import lru_cache, partial
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..utils.compat import shard_map
 
 
 def make_mesh(n_devices: int | None = None, axis: str = "data") -> Mesh:

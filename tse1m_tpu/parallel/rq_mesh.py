@@ -29,8 +29,9 @@ from functools import lru_cache, partial
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..utils.compat import enable_x64, shard_map
 
 from ..ops.segment import masked_mean, masked_spearman, segment_searchsorted
 from .mesh import make_mesh
@@ -53,7 +54,7 @@ def _device_f64_exact(device) -> bool:
     key = getattr(device, "platform", str(device))
     if key not in _F64_EXACT:
         canary = np.array([1.0 + 2.0 ** -50, np.pi, 1e300], dtype=np.float64)
-        with jax.enable_x64(True):
+        with enable_x64(True):
             back = np.asarray(jax.device_get(jax.device_put(canary, device)))
         _F64_EXACT[key] = bool(np.array_equal(canary, back))
     return _F64_EXACT[key]
@@ -362,7 +363,7 @@ def nanpercentile_by_session_mesh(sub: np.ndarray, q, mesh: Mesh) -> np.ndarray:
     n_dev = mesh.devices.size
     cols = _pad_rows(np.ascontiguousarray(sub.T), n_dev, np.nan)  # [S', G]
 
-    with jax.enable_x64(True):
+    with enable_x64(True):
         kernel = _nanpercentile_mesh_kernel(mesh, tuple(qf.tolist()), g)
         vlo, vhi, n = kernel(_placed(mesh, cols.astype(np.float64),
                                      P(AXIS, None)))

@@ -1,0 +1,59 @@
+"""Unified resilience layer: one retry engine, one fault-injection plane,
+one run-to-completion orchestrator (SURVEY §5 A3/A4).
+
+The source study survived years of flaky external services — GCS
+pagination, daily coverage servers, a Selenium-scraped tracker — across
+~1.19M build logs.  The rebuild previously had robustness *seats*
+(transport retries, checkpoint resume) but no code path ever exercised
+them under an actual failure.  This package makes recovery a tested
+property:
+
+- ``retry.retry_call`` / ``RetryPolicy``: exponential backoff + full
+  jitter + deadline + exception allowlist; honors server ``Retry-After``
+  hints carried on exceptions.  Used by the HTTP transport, both DB
+  drivers, and both checkpointers.
+- ``faults.fault_point`` / ``FaultPlan``: a deterministic, seeded fault
+  injector.  Production I/O seats call ``fault_point("site")``; with no
+  plan installed (the default) that is a no-op, so prod code carries zero
+  test-only branches.  A plan (JSON via ``TSE1M_FAULT_PLAN``, or
+  installed in-process) makes the *production* path raise, delay, drop
+  connections, tear writes, or SIGKILL the process at chosen sites.
+- ``runner.StepRunner``: run-to-completion orchestration for ``cli all``
+  — each step isolated, per-step status/attempts/traceback recorded in
+  ``run_manifest.json``, survivors complete, exit code reflects partial
+  failure.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .faults import (FaultPlan, FaultRule, InjectedConnectionDrop,
+                     InjectedFault, active_plan, clear_plan, fault_point,
+                     install_plan)
+from .retry import RetryError, RetryPolicy, retry_call
+from .runner import StepRunner
+
+__all__ = [
+    "FaultPlan", "FaultRule", "InjectedConnectionDrop", "InjectedFault",
+    "RetryError", "RetryPolicy", "StepRunner", "active_plan", "clear_plan",
+    "fault_point", "install_plan", "io_retry_policy", "retry_call",
+]
+
+
+def io_retry_policy(**overrides) -> RetryPolicy:
+    """The default policy for local-I/O seats (checkpoint writes, DB
+    statements): a few fast attempts, bounded backoff.  Env-tunable so an
+    operator can harden a flaky NFS mount without code changes:
+    ``TSE1M_RETRY_ATTEMPTS``, ``TSE1M_RETRY_BASE_DELAY``,
+    ``TSE1M_RETRY_MAX_DELAY``, ``TSE1M_RETRY_DEADLINE``.
+    """
+    kw = dict(
+        max_attempts=int(os.environ.get("TSE1M_RETRY_ATTEMPTS", 4)),
+        base_delay=float(os.environ.get("TSE1M_RETRY_BASE_DELAY", 0.05)),
+        max_delay=float(os.environ.get("TSE1M_RETRY_MAX_DELAY", 2.0)),
+    )
+    if "TSE1M_RETRY_DEADLINE" in os.environ:
+        kw["deadline"] = float(os.environ["TSE1M_RETRY_DEADLINE"])
+    kw.update(overrides)
+    return RetryPolicy(**kw)

@@ -1,0 +1,213 @@
+"""Deterministic, seeded fault-injection plane.
+
+Production I/O seats call ``fault_point("dotted.site", path=...)``.  With
+no plan active — the production default — that is a dict lookup and a
+return; there are no test-only branches in prod code.  Tests (or an
+operator running a game-day) activate a :class:`FaultPlan` either
+in-process (``install_plan`` / ``FaultPlan.active()``) or across process
+boundaries via ``TSE1M_FAULT_PLAN=<plan.json>``, and the *production*
+code paths then run under injected failures.
+
+Instrumented sites (grep for ``fault_point(`` to audit):
+
+- ``http.fetch``                 one HTTP request attempt (transport.py)
+- ``db.connect`` / ``db.execute``  connection wrapper (db/connection.py)
+- ``pglib.exec``                 raw libpq statement (db/pglib.py)
+- ``checkpoint.csv.flush``       collector batch write (collect/checkpoint.py)
+- ``checkpoint.cluster.save``    cluster shard write (cluster/checkpoint.py)
+
+Fault kinds:
+
+- ``raise``:  raise :class:`InjectedFault` (or a named exception class)
+- ``connection_drop``: raise :class:`InjectedConnectionDrop` (a
+  ``ConnectionError`` subclass, so generic disconnect classifiers fire)
+- ``delay``:  sleep ``delay_s`` seconds, then pass through
+- ``torn_write``: truncate the file at the seat's ``path`` to
+  ``truncate_fraction`` of its bytes, then raise — a crash mid-write
+- ``kill``:   ``SIGKILL`` the current process — the chaos-test hammer
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+import os
+import random
+import signal
+import time
+from dataclasses import asdict, dataclass, field
+
+from ..utils.logging import get_logger
+
+log = get_logger("resilience.faults")
+
+
+class InjectedFault(Exception):
+    """A transient failure injected by the fault plane."""
+
+
+class InjectedConnectionDrop(ConnectionError, InjectedFault):
+    """An injected dropped connection (classified like a real one)."""
+
+
+_KINDS = ("raise", "connection_drop", "delay", "torn_write", "kill")
+
+
+@dataclass
+class FaultRule:
+    """One per-site rule.  ``site`` is an fnmatch pattern against the seat
+    name; the rule fires for the matching calls numbered
+    ``[after_calls, after_calls + times)`` (per-rule counter), each time
+    with probability ``probability`` drawn from the plan's seeded RNG."""
+
+    site: str
+    kind: str = "raise"
+    times: int = 1                 # how many calls fire; -1 = every call
+    after_calls: int = 0           # skip this many matching calls first
+    probability: float = 1.0       # per-eligible-call chance (seeded RNG)
+    message: str = "injected fault"
+    delay_s: float = 0.05          # kind=delay
+    truncate_fraction: float = 0.5  # kind=torn_write
+    _seen: int = field(default=0, repr=False, compare=False)
+    _fired: int = field(default=0, repr=False, compare=False)
+
+    def __post_init__(self) -> None:
+        if self.kind not in _KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}; "
+                             f"expected one of {_KINDS}")
+
+
+class FaultPlan:
+    """An ordered set of :class:`FaultRule`\\ s plus a seeded RNG.
+
+    The first matching, still-eligible rule fires per call.  ``fired`` is
+    the observable log of (site, kind) events for test assertions."""
+
+    def __init__(self, rules: list[FaultRule], seed: int = 0):
+        self.rules = list(rules)
+        self.rng = random.Random(seed)
+        self.seed = seed
+        self.fired: list[tuple[str, str]] = []
+
+    # -- (de)serialization --------------------------------------------------
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FaultPlan":
+        rules = [FaultRule(**r) for r in d.get("rules", [])]
+        return cls(rules, seed=int(d.get("seed", 0)))
+
+    @classmethod
+    def from_json(cls, path: str) -> "FaultPlan":
+        with open(path, encoding="utf-8") as f:
+            return cls.from_dict(json.load(f))
+
+    def to_dict(self) -> dict:
+        rules = []
+        for r in self.rules:
+            d = asdict(r)
+            d.pop("_seen"), d.pop("_fired")
+            rules.append(d)
+        return {"seed": self.seed, "rules": rules}
+
+    def save(self, path: str) -> str:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(self.to_dict(), f, indent=2)
+        return path
+
+    # -- firing -------------------------------------------------------------
+
+    def fire(self, site: str, path: str | None = None) -> None:
+        for rule in self.rules:
+            if not fnmatch.fnmatch(site, rule.site):
+                continue
+            rule._seen += 1
+            if rule._seen <= rule.after_calls:
+                continue
+            if rule.times >= 0 and rule._fired >= rule.times:
+                continue
+            if rule.probability < 1.0 and self.rng.random() >= rule.probability:
+                continue
+            rule._fired += 1
+            self.fired.append((site, rule.kind))
+            log.warning("fault plane: %s at %s (fire %d)", rule.kind, site,
+                        rule._fired)
+            self._apply(rule, site, path)
+            return  # at most one rule fires per call
+
+    def _apply(self, rule: FaultRule, site: str, path: str | None) -> None:
+        if rule.kind == "delay":
+            time.sleep(rule.delay_s)
+            return
+        if rule.kind == "kill":
+            os.kill(os.getpid(), signal.SIGKILL)
+        if rule.kind == "torn_write" and path and os.path.exists(path):
+            size = os.path.getsize(path)
+            keep = int(size * rule.truncate_fraction)
+            with open(path, "rb+") as f:
+                f.truncate(keep)
+            log.warning("fault plane: tore %s to %d/%d bytes", path, keep,
+                        size)
+        if rule.kind == "connection_drop":
+            raise InjectedConnectionDrop(f"{rule.message} at {site}")
+        raise InjectedFault(f"{rule.message} at {site}")
+
+    # -- context-manager installation ---------------------------------------
+
+    def active(self) -> "_Activation":
+        """``with plan.active(): ...`` installs the plan in-process."""
+        return _Activation(self)
+
+
+class _Activation:
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+
+    def __enter__(self) -> FaultPlan:
+        install_plan(self.plan)
+        return self.plan
+
+    def __exit__(self, *exc) -> None:
+        clear_plan()
+
+
+# -- process-global plan ------------------------------------------------------
+
+_plan: FaultPlan | None = None
+_env_loaded = False
+
+
+def install_plan(plan: FaultPlan) -> None:
+    global _plan, _env_loaded
+    _plan = plan
+    _env_loaded = True  # an explicit install wins over the env plan
+
+
+def clear_plan() -> None:
+    global _plan, _env_loaded
+    _plan = None
+    _env_loaded = True
+
+
+def active_plan() -> FaultPlan | None:
+    """The installed plan, loading ``TSE1M_FAULT_PLAN`` on first use."""
+    global _plan, _env_loaded
+    if not _env_loaded:
+        _env_loaded = True
+        path = os.environ.get("TSE1M_FAULT_PLAN")
+        if path:
+            try:
+                _plan = FaultPlan.from_json(path)
+                log.warning("fault plan active from %s: %d rules", path,
+                            len(_plan.rules))
+            except Exception as e:
+                raise RuntimeError(
+                    f"TSE1M_FAULT_PLAN={path!r} could not be loaded: {e}"
+                ) from e
+    return _plan
+
+
+def fault_point(site: str, path: str | None = None) -> None:
+    """The single hook production I/O seats call.  No active plan: no-op."""
+    plan = active_plan()
+    if plan is not None:
+        plan.fire(site, path=path)

@@ -1,0 +1,130 @@
+"""The shared retry engine.
+
+One implementation of the loop every I/O seat needs: bounded attempts,
+exponential backoff with full jitter (the AWS-architecture result — full
+jitter minimizes contention among recovering clients), a wall-clock
+deadline across *all* attempts, an exception allowlist, and server
+``Retry-After`` hints.  The reference hand-rolls this per script
+(2_get_buildlog_metadata.py:106-108, 3_get_coverage_data.py:73-74);
+the rebuild previously hand-rolled it once in ``HttpFetcher``; now there
+is exactly one engine and it is exercised under injected faults in tests.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Callable
+
+from ..utils.logging import get_logger
+
+log = get_logger("resilience.retry")
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Backoff/budget policy applied by :func:`retry_call`."""
+
+    max_attempts: int = 4
+    base_delay: float = 0.25          # first backoff step, seconds
+    max_delay: float = 30.0           # per-sleep cap
+    deadline: float | None = None     # wall-clock budget over all attempts
+    jitter: bool = True               # full jitter: sleep ~ U(0, step)
+    retry_on: tuple = (Exception,)    # exception allowlist (isinstance)
+
+    def step(self, attempt: int) -> float:
+        """Deterministic (pre-jitter) backoff for the given 0-based
+        attempt number."""
+        return min(self.max_delay, self.base_delay * (2 ** attempt))
+
+
+class RetryError(RuntimeError):
+    """All attempts exhausted (or the deadline passed).  ``__cause__`` is
+    the final underlying exception; ``attempts`` is how many were made."""
+
+    def __init__(self, message: str, attempts: int):
+        super().__init__(message)
+        self.attempts = attempts
+
+
+@dataclass
+class RetryStats:
+    """Observability for callers/tests: what the engine actually did."""
+
+    attempts: int = 0
+    sleeps: list = field(default_factory=list)
+
+
+def retry_call(
+    fn: Callable,
+    *args,
+    policy: RetryPolicy | None = None,
+    site: str = "",
+    should_retry: Callable[[BaseException], bool] | None = None,
+    on_retry: Callable[[BaseException, int], None] | None = None,
+    sleep: Callable[[float], None] = time.sleep,
+    rng: random.Random | None = None,
+    clock: Callable[[], float] = time.monotonic,
+    stats: RetryStats | None = None,
+    **kwargs,
+):
+    """Call ``fn(*args, **kwargs)``, retrying per ``policy``.
+
+    - Only exceptions matching ``policy.retry_on`` (and, if given, for
+      which ``should_retry(exc)`` is true) are retried; anything else
+      propagates immediately.
+    - ``on_retry(exc, attempt)`` runs before each re-attempt — the seat's
+      recovery hook (e.g. DB reconnect after a dropped connection).
+    - An exception may carry a ``retry_after`` attribute (seconds) — the
+      transport sets it from HTTP ``Retry-After`` — which raises the next
+      sleep to at least that, still capped by the remaining deadline.
+    - On exhaustion raises :class:`RetryError` from the last exception,
+      so callers see both the summary and the root cause.
+
+    ``sleep``/``rng``/``clock`` are injectable for deterministic tests.
+    """
+    policy = policy or RetryPolicy()
+    rng = rng or random
+    start = clock()
+    last: BaseException | None = None
+    attempts = 0
+    for attempt in range(policy.max_attempts):
+        attempts = attempt + 1
+        if stats is not None:
+            stats.attempts = attempts
+        try:
+            return fn(*args, **kwargs)
+        except policy.retry_on as e:
+            if should_retry is not None and not should_retry(e):
+                raise
+            last = e
+        delay = policy.step(attempt)
+        if policy.jitter:
+            delay = rng.uniform(0, delay)
+        hint = getattr(last, "retry_after", None)
+        if hint is not None:
+            delay = max(delay, float(hint))
+        if policy.deadline is not None:
+            remaining = policy.deadline - (clock() - start)
+            if remaining <= 0 or (attempt + 1 >= policy.max_attempts):
+                break
+            if delay > remaining:
+                # Sleeping past the deadline cannot help; spend what's
+                # left (the last attempt may still get lucky).
+                delay = remaining
+        elif attempt + 1 >= policy.max_attempts:
+            break
+        log.warning("%s: attempt %d/%d failed (%s: %s); retrying in %.2fs",
+                    site or getattr(fn, "__name__", "call"), attempts,
+                    policy.max_attempts, type(last).__name__, last, delay)
+        if on_retry is not None:
+            on_retry(last, attempt)
+        if stats is not None:
+            stats.sleeps.append(delay)
+        if delay > 0:
+            sleep(delay)
+    raise RetryError(
+        f"{site or getattr(fn, '__name__', 'call')}: giving up after "
+        f"{attempts} attempts: {type(last).__name__}: {last}",
+        attempts) from last

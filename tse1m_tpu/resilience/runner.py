@@ -1,0 +1,128 @@
+"""Run-to-completion orchestration for multi-step commands (``cli all``).
+
+Each step runs isolated: a failure is recorded (status, attempts, short
+error, full traceback) and the remaining steps still run.  The manifest
+is rewritten atomically after *every* step, so even a SIGKILL mid-run
+leaves an accurate partial record on disk.  ``exit_code()`` reflects
+partial failure — previously ``cli all`` aborted every remaining RQ on
+the first exception and a missing module still exited 0.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import traceback
+from dataclasses import asdict, dataclass, field
+
+from .retry import RetryError, RetryPolicy, retry_call
+from ..utils.logging import get_logger
+
+log = get_logger("resilience.runner")
+
+
+@dataclass
+class StepRecord:
+    name: str
+    status: str = "pending"   # pending | ok | failed | missing | skipped
+    attempts: int = 0
+    wall_s: float = 0.0
+    error: str | None = None      # one-line summary
+    traceback: str | None = None  # full text, failures only
+    detail: str | None = None     # e.g. why a step was skipped/missing
+
+
+class StepRunner:
+    """Run named steps to completion, recording each into a JSON manifest.
+
+    ``policy`` (optional) retries each step through the shared engine —
+    for idempotent steps only; the default is one attempt, because an RQ
+    that half-wrote artifacts should surface, not loop.
+    """
+
+    def __init__(self, manifest_path: str | None,
+                 policy: RetryPolicy | None = None):
+        self.manifest_path = manifest_path
+        self.policy = policy or RetryPolicy(max_attempts=1)
+        self.steps: list[StepRecord] = []
+        self.started_at = time.time()
+
+    def _record(self, rec: StepRecord) -> StepRecord:
+        self.steps.append(rec)
+        self._write()
+        return rec
+
+    def run(self, name: str, fn, *args, **kwargs) -> StepRecord:
+        """Run one step isolated; never raises (the record carries the
+        failure)."""
+        rec = StepRecord(name=name, status="running")
+        self.steps.append(rec)
+        t0 = time.time()
+        attempts = [0]
+
+        def attempt():
+            attempts[0] += 1
+            return fn(*args, **kwargs)
+
+        try:
+            retry_call(attempt, policy=self.policy, site=f"step:{name}")
+            rec.status = "ok"
+        except BaseException as e:  # noqa: BLE001 — isolation is the point
+            cause = e.__cause__ if isinstance(e, RetryError) and e.__cause__ else e
+            rec.error = f"{type(cause).__name__}: {cause}".strip().rstrip(":")
+            rec.status = "failed"
+            rec.traceback = traceback.format_exc()
+            log.error("step %s failed after %d attempt(s): %s", name,
+                      attempts[0], rec.error)
+            if isinstance(e, KeyboardInterrupt):
+                rec.wall_s = round(time.time() - t0, 3)
+                rec.attempts = attempts[0]
+                self._write()
+                raise
+        rec.attempts = attempts[0]
+        rec.wall_s = round(time.time() - t0, 3)
+        self._write()
+        return rec
+
+    def record_missing(self, name: str, detail: str) -> StepRecord:
+        """A requested step whose implementation is absent — previously a
+        silent log line and exit 0."""
+        return self._record(StepRecord(name=name, status="missing",
+                                       detail=detail))
+
+    def record_skipped(self, name: str, detail: str) -> StepRecord:
+        return self._record(StepRecord(name=name, status="skipped",
+                                       detail=detail))
+
+    # -- outcome ------------------------------------------------------------
+
+    @property
+    def failed(self) -> list[StepRecord]:
+        return [s for s in self.steps if s.status in ("failed", "missing")]
+
+    def exit_code(self) -> int:
+        return 1 if self.failed or not self.steps else 0
+
+    def summary(self) -> dict:
+        by = {}
+        for s in self.steps:
+            by[s.status] = by.get(s.status, 0) + 1
+        return by
+
+    def _write(self) -> None:
+        if not self.manifest_path:
+            return
+        payload = {
+            "started_at": self.started_at,
+            "wall_seconds": round(time.time() - self.started_at, 3),
+            "ok": not self.failed,
+            "summary": self.summary(),
+            "steps": [asdict(s) for s in self.steps],
+        }
+        os.makedirs(os.path.dirname(self.manifest_path) or ".",
+                    exist_ok=True)
+        tmp = self.manifest_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=2, default=str)
+        os.replace(tmp, self.manifest_path)

@@ -1,0 +1,45 @@
+"""Version compatibility shims.
+
+The framework targets jax >= 0.9 (``jax.shard_map``, ``check_vma=``), but
+minimal images ship older wheels where shard_map still lives in
+``jax.experimental.shard_map`` and the replication-check kwarg is spelled
+``check_rep``.  Every mesh module imports shard_map from here so the same
+code runs on both — part of the resilience contract: a missing/renamed
+dependency surface degrades to the equivalent API, never to 16 dead
+test modules.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:  # jax >= 0.9
+    from jax import shard_map as _shard_map
+except ImportError:  # jax 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_PARAMS = inspect.signature(_shard_map).parameters
+_VMA_KW = "check_vma" if "check_vma" in _PARAMS else (
+    "check_rep" if "check_rep" in _PARAMS else None)
+
+
+try:  # jax >= 0.9 exposes the x64 context manager at top level
+    enable_x64 = __import__("jax").enable_x64
+    enable_x64  # touch: the deprecation proxy raises on attribute access
+except AttributeError:  # jax 0.4.x
+    from jax.experimental import enable_x64
+
+
+def shard_map(f=None, **kwargs):
+    """`jax.shard_map` with the `check_vma` kwarg translated for older jax.
+
+    Usable both as a decorator factory (``@partial(shard_map, mesh=...)``
+    matches ``f=None`` and returns a decorator) and as a direct call.
+    """
+    if "check_vma" in kwargs and _VMA_KW != "check_vma":
+        val = kwargs.pop("check_vma")
+        if _VMA_KW is not None:
+            kwargs[_VMA_KW] = val
+    if f is None:
+        return lambda g: _shard_map(g, **kwargs)
+    return _shard_map(f, **kwargs)
