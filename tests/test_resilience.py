@@ -355,6 +355,120 @@ def test_db_statement_timeout_configured(tmp_path):
     db.closeConnection()
 
 
+def test_db_open_caller_transaction_is_not_silently_retried(tmp_path):
+    """A transient failure inside a caller-managed multi-statement
+    transaction must surface: the retry engine's recovery rollback would
+    silently drop the earlier uncommitted statements and a later
+    ``commit()`` would persist a half-applied unit."""
+    db = _db(tmp_path)
+    db.execute("CREATE TABLE t (x INTEGER)")
+    db.commit()
+    db.execute("INSERT INTO t VALUES (1)")  # opens a caller transaction
+    plan = FaultPlan([FaultRule(site="db.execute", times=1)])
+    with plan.active():
+        with pytest.raises(InjectedFault):
+            db.execute("INSERT INTO t VALUES (2)")
+    db.rollback()
+    db.closeConnection()
+
+
+def test_derive_projects_retries_whole_unit_without_duplicates(tmp_path):
+    """REVIEW regression: a transient fault on derive_projects' INSERT
+    must rerun the whole DELETE+INSERT unit — a per-statement retry rolls
+    back the DELETE, replays only the INSERT, and commit then persists
+    stale rows alongside new ones (duplicated projects)."""
+    from tse1m_tpu.db.ingest import derive_projects
+    from tse1m_tpu.db.schema import create_schema
+
+    db = _db(tmp_path)
+    create_schema(db)
+    db.executeMany(
+        "INSERT INTO buildlog_data (name, project, timecreated, build_type,"
+        " result) VALUES (?, ?, ?, ?, ?)",
+        [(f"n{i}", f"p{i}", "2024-01-01", "Fuzzing", "Finish")
+         for i in range(3)])
+    derive_projects(db)  # seed the stale rows a broken retry would keep
+    # after_calls=1 lands the fault on the unit's second statement (the
+    # INSERT), i.e. after the first attempt's DELETE already ran.
+    plan = FaultPlan([FaultRule(site="db.execute", times=1, after_calls=1)])
+    with plan.active():
+        derive_projects(db)
+    assert plan.fired == [("db.execute", "raise")]
+    rows = db.query("SELECT project_name FROM projects ORDER BY project_name")
+    assert rows == [("p0",), ("p1",), ("p2",)]
+    db.closeConnection()
+
+
+def test_restore_insert_dump_survives_db_faults(tmp_path):
+    """REVIEW regression: each dump INSERT commits as its own unit, so a
+    mid-stream transient failure (or dropped connection) cannot silently
+    discard previously-streamed uncommitted rows."""
+    from tse1m_tpu.db.restore import restore_sql_dump
+
+    dump = tmp_path / "dump.sql"
+    dump.write_text("\n".join(
+        "INSERT INTO buildlog_data (name, project, timecreated, build_type,"
+        f" result) VALUES ('n{i}', 'p', '2024-01-01', 'Fuzzing', 'Finish');"
+        for i in range(6)) + "\n")
+
+    clean_db = _db(tmp_path, name="clean.sqlite")
+    clean = restore_sql_dump(clean_db, str(dump))
+    clean_rows = clean_db.query(
+        "SELECT name, result FROM buildlog_data ORDER BY name")
+    clean_db.closeConnection()
+
+    faulty_db = _db(tmp_path, name="faulty.sqlite")
+    plan = FaultPlan([
+        FaultRule(site="db.execute", times=2, after_calls=9),
+        FaultRule(site="db.execute", times=1, kind="connection_drop",
+                  after_calls=14),
+    ])
+    with plan.active():
+        faulty = restore_sql_dump(faulty_db, str(dump))
+    faulty_rows = faulty_db.query(
+        "SELECT name, result FROM buildlog_data ORDER BY name")
+    faulty_db.closeConnection()
+
+    assert len(plan.fired) >= 3
+    assert faulty == clean
+    assert faulty_rows == clean_rows
+
+
+def test_config_fault_plan_is_installed_at_cli_startup(tmp_path, monkeypatch):
+    """REVIEW regression: an INI-configured `fault_plan` must actually
+    activate (previously only TSE1M_FAULT_PLAN was consumed)."""
+    from tse1m_tpu.cli import _activate_config_fault_plan
+    from tse1m_tpu.resilience import active_plan
+
+    path = str(tmp_path / "plan.json")
+    FaultPlan([FaultRule(site="nowhere.*", times=1)], seed=3).save(path)
+    ini = tmp_path / "env.ini"
+    ini.write_text(f"[FRAMEWORK]\nfault_plan = {path}\n")
+    monkeypatch.setenv("TSE1M_ENVFILE", str(ini))
+    monkeypatch.delenv("TSE1M_FAULT_PLAN", raising=False)
+    try:
+        _activate_config_fault_plan()
+        plan = active_plan()
+        assert plan is not None and plan.seed == 3
+        # exported so chaos-test subprocesses inherit the same plan
+        assert os.environ.get("TSE1M_FAULT_PLAN") == path
+    finally:
+        os.environ.pop("TSE1M_FAULT_PLAN", None)
+
+
+def test_kill_rule_never_falls_through_to_catchable_fault(monkeypatch):
+    """REVIEW regression: if SIGKILL delivery is not immediate, the kill
+    branch must not fall through and raise InjectedFault instead."""
+    import signal
+
+    delivered = []
+    monkeypatch.setattr(os, "kill", lambda pid, sig: delivered.append(sig))
+    plan = FaultPlan([FaultRule(site="s", kind="kill")])
+    with pytest.raises(SystemExit):
+        plan.fire("s")
+    assert delivered == [signal.SIGKILL]
+
+
 def test_ingest_under_db_faults_matches_fault_free(tmp_path):
     from tse1m_tpu.data.synth import SynthSpec, generate_study
     from tse1m_tpu.db.ingest import ingest_csv_dir

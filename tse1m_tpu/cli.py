@@ -12,6 +12,7 @@ this adds the operational commands the rebuild needs:
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 from .config import load_config
@@ -19,6 +20,24 @@ from .db.connection import DB
 from .utils.logging import get_logger
 
 log = get_logger("cli")
+
+
+def _activate_config_fault_plan() -> None:
+    """Install a FaultPlan configured via the INI (``fault_plan =`` under
+    ``[FRAMEWORK]``).  ``TSE1M_FAULT_PLAN`` already activates lazily inside
+    resilience.faults; this seat makes the config field equivalent for
+    operator game-days, and exports the env var so chaos-test subprocesses
+    spawned by this run inherit the same plan."""
+    from .resilience import active_plan, install_plan
+    from .resilience.faults import FaultPlan
+
+    if active_plan() is not None:  # env plan / in-process install wins
+        return
+    plan_path = load_config().fault_plan
+    if plan_path:
+        install_plan(FaultPlan.from_json(plan_path))
+        os.environ.setdefault("TSE1M_FAULT_PLAN", plan_path)
+        log.warning("fault plan active from config: %s", plan_path)
 
 
 def _cmd_synth(args) -> int:
@@ -42,8 +61,6 @@ def _cmd_synth(args) -> int:
     # RQ4 reads the corpus-analysis CSV from cfg.corpus_csv (rq4a_bug.py:34),
     # so a synthetic study must always materialise it there — regardless of
     # whether --csv-dir also received a copy.
-    import os
-
     os.makedirs(os.path.dirname(cfg.corpus_csv) or ".", exist_ok=True)
     study.corpus_analysis.to_csv(cfg.corpus_csv, index=False)
     log.info("corpus analysis CSV at %s", cfg.corpus_csv)
@@ -385,6 +402,7 @@ def main(argv=None) -> int:
     p.set_defaults(fn=_cmd_cluster)
 
     args = ap.parse_args(argv)
+    _activate_config_fault_plan()
     return args.fn(args)
 
 

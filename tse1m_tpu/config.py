@@ -64,9 +64,11 @@ class Config:
     # Test-mode subset switch (rq1_detection_rate.py:20,155-158,233).
     test_mode: bool = False
     # -- resilience (resilience/) -----------------------------------------
-    # Path to a FaultPlan JSON; also honored cross-process via
-    # TSE1M_FAULT_PLAN (resilience/faults.py reads the env directly so
-    # config-less seats like the checkpointers see the same plan).
+    # Path to a FaultPlan JSON.  Honored two ways: TSE1M_FAULT_PLAN is
+    # read directly by resilience/faults.py (so config-less seats like
+    # subprocess checkpointers see the same plan), and an INI-configured
+    # path is installed at CLI startup (cli._activate_config_fault_plan),
+    # which also exports the env var for child processes.
     fault_plan: str | None = None
     # Shared retry engine knobs for DB statements/connects.
     db_retry_attempts: int = 4

@@ -108,6 +108,12 @@ class DB:
         self.dialect = self._resolve_dialect()
         self.connection = None
         self.cursor = None
+        # Uncommitted writes issued through non-committing ops since the
+        # last commit/rollback/connect — i.e. a caller-managed transaction
+        # is open and per-statement retry is no longer safe.
+        self._dirty = False
+        # > 0 while inside run_transaction: the unit owns retry there.
+        self._txn_depth = 0
         c = self.config
         self._retry_policy = io_retry_policy(
             max_attempts=max(1, c.db_retry_attempts),
@@ -146,6 +152,7 @@ class DB:
 
     def _connect_once(self) -> None:
         fault_point("db.connect")
+        self._dirty = False  # a fresh connection has no open transaction
         timeout_ms = self.config.db_statement_timeout_ms
         if self.dialect == "postgres":
             pg = self.config.postgres
@@ -165,9 +172,16 @@ class DB:
             self.cursor = self.connection.cursor()
             if timeout_ms > 0:
                 # A hung statement must fail (and be retried/surfaced),
-                # not stall a collector for hours.
+                # not stall a collector for hours.  SET is transactional
+                # in Postgres and both drivers run it inside a BEGIN
+                # (implicit for psycopg2, lazy for pglib): commit it
+                # immediately so the first rollback — including the one
+                # the retry engine's own recovery issues — cannot
+                # silently revert the timeout for the rest of the
+                # session.
                 self.cursor.execute(
                     f"SET statement_timeout = {int(timeout_ms)}")
+                self.connection.commit()
         else:
             path = self.config.sqlite_path
             if path != ":memory:":
@@ -192,20 +206,49 @@ class DB:
             self.cursor = self.connection = None
         self._connect_once()
 
-    def _statement(self, op: Callable, site: str = "db.execute"):
+    def _statement(self, op: Callable, site: str = "db.execute",
+                   commits: bool = False, writes: bool = False):
         """Run ``op()`` (a closure over ``self.cursor``) under the shared
         retry engine.  Transient faults re-execute on the same connection;
-        disconnect-class failures reconnect first.  Each op here is one
-        autocommit-scoped unit, so the retry is idempotent from the DB's
-        view unless the server committed *and* dropped before replying —
-        the standard at-least-once caveat.
+        disconnect-class failures reconnect first.
+
+        Retry is only safe when the op is its own unit of work, so:
+
+        - ops that commit internally (``commits=True``: executeMany,
+          executeValues, DML executeQuery, ``execute_raw(commit=True)``)
+          always retry — rollback/reconnect discards nothing committed
+          and the whole op re-applies;
+        - non-committing ops retry only while no caller-managed
+          transaction is open (``self._dirty`` unset).  Once a caller
+          has issued an uncommitted write, the recovery rollback would
+          silently drop the *earlier* statements of that transaction and
+          the caller's eventual ``commit()`` would persist a
+          half-applied unit — so the failure surfaces instead.  Use
+          :meth:`run_transaction` to make a multi-statement unit
+          retryable as a whole.
+        - inside :meth:`run_transaction` the unit owns retry; statements
+          execute exactly once per unit attempt.
+
+        The standard at-least-once caveat stands: a retried committing
+        op can double-apply when the server committed *and* dropped
+        before replying.
         """
 
         def attempt():
             fault_point(site)
             if self.connection is None or self.cursor is None:
                 self._connect_once()
-            return op()
+            result = op()
+            if commits:
+                self._dirty = False
+            elif writes:
+                self._dirty = True
+            return result
+
+        if self._txn_depth:
+            return attempt()  # the enclosing run_transaction retries
+        if self._dirty and not commits:
+            return attempt()  # open caller transaction: surface, not retry
 
         def recover(exc: BaseException, _attempt: int) -> None:
             if is_disconnect(exc):
@@ -219,12 +262,53 @@ class DB:
         return retry_call(attempt, policy=self._retry_policy, site=site,
                           should_retry=is_transient, on_retry=recover)
 
+    def run_transaction(self, fn: Callable[["DB"], Any],
+                        site: str = "db.txn"):
+        """Execute ``fn(self)`` as one retried, atomic unit.
+
+        Statements issued inside run once per attempt (no per-statement
+        retry); on a transient failure the whole unit rolls back —
+        reconnecting when the connection died — and re-runs from the
+        top, and the commit happens here after a fully successful
+        attempt.  ``fn`` must therefore be idempotent *as a whole*, e.g.
+        the DELETE+INSERT rebuild in ``db/ingest.derive_projects`` or
+        the IF-NOT-EXISTS DDL in ``db/schema.create_schema``.  Ops that
+        commit internally (executeMany/executeValues/...) escape the
+        unit's atomicity — avoid them inside ``fn``.
+        """
+
+        def attempt():
+            if self.connection is None or self.cursor is None:
+                self._connect_once()
+            self._txn_depth += 1
+            try:
+                result = fn(self)
+            finally:
+                self._txn_depth -= 1
+            self.connection.commit()
+            self._dirty = False
+            return result
+
+        def recover(exc: BaseException, _attempt: int) -> None:
+            self._dirty = False
+            if is_disconnect(exc):
+                self._reconnect()
+            else:
+                try:
+                    self.connection.rollback()
+                except Exception:
+                    pass
+
+        return retry_call(attempt, policy=self._retry_policy, site=site,
+                          should_retry=is_transient, on_retry=recover)
+
     def closeConnection(self) -> None:
         if self.cursor is not None:
             self.cursor.close()
         if self.connection is not None:
             self.connection.close()
         self.cursor = self.connection = None
+        self._dirty = False
 
     close = closeConnection
 
@@ -243,21 +327,28 @@ class DB:
 
     def execute(self, sql: str, params: Sequence[Any] = ()) -> None:
         self._statement(
-            lambda: self.cursor.execute(self._adapt(sql), tuple(params)))
+            lambda: self.cursor.execute(self._adapt(sql), tuple(params)),
+            writes=True)
 
-    def execute_raw(self, sql: str) -> int:
+    def execute_raw(self, sql: str, commit: bool = False) -> int:
         """Execute one complete statement verbatim — no qmark adaptation,
         no parameter interpolation.  The restore path needs this: dump
         statements may carry ``?`` or ``%`` inside string literals, which
         ``_adapt`` + driver interpolation would corrupt or crash on.
+        ``commit=True`` commits the statement as its own unit of work,
+        which keeps it retryable under the shared engine (the restore
+        path streams thousands of independent INSERTs and must not hold
+        them all in one fragile uncommitted transaction).
         Returns the driver-reported affected-row count (0 when unknown)."""
 
         def op() -> int:
             self.cursor.execute(sql)
             n = self.cursor.rowcount
+            if commit:
+                self.connection.commit()
             return int(n) if n and n > 0 else 0
 
-        return self._statement(op)
+        return self._statement(op, commits=commit, writes=True)
 
     def query(self, sql: str, params: Sequence[Any] = ()) -> list[tuple]:
         def op() -> list[tuple]:
@@ -287,6 +378,11 @@ class DB:
 
     def commit(self) -> None:
         self.connection.commit()
+        self._dirty = False
+
+    def rollback(self) -> None:
+        self.connection.rollback()
+        self._dirty = False
 
     # -- reference-compatible surface (dbFile.py:16-38) --------------------
 
@@ -301,7 +397,7 @@ class DB:
             self.connection.commit()
             return None
 
-        return self._statement(op)
+        return self._statement(op, commits=(type != "select"))
 
     def executeMany(self, sql: str, rows: Iterable[Sequence[Any]]) -> None:
         rows = [tuple(r) for r in rows]
@@ -310,7 +406,7 @@ class DB:
             self.cursor.executemany(self._adapt(sql), rows)
             self.connection.commit()
 
-        self._statement(op)
+        self._statement(op, commits=True)
 
     def executeValues(self, sql: str, rows: Iterable[Sequence[Any]], page_size: int = 1000) -> None:
         """Bulk insert.  Postgres uses psycopg2.extras.execute_values
@@ -350,4 +446,4 @@ class DB:
                     sql.replace("VALUES ?", f"VALUES {placeholders}"), rows)
             self.connection.commit()
 
-        self._statement(op)
+        self._statement(op, commits=True)

@@ -244,7 +244,16 @@ def ingest_csv_dir(db: DB, csv_dir: str) -> dict[str, int]:
 def derive_projects(db: DB) -> None:
     """Rebuild the count-only ``projects`` table (queries1.py:6-11) from
     buildlog rows.  There is no projects.csv in the collection pipeline; the
-    table is always derived."""
-    db.execute("DELETE FROM projects")
-    db.execute("INSERT INTO projects (project_name) SELECT project FROM buildlog_data")
-    db.commit()
+    table is always derived.
+
+    The DELETE+INSERT rebuild is one retried transaction unit: a transient
+    failure between the two statements must rerun *both*, otherwise a
+    per-statement retry would roll back the DELETE, replay only the INSERT,
+    and the commit would persist stale rows alongside the new ones."""
+
+    def _rebuild(dbx: DB) -> None:
+        dbx.execute("DELETE FROM projects")
+        dbx.execute("INSERT INTO projects (project_name) "
+                    "SELECT project FROM buildlog_data")
+
+    db.run_transaction(_rebuild, site="db.derive_projects")

@@ -132,9 +132,14 @@ def restore_sql_dump(db, path: str, create: bool = True,
                 if m and m.group(1).lower() in counts:
                     table = m.group(1).lower()
                     # rowcount, not statement count: pg_dump --inserts can
-                    # pack many rows per VALUES list.
+                    # pack many rows per VALUES list.  commit=True: each
+                    # dump INSERT is its own retryable unit — holding the
+                    # whole stream in one transaction would mean a single
+                    # mid-stream disconnect silently drops every prior
+                    # uncommitted row.
                     counts[table] += db.execute_raw(
-                        stmt.rstrip(";").replace(f"public.{table}", table))
+                        stmt.rstrip(";").replace(f"public.{table}", table),
+                        commit=True)
                 elif stmt and not stmt.startswith("--"):
                     skipped += 1
     # A COPY block for a skipped table collects under "__skip__": drop it.
@@ -146,9 +151,14 @@ def restore_sql_dump(db, path: str, create: bool = True,
     if counts.get("buildlog_data", 0):
         from .ingest import _RESULT_CANON
 
-        for src, dst in _RESULT_CANON.items():
-            db.execute("UPDATE buildlog_data SET result = ? "
-                       "WHERE result = ?", (dst, src))
+        def _canon(dbx) -> None:
+            # One retried transaction unit: the UPDATEs are idempotent
+            # as a batch, so a transient mid-batch failure replays all.
+            for src, dst in _RESULT_CANON.items():
+                dbx.execute("UPDATE buildlog_data SET result = ? "
+                            "WHERE result = ?", (dst, src))
+
+        db.run_transaction(_canon, site="db.restore.canon")
     if counts.get("projects", 0) == 0 and counts.get("buildlog_data", 0):
         from .ingest import derive_projects
 

@@ -133,9 +133,15 @@ def ddl(dialect: str) -> str:
 
 
 def create_schema(db) -> None:
-    """Create all study tables on an open tse1m_tpu.db.DB connection."""
-    for statement in ddl(db.dialect).split(";"):
-        stmt = statement.strip()
-        if stmt:
-            db.execute(stmt)
-    db.commit()
+    """Create all study tables on an open tse1m_tpu.db.DB connection.
+
+    Runs as one retried transaction unit (db/connection.run_transaction):
+    every statement is IF NOT EXISTS, so replaying the whole batch after
+    a transient failure is idempotent."""
+    statements = [s.strip() for s in ddl(db.dialect).split(";") if s.strip()]
+
+    def _create(dbx) -> None:
+        for stmt in statements:
+            dbx.execute(stmt)
+
+    db.run_transaction(_create, site="db.create_schema")

@@ -140,6 +140,10 @@ class FaultPlan:
             return
         if rule.kind == "kill":
             os.kill(os.getpid(), signal.SIGKILL)
+            # SIGKILL delivery can be asynchronous; never fall through
+            # and surface some *other* fault kind as a catchable
+            # exception while the signal is in flight.
+            raise SystemExit(f"fault plane: SIGKILL at {site}")
         if rule.kind == "torn_write" and path and os.path.exists(path):
             size = os.path.getsize(path)
             keep = int(size * rule.truncate_fraction)
