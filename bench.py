@@ -284,6 +284,16 @@ def main() -> int:
     args = p.parse_args()
     iters = max(1, args.iters)
 
+    # Persistent XLA compilation cache (Config.xla_cache_dir /
+    # TSE1M_XLA_CACHE_DIR): repeat bench rounds skip every kernel
+    # recompile — each fresh compile pays several 129 ms dispatch RTTs on
+    # the measured tunneled link.  Must happen before the first jit.
+    cache_dir = os.environ.get("TSE1M_XLA_CACHE_DIR")
+    if cache_dir:
+        from tse1m_tpu.utils.compat import enable_persistent_compilation_cache
+
+        enable_persistent_compilation_cache(cache_dir)
+
     import jax
 
     from tse1m_tpu.cluster import (ClusterParams, adjusted_rand_index,
@@ -333,6 +343,17 @@ def main() -> int:
     from tse1m_tpu.cluster.pipeline import last_run_info
 
     cluster_info = dict(last_run_info)
+    # Per-stage walls of the LAST timed run (observability.StageRecorder):
+    # stage_encode_s / stage_h2d_s / stage_compute_s / stage_d2h_s plus
+    # h2d_overlap_fraction — the round-over-round answer to "which stage
+    # moved".  Emitted at top level, not cluster_-prefixed: they are the
+    # bench contract keys (PARITY.md "Wire format & streaming pipeline").
+    stage_info = cluster_info.pop("stages", {})
+    if stage_info.get("stage_encode_s") and cluster_info.get("wire_mb"):
+        # Host packing throughput over the shipped wire bytes — separates
+        # "encode got slower" from "wire got bigger" between rounds.
+        stage_info["encode_MBps"] = round(
+            cluster_info["wire_mb"] / stage_info["stage_encode_s"], 1)
 
     def compute_only() -> float:
         """Device-compute wall with items already resident on device —
@@ -371,8 +392,9 @@ def main() -> int:
 
     def transfer_probe() -> dict:
         """Measured H2D wall for the exact payload the cluster pipeline
-        ships — the pipeline's OWN encoding decision (base-delta lanes
-        when `cluster/encode.py` engages, else 24-bit pack), median of 3 —
+        ships — `pipeline.wire_payloads` returns the pipeline's OWN wire
+        plan (quantization, delta lanes, adaptive bit-packing), so the
+        probe cannot drift from the shipped format; median of 3 —
         `value` minus this minus `compute_only_s` is dispatch/encode
         overhead, so the link bound is measured rather than inferred from
         subtraction."""
@@ -380,19 +402,8 @@ def main() -> int:
 
         from tse1m_tpu.cluster import pipeline as pl
 
-        enc = pl._maybe_encode(items, params)
-        pack = pl.should_pack24(items)
-        if enc is None:
-            payloads = [pl._pack24_host(items) if pack else items]
-            kind = "pack24" if pack else "raw"
-        else:
-            payloads = [
-                pl._pack24_host(enc.full_rows) if pack else enc.full_rows,
-                enc.rep_in_full, enc.counts, enc.pos_flat,
-                pl._pack24_host(enc.val_flat) if pack else enc.val_flat,
-                enc.mask_bits,
-            ]
-            kind = "delta"
+        payloads, winfo = pl.wire_payloads(items, params)
+        kind = winfo["encoding"]
         # An all-exact-duplicate workload has zero diffs: empty lanes can't
         # be indexed by the sync op and ship nothing anyway.
         payloads = [p for p in payloads if p.size]
@@ -419,7 +430,8 @@ def main() -> int:
             "transfer_runs_s": [round(s, 4) for s in samples],
             "transfer_best_s": round(min(samples), 4),
             "transfer_MBps": round(nbytes / med / 1e6, 1),
-            "transfer_packed24": pack,
+            "transfer_chunk_bits": winfo["chunk_bits"],
+            "transfer_quant_bits": winfo["wire_quant_bits"],
             "transfer_encoding": kind,
         }
 
@@ -464,8 +476,10 @@ def main() -> int:
     if ari_host is not None:
         result["ari_vs_host_sample"] = ari_host
     # Encoding stats of the last timed run (cluster/encode.py): lane split,
-    # wire bytes, host encode seconds.
+    # wire bytes, host encode seconds — plus the per-stage walls and
+    # overlap fraction (observability plane).
     result.update({f"cluster_{k}": v for k, v in cluster_info.items()})
+    result.update(stage_info)
     result.update(transfer_stats)
     try:
         result.update(bench_link())

@@ -34,18 +34,27 @@ def run_csv(args) -> int:
 
 def run_cluster(args) -> int:
     """Resumable clustering over a deterministic synthetic study; labels
-    land in ``--out`` as .npy for the parent to compare."""
+    land in ``--out`` as .npy for the parent to compare.  ``--no-overlap``
+    disables the double-buffered producer thread (the sequential oracle
+    for the overlap chaos test); ``--info`` dumps the run's
+    last_run_info — including the observability stage record — as JSON."""
+    import json
+
     import numpy as np
 
     from tse1m_tpu.cluster import ClusterParams, cluster_sessions_resumable
+    from tse1m_tpu.cluster.pipeline import last_run_info
     from tse1m_tpu.data.synth import synth_session_sets
 
     items = synth_session_sets(args.n, set_size=16, seed=args.seed)[0]
     params = ClusterParams(n_hashes=32, n_bands=4, use_pallas="never",
-                           h2d_chunks=4)
+                           h2d_chunks=4, overlap=not args.no_overlap)
     labels = cluster_sessions_resumable(items, params,
                                         checkpoint_dir=args.dir)
     np.save(args.out, labels)
+    if args.info:
+        with open(args.info, "w") as f:
+            json.dump(dict(last_run_info), f)
     return 0
 
 
@@ -65,6 +74,8 @@ def main(argv=None) -> int:
     p.add_argument("--out", required=True)
     p.add_argument("--n", type=int, default=2048)
     p.add_argument("--seed", type=int, default=13)
+    p.add_argument("--no-overlap", action="store_true")
+    p.add_argument("--info", default=None)
     p.set_defaults(fn=run_cluster)
 
     args = ap.parse_args(argv)

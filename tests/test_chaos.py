@@ -98,6 +98,44 @@ def test_cluster_checkpoint_sigkill_resume_equals_uninterrupted(tmp_path):
     assert not glob.glob(os.path.join(ck_dir, "shard_*"))
 
 
+def test_cluster_overlap_sigkill_resume_equals_uninterrupted(tmp_path):
+    """SIGKILL mid-stream under the DOUBLE-BUFFERED path (producer thread
+    packing/transferring chunk k+1 while chunk k computes and its shard
+    saves): resume must land on labels identical to a sequential
+    (--no-overlap) uninterrupted run.  The kill fires during the 3rd of 4
+    shard saves, i.e. while the producer thread has the final chunk's
+    pack + device_put in flight."""
+    import json
+
+    clean_out = str(tmp_path / "clean.npy")
+    run_driver(["cluster", "--dir", str(tmp_path / "ck_clean"),
+                "--out", clean_out, "--no-overlap"])
+    want = np.load(clean_out)
+
+    plan_path = str(tmp_path / "plan.json")
+    FaultPlan([FaultRule(site="checkpoint.cluster.save", kind="kill",
+                         after_calls=2)]).save(plan_path)
+    ck_dir = str(tmp_path / "ck_chaos")
+    out = str(tmp_path / "chaos.npy")
+    run_driver(["cluster", "--dir", ck_dir, "--out", out],
+               fault_plan_path=plan_path, expect_kill=True)
+    assert not os.path.exists(out)
+    shards = [s for s in glob.glob(os.path.join(ck_dir, "shard_*.npz"))
+              if not s.endswith(".tmp.npz")]
+    assert len(shards) == 2  # two durable chunks before the kill
+
+    info_path = str(tmp_path / "info.json")
+    run_driver(["cluster", "--dir", ck_dir, "--out", out,
+                "--info", info_path])
+    np.testing.assert_array_equal(np.load(out), want)
+    # the resumed overlapped run reported its per-stage telemetry
+    info = json.load(open(info_path))
+    stages = info["stages"]
+    for key in ("stage_encode_s", "stage_h2d_s", "stage_compute_s",
+                "h2d_overlap_fraction"):
+        assert key in stages, stages
+
+
 @pytest.mark.slow
 def test_cluster_sigkill_twice_then_resume(tmp_path):
     """Two consecutive kills at different chunks, then a clean resume —

@@ -63,15 +63,16 @@ def test_kill_and_resume_recomputes_only_missing_chunks(items, tmp_path,
         cluster_sessions_resumable(items, PARAMS, checkpoint_dir=d)
     monkeypatch.setattr(ClusterCheckpoint, "save_chunk", real_save)
 
-    # Resume: only the remaining chunks may hit the compute path.
+    # Resume: only the remaining chunks may hit the compute path
+    # (_chunk_minhash is the per-chunk decode+MinHash seat).
     computed = []
-    real_mk = pipeline_mod.minhash_and_keys
+    real_mk = pipeline_mod._chunk_minhash
 
     def counting_mk(*a, **kw):
         computed.append(1)
         return real_mk(*a, **kw)
 
-    monkeypatch.setattr(pipeline_mod, "minhash_and_keys", counting_mk)
+    monkeypatch.setattr(pipeline_mod, "_chunk_minhash", counting_mk)
     got = cluster_sessions_resumable(items, PARAMS, checkpoint_dir=d)
     n_chunks = -(-N // 512)
     assert len(computed) == n_chunks - 2

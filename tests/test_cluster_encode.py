@@ -21,9 +21,20 @@ import tse1m_tpu.cluster.pipeline as pipeline_mod
 from tse1m_tpu.cluster import (ClusterParams, cluster_sessions,
                                cluster_sessions_resumable)
 from tse1m_tpu.cluster.checkpoint import ClusterCheckpoint
-from tse1m_tpu.cluster.encode import (DeltaEncoding, _group_rows, decode_host,
-                                      encode_delta)
+from tse1m_tpu.cluster.encode import (DeltaEncoding, _group_rows,
+                                      chunk_wire_bits, decode_host,
+                                      encode_delta, pack_bits_host,
+                                      pack_chunk, pack_delta_meta,
+                                      quantize_ids, unpack_bits_host,
+                                      unpack_chunk_host, width_bits)
 from tse1m_tpu.data.synth import synth_session_sets
+
+try:
+    from hypothesis import given, settings, strategies as st
+
+    HAVE_HYPOTHESIS = True
+except ImportError:  # property tests degrade to the deterministic suite
+    HAVE_HYPOTHESIS = False
 
 N = 4096
 
@@ -96,7 +107,7 @@ def test_encoded_labels_bit_identical(dup_items):
                         encoding="delta")
     np.testing.assert_array_equal(cluster_sessions(dup_items, enc),
                                   cluster_sessions(dup_items, base))
-    assert pipeline_mod.last_run_info["encoding"] == "pack24"
+    assert pipeline_mod.last_run_info["encoding"] == "plain"
 
 
 def test_encoded_labels_bit_identical_raw_values():
@@ -118,8 +129,11 @@ def test_encoded_labels_bit_identical_raw_values():
 def test_auto_policy_skips_small_inputs(dup_items):
     cluster_sessions(dup_items[:512],
                      ClusterParams(use_pallas="never", encoding="auto"))
-    # the two-step no-pallas path ships raw uint32 — the report says so
-    assert pipeline_mod.last_run_info["encoding"] == "raw"
+    # small inputs skip the delta encoder; the plain adaptively-packed
+    # lane ships (and is reported as such, with its per-chunk widths)
+    info = pipeline_mod.last_run_info
+    assert info["encoding"] == "plain"
+    assert all(1 <= w <= 32 for w in info["chunk_bits"])
 
 
 def test_auto_policy_engages_on_large_compressible(dup_items, monkeypatch):
@@ -258,3 +272,220 @@ def _drop_delta_row(items: np.ndarray, enc: DeltaEncoding,
         pos_flat=enc.pos_flat[keep_flat],
         val_flat=enc.val_flat[keep_flat],
     )
+
+
+# ---------------------------------------------------------------------------
+# Adaptive bit-width wire packing (this PR's wire layer).
+
+_WIDTHS = (8, 16, 24, 32, 1, 3, 5, 6, 7, 10, 12, 17, 21, 31)
+
+
+def _device_unpack(packed, n, bits, offset=0):
+    import jax.numpy as jnp
+
+    return np.asarray(pipeline_mod._unpack_bits(
+        jnp.asarray(packed), n, bits, np.uint32(offset)))
+
+
+@pytest.mark.parametrize("bits", _WIDTHS)
+def test_bitpack_roundtrip_max_range_and_empty(bits):
+    """Byte-multiple AND sub-byte widths round-trip through both the host
+    oracle and the device kernel — including all-max values (every bit
+    set, the mask-off edge) and the empty stream."""
+    top = (1 << bits) - 1
+    for n in (0, 1, 7, 8, 9, 257):
+        vals = np.full(n, top, np.uint32)
+        packed = pack_bits_host(vals, bits)
+        assert packed.nbytes == -(-n * bits // 8)
+        np.testing.assert_array_equal(unpack_bits_host(packed, n, bits),
+                                      vals)
+        np.testing.assert_array_equal(_device_unpack(packed, n, bits), vals)
+
+
+@pytest.mark.parametrize("bits", _WIDTHS)
+def test_bitpack_roundtrip_random(bits):
+    rng = np.random.default_rng(bits)
+    vals = rng.integers(0, 1 << bits, size=999, dtype=np.uint64).astype(
+        np.uint32)
+    packed = pack_bits_host(vals, bits)
+    np.testing.assert_array_equal(unpack_bits_host(packed, 999, bits), vals)
+    np.testing.assert_array_equal(_device_unpack(packed, 999, bits), vals)
+
+
+def test_pack_chunk_adaptive_width_offset_and_device_parity():
+    """A narrow value band high in the id space packs at the width of its
+    RANGE (min-subtracted), and the device decode restores it exactly."""
+    rng = np.random.default_rng(0)
+    base = 5_000_000
+    chunk = (base + rng.integers(0, 100, size=(37, 8))).astype(np.uint32)
+    wire = pack_chunk(chunk)
+    assert wire.bits == width_bits(int(chunk.max()) - int(chunk.min()))
+    assert wire.bits <= 7 and wire.offset == int(chunk.min())
+    np.testing.assert_array_equal(unpack_chunk_host(wire), chunk)
+    got = _device_unpack(wire.payload, wire.n_values, wire.bits,
+                         wire.offset).reshape(wire.shape)
+    np.testing.assert_array_equal(got, chunk)
+
+
+def test_pack_chunk_respects_pack_limit():
+    """Ids at/above the limit ship raw uint32 (the historical pack24 kill
+    switch), regardless of range."""
+    chunk = np.array([[1 << 25, (1 << 25) + 3]], np.uint32)
+    assert chunk_wire_bits(chunk, pack_limit=1 << 24) == (32, 0)
+    wire = pack_chunk(chunk, pack_limit=1 << 24)
+    np.testing.assert_array_equal(unpack_chunk_host(wire), chunk)
+    # without the limit, the 2-wide range packs to 2 bits + offset
+    assert pack_chunk(chunk, pack_limit=1 << 33).bits == 2
+
+
+def test_pack_delta_meta_roundtrip(dup_items):
+    """The bit-packed delta metadata lanes (rep/counts/pos/val) decode
+    back to the DeltaEncoding exactly — and they are strictly smaller
+    than the fixed-width lanes they replaced."""
+    enc = encode_delta(dup_items, use_native=False)
+    meta = pack_delta_meta(enc)
+    np.testing.assert_array_equal(
+        unpack_bits_host(meta.rep, enc.n_delta, meta.rep_bits),
+        enc.rep_in_full.astype(np.uint32))
+    np.testing.assert_array_equal(
+        unpack_bits_host(meta.counts, enc.n_delta, meta.counts_bits),
+        enc.counts.astype(np.uint32))
+    np.testing.assert_array_equal(
+        unpack_bits_host(meta.pos, len(enc.pos_flat), meta.pos_bits),
+        enc.pos_flat.astype(np.uint32))
+    np.testing.assert_array_equal(unpack_chunk_host(meta.val), enc.val_flat)
+    fixed = (enc.rep_in_full.nbytes + enc.counts.nbytes + enc.pos_flat.nbytes
+             + enc.val_flat.nbytes)
+    assert meta.nbytes < fixed
+
+
+if HAVE_HYPOTHESIS:
+
+    @settings(max_examples=80, deadline=None)
+    @given(st.data())
+    def test_bitpack_roundtrip_property(data):
+        """Hypothesis sweep over width x length x values (including the
+        degenerate empty chunk and max-range draws)."""
+        bits = data.draw(st.sampled_from(_WIDTHS), label="bits")
+        n = data.draw(st.integers(min_value=0, max_value=130), label="n")
+        top = (1 << bits) - 1
+        vals = np.asarray(
+            data.draw(st.lists(st.integers(0, top), min_size=n, max_size=n),
+                      label="vals"), dtype=np.uint32).reshape(n)
+        packed = pack_bits_host(vals, bits)
+        assert packed.nbytes == -(-n * bits // 8)
+        np.testing.assert_array_equal(unpack_bits_host(packed, n, bits),
+                                      vals)
+        np.testing.assert_array_equal(_device_unpack(packed, n, bits), vals)
+
+    @settings(max_examples=40, deadline=None)
+    @given(st.data())
+    def test_pack_chunk_roundtrip_property(data):
+        """pack_chunk picks a legal width for ANY uint32 chunk and
+        round-trips bit-exactly through host and device decoders."""
+        rows = data.draw(st.integers(0, 24), label="rows")
+        cols = data.draw(st.integers(1, 9), label="cols")
+        hi = data.draw(st.sampled_from(
+            [1 << 4, 1 << 12, 1 << 24, (1 << 32) - 1]), label="hi")
+        lo = data.draw(st.integers(0, hi), label="lo")
+        rng = np.random.default_rng(data.draw(st.integers(0, 2**16)))
+        chunk = rng.integers(lo, hi + 1, size=(rows, cols),
+                             dtype=np.uint64).astype(np.uint32)
+        wire = pack_chunk(chunk)
+        assert 1 <= wire.bits <= 32
+        np.testing.assert_array_equal(unpack_chunk_host(wire), chunk)
+        got = _device_unpack(wire.payload, wire.n_values, wire.bits,
+                             wire.offset).reshape(wire.shape)
+        np.testing.assert_array_equal(got, chunk)
+
+else:  # pragma: no cover - environment without hypothesis
+
+    @pytest.mark.skip(reason="property tests need hypothesis "
+                             "(pip install -e .[test])")
+    def test_bitpack_roundtrip_property():
+        pass
+
+    @pytest.mark.skip(reason="property tests need hypothesis "
+                             "(pip install -e .[test])")
+    def test_pack_chunk_roundtrip_property():
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Wire quantization (b-bit-minwise universe reduction).
+
+def test_quantize_ids_deterministic_and_bounded():
+    rng = np.random.default_rng(1)
+    x = rng.integers(0, 1 << 32, size=(64, 8), dtype=np.uint64).astype(
+        np.uint32)
+    q = quantize_ids(x, 10)
+    assert int(q.max()) < 1 << 10
+    np.testing.assert_array_equal(q, quantize_ids(x, 10))  # deterministic
+    # equal ids collide identically: exact-duplicate rows stay duplicates
+    np.testing.assert_array_equal(quantize_ids(x[0], 10),
+                                  quantize_ids(x[0].copy(), 10))
+
+
+def test_quant_auto_policy(dup_items, monkeypatch):
+    prm = ClusterParams(use_pallas="never")
+    # small input: auto stays off
+    assert pipeline_mod._quant_bits(dup_items, prm) == 0
+    # large input (threshold lowered): auto engages at _AUTO_QUANT_BITS
+    monkeypatch.setattr(pipeline_mod, "_AUTO_MIN_BYTES", 1024)
+    assert pipeline_mod._quant_bits(dup_items, prm) \
+        == pipeline_mod._AUTO_QUANT_BITS
+    # explicit off wins over size
+    off = ClusterParams(use_pallas="never", wire_quant_bits=-1)
+    assert pipeline_mod._quant_bits(dup_items, off) == 0
+    # no gain when ids already fit the target universe
+    assert pipeline_mod._quant_bits((dup_items & 511), prm) == 0
+
+
+def test_quantized_labels_parity_across_encodings(dup_items):
+    """Forced quantization must leave delta and plain paths bit-identical
+    to each other (both cluster quantize_ids(items)) and equal to
+    clustering the pre-quantized items directly."""
+    prm = dict(use_pallas="never", h2d_chunks=3, wire_quant_bits=12)
+    delta = cluster_sessions(dup_items, ClusterParams(encoding="delta",
+                                                      **prm))
+    assert pipeline_mod.last_run_info["wire_quant_bits"] == 12
+    assert max(pipeline_mod.last_run_info["chunk_bits"]) <= 12
+    plain = cluster_sessions(dup_items, ClusterParams(encoding="pack24",
+                                                      **prm))
+    np.testing.assert_array_equal(delta, plain)
+    oracle = cluster_sessions(quantize_ids(dup_items, 12),
+                              ClusterParams(use_pallas="never",
+                                            h2d_chunks=3,
+                                            wire_quant_bits=-1))
+    np.testing.assert_array_equal(delta, oracle)
+
+
+def test_quantized_resumable_matches_and_refuses_policy_change(dup_items,
+                                                               tmp_path):
+    prm = ClusterParams(use_pallas="never", h2d_chunks=4, encoding="delta",
+                        wire_quant_bits=11)
+    want = cluster_sessions(dup_items, prm)
+    d = str(tmp_path / "ck")
+    got = cluster_sessions_resumable(dup_items, prm, checkpoint_dir=d,
+                                     cleanup=False)
+    np.testing.assert_array_equal(got, want)
+    # same directory, different quantization policy -> refuse
+    other = ClusterParams(use_pallas="never", h2d_chunks=4, encoding="delta",
+                          wire_quant_bits=9)
+    with pytest.raises(ValueError, match="different"):
+        cluster_sessions_resumable(dup_items, other, checkpoint_dir=d)
+
+
+def test_wire_payloads_matches_pipeline_decision(dup_items, monkeypatch):
+    """bench's transfer probe ships wire_payloads — its byte count and
+    encoding decision must equal what the timed pipeline reports."""
+    monkeypatch.setattr(pipeline_mod, "_AUTO_MIN_BYTES", 1024)
+    prm = ClusterParams(use_pallas="never", h2d_chunks=2)
+    payloads, winfo = pipeline_mod.wire_payloads(dup_items, prm)
+    cluster_sessions(dup_items, prm)
+    info = pipeline_mod.last_run_info
+    assert winfo["encoding"] == info["encoding"] == "delta"
+    assert winfo["wire_quant_bits"] == info["wire_quant_bits"]
+    assert abs(winfo["wire_mb"] - info["wire_mb"]) < 0.02
+    assert sum(p.nbytes for p in payloads) == pytest.approx(
+        winfo["wire_mb"] * 2**20, abs=2**14)

@@ -43,6 +43,35 @@ def test_step_runner_records_ok_and_failed(tmp_path):
     assert r.exit_code() == 1
 
 
+def test_step_runner_embeds_stage_telemetry(tmp_path):
+    """A step whose body records pipeline stage timings (observability
+    plane) gets them embedded in its manifest record; steps that record
+    nothing stay stage-free — including a step AFTER a recording one (the
+    runner clears the handoff slot per step)."""
+    from tse1m_tpu.observability import StageRecorder, record_last_stages
+
+    def staged_step():
+        rec = StageRecorder()
+        rec.add("encode", 0.5, 1 << 20)
+        rec.add("h2d", 2.0, 1 << 20)
+        rec.add("compute", 1.75)
+        rec.set_total(2.5)
+        record_last_stages(rec.as_dict())
+        return 1
+
+    man = str(tmp_path / "m.json")
+    r = StepRunner(man)
+    r.run("cluster", staged_step)
+    r.run("plain", lambda: 2)
+    by_name = {s["name"]: s for s in _read(man)["steps"]}
+    stages = by_name["cluster"]["stages"]
+    assert stages["stage_h2d_s"] == 2.0
+    assert stages["stage_encode_mb"] == 1.0
+    # sum(stages)=4.25, wall=2.5 -> 1.75 s hidden, all of it h2d time
+    assert stages["h2d_overlap_fraction"] == 0.875
+    assert by_name["plain"]["stages"] is None
+
+
 def test_step_runner_all_ok_exit_zero(tmp_path):
     man = str(tmp_path / "m.json")
     r = StepRunner(man)

@@ -40,6 +40,18 @@ def _activate_config_fault_plan() -> None:
         log.warning("fault plan active from config: %s", plan_path)
 
 
+def _activate_xla_cache() -> None:
+    """Enable the persistent XLA compilation cache when configured
+    (``xla_cache_dir`` under ``[FRAMEWORK]``, or TSE1M_XLA_CACHE_DIR) —
+    repeat CLI runs then skip every kernel recompile."""
+    path = load_config().xla_cache_dir
+    if path:
+        from .utils.compat import enable_persistent_compilation_cache
+
+        if enable_persistent_compilation_cache(path):
+            log.info("persistent XLA compilation cache: %s", path)
+
+
 def _cmd_synth(args) -> int:
     from .data.synth import SynthSpec, generate_study
 
@@ -403,6 +415,7 @@ def main(argv=None) -> int:
 
     args = ap.parse_args(argv)
     _activate_config_fault_plan()
+    _activate_xla_cache()
     return args.fn(args)
 
 

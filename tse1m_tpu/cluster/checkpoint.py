@@ -81,10 +81,18 @@ class ClusterCheckpoint:
             prior_meta = {k: v for k, v in prior.items()
                           if k != "chunks_done"}
             if prior_meta != self.meta:
+                # The meta diff, not the raw dicts: a long chunks_done
+                # list would bury the one key that actually differs
+                # (e.g. wire_quant_bits — shards hold signatures of the
+                # QUANTIZED universe, so a policy change means every
+                # shard is wrong for this run).
+                diff = {k: (prior_meta.get(k), self.meta.get(k))
+                        for k in set(prior_meta) | set(self.meta)
+                        if prior_meta.get(k) != self.meta.get(k)}
                 raise ValueError(
                     f"checkpoint at {directory} belongs to a different "
                     "run (items or params changed); use a fresh directory "
-                    f"or delete it. have={prior}, want={self.meta}")
+                    f"or delete it. mismatched (have, want): {diff}")
             self.done = set(prior["chunks_done"])
             log.info("resuming cluster run: %d/%d chunks already done",
                      len(self.done), self.n_chunks)

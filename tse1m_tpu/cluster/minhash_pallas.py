@@ -112,3 +112,132 @@ def minhash_and_keys(items, a, b, n_bands: int, *, use_pallas: str = "auto",
         return sig[:n], keys[:n]
     sig = minhash_signatures(jnp.asarray(items), jnp.asarray(a), jnp.asarray(b))
     return sig, band_keys(sig, n_bands)
+
+
+# ---------------------------------------------------------------------------
+# Fused byte-unpack MinHash: consume the wire's byte-packed payload
+# directly, so decoded uint32 items never round-trip HBM (the decode is a
+# VMEM-resident combine in the same pass that hashes).  Offsets fold into
+# the hash's additive constant — h(x + off) = x*a + (off*a + b) — so the
+# signatures are bit-identical to decode-then-hash.
+
+def _kernel_packed(items_ref, a_ref, b_ref, sig_ref, keys_ref, *,
+                   n_bands: int, k: int):
+    """items_ref: [BN, S*k] uint8, element j's little-endian bytes at
+    columns [j*k, (j+1)*k).  Same static-unroll structure as _kernel."""
+    items = items_ref[...]
+    a = a_ref[...]
+    b = b_ref[...]
+    bn, sk = items.shape
+    s = sk // k
+    h = a.shape[0]
+
+    bias = jnp.uint32(0x80000000)
+    acc = jnp.full((bn, h), 0x7FFFFFFF, dtype=jnp.int32)
+    for j in range(s):
+        col = items[:, j * k:(j + 1) * k].astype(jnp.uint32)  # static slice
+        x = col[:, 0:1]
+        for t in range(1, k):
+            x = x | (col[:, t:t + 1] << jnp.uint32(8 * t))
+        hashed = x * a[None, :] + b[None, :]
+        acc = jnp.minimum(acc, jax.lax.bitcast_convert_type(
+            hashed ^ bias, jnp.int32))
+    sig = jax.lax.bitcast_convert_type(acc, jnp.uint32) ^ bias
+    sig_ref[...] = sig
+
+    r = h // n_bands
+    salt = _FNV_OFFSET + jax.lax.broadcasted_iota(jnp.uint32, (bn, n_bands), 1)
+    keys = salt
+    for j in range(r):
+        x = sig[:, j * n_bands:(j + 1) * n_bands]
+        keys = (keys ^ x) * _FNV_PRIME
+    keys_ref[...] = keys
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("k", "n_bands", "block_n", "interpret"))
+def _minhash_packed_pallas(payload2d, a, b, k: int, n_bands: int,
+                           block_n: int, interpret: bool):
+    from jax.experimental import pallas as pl
+
+    n, sk = payload2d.shape
+    h = a.shape[0]
+    assert n % block_n == 0, (n, block_n)
+    grid = (n // block_n,)
+    return pl.pallas_call(
+        functools.partial(_kernel_packed, n_bands=n_bands, k=k),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_n, sk), lambda i: (i, 0)),
+            pl.BlockSpec((h,), lambda i: (0,)),
+            pl.BlockSpec((h,), lambda i: (0,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_n, h), lambda i: (i, 0)),
+            pl.BlockSpec((block_n, n_bands), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, h), jnp.uint32),
+            jax.ShapeDtypeStruct((n, n_bands), jnp.uint32),
+        ],
+        interpret=interpret,
+    )(payload2d, a.astype(jnp.uint32), b.astype(jnp.uint32))
+
+
+@functools.partial(jax.jit, static_argnames=("shape", "k"))
+def _combine_bytes(payload, shape: tuple, k: int, offset):
+    """Fallback device decode for the byte-packed wire (jnp, off-pallas):
+    [rows*S*k] uint8 -> [rows, S] uint32 (+ offset)."""
+    rows, s = shape
+    p = payload.reshape(rows, s, k).astype(jnp.uint32)
+    x = p[..., 0]
+    for t in range(1, k):
+        x = x | (p[..., t] << jnp.uint32(8 * t))
+    return x + jnp.asarray(offset, jnp.uint32)
+
+
+# One-shot breaker: if Mosaic rejects the uint8 fused kernel on some TPU
+# generation, fall back to decode-then-hash for the rest of the process
+# instead of failing every chunk (the unfused path is bit-identical).
+_FUSED_UNPACK_OK = True
+
+
+def minhash_and_keys_packed(payload_d, shape: tuple, k: int, offset, a, b,
+                            n_bands: int, *, use_pallas: str = "auto",
+                            block_n: int = 512):
+    """minhash_and_keys over a byte-packed wire chunk.
+
+    payload_d: flat uint8 device array, `shape` = (rows, S) decoded shape,
+    `k` = bytes per value, `offset` = per-chunk bias (folded into b).
+    Signatures/keys are bit-identical to decoding first — the pipeline
+    relies on this for cross-encoding label parity.
+    """
+    global _FUSED_UNPACK_OK
+    rows, s = shape
+    if use_pallas == "auto":
+        use_pallas = "force" if jax.default_backend() == "tpu" else "never"
+    if use_pallas in ("force", "interpret") and rows and _FUSED_UNPACK_OK:
+        a = jnp.asarray(a).astype(jnp.uint32)
+        b = jnp.asarray(b).astype(jnp.uint32)
+        # Fold the offset bias into the additive hash constant.
+        b_eff = b + jnp.asarray(offset, jnp.uint32) * a
+        payload2d = payload_d.reshape(rows, s * k)
+        pad = (-rows) % block_n
+        if pad:
+            payload2d = jnp.concatenate(
+                [payload2d, jnp.zeros((pad, s * k), dtype=jnp.uint8)], axis=0)
+        try:
+            sig, keys = _minhash_packed_pallas(
+                payload2d, a, b_eff, k, n_bands, block_n,
+                use_pallas == "interpret")
+            return sig[:rows], keys[:rows]
+        except Exception as e:  # Mosaic lowering gap: unfuse, don't fail
+            _FUSED_UNPACK_OK = False
+            from ..utils.logging import get_logger
+
+            get_logger("cluster.pallas").warning(
+                "fused byte-unpack kernel unavailable (%s: %s); "
+                "falling back to decode-then-hash", type(e).__name__, e)
+    items = _combine_bytes(payload_d, (rows, s), k, offset)
+    return minhash_and_keys(items, a, b, n_bands, use_pallas=use_pallas,
+                            block_n=block_n)

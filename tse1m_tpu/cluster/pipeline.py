@@ -1,19 +1,30 @@
 """End-to-end device clustering pipeline with mesh sharding.
 
 Single-device: one jitted chain items -> signatures -> band keys -> bucket
-reps -> verified edges -> propagated labels, fed over the H2D link by the
-base-delta wire encoding (cluster/encode.py) when it pays.
+reps -> verified edges -> propagated labels, fed over the H2D link by a
+wire-size-aware streaming layer: ids optionally quantized into a smaller
+universe (encode.quantize_ids — b-bit-minwise argument, lossy but
+ARI-neutral), near-duplicate rows base-delta encoded (cluster/encode.py)
+when it pays, and every chunk bit-packed at its own adaptive width
+(encode.pack_chunk).  Chunks stream double-buffered: a producer thread
+packs chunk k+1 and has its device_put in flight while the main thread
+runs MinHash on chunk k, so encode/transfer/compute overlap instead of
+serializing (BENCH_r05: 1.86 s compute inside a 15.2 s wall — the wire
+was the bottleneck).  Per-stage walls land in observability.StageRecorder
+and `last_run_info["stages"]`.
 
 Multi-device: MinHash + band keys stay row-sharded (embarrassingly
 data-parallel); the bucket/verify/propagate tail is band-sharded with an
 explicit `shard_map` kernel (cluster/sharded.py) — `all_to_all` re-shards
 the keys so each device sorts only B/d bands, and label propagation
-reduces across devices with `pmin`.  Labels are bit-identical to the
-single-device path in both cases.
+reduces across devices with `pmin`.  The mesh feed ships 24-bit packed
+bytes (unpacked inside the shard_map kernel) when ids allow.  Labels are
+bit-identical to the single-device path in both cases.
 """
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from functools import partial
 
@@ -21,10 +32,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .encode import (_AUTO_MIN_BYTES, _AUTO_MIN_DELTA_FRACTION, encode_delta)
+from ..observability import StageRecorder, record_last_stages
+from .encode import (_AUTO_MIN_BYTES, _AUTO_MIN_DELTA_FRACTION,
+                     _AUTO_QUANT_BITS, ChunkWire, encode_delta,
+                     pack_bits_host, pack_chunk, pack_delta_meta,
+                     quantize_ids, width_bits)
 from .lsh import bucket_representatives, estimated_jaccard, propagate_labels
 from .minhash import band_keys, make_hash_params, minhash_signatures
-from .minhash_pallas import minhash_and_keys
+from .minhash_pallas import minhash_and_keys, minhash_and_keys_packed
 
 
 @dataclass(frozen=True)
@@ -40,21 +55,34 @@ class ClusterParams:
     use_pallas: str = "auto"     # auto | never | force | interpret
     block_n: int = 512
     # H2D double-buffering: split the item axis into this many chunks and
-    # device_put each one separately — jax transfers are async, so chunk
-    # i+1 streams over the (slow, remote-PJRT) link while MinHash runs on
-    # chunk i.  0 = auto (chunk when items exceed _CHUNK_BYTES), 1 = off.
+    # stream each one — chunk i+1's host pack + device_put run on a
+    # producer thread while MinHash runs on chunk i.  0 = auto (chunk when
+    # items exceed _CHUNK_BYTES), 1 = off.
     h2d_chunks: int = 0
+    # Producer-thread overlap for the chunked stream.  False falls back to
+    # the sequential per-chunk loop (same chunks, same labels) — the A/B
+    # lever for the chaos tests and for debugging thread-related issues.
+    overlap: bool = True
     # H2D payload encoding (cluster/encode.py): 'auto' base-delta-encodes
-    # large inputs when enough rows are near-duplicates (the measured win:
-    # 183 -> ~104 MB on the 1M north star); 'delta' forces it; 'pack24'
-    # keeps the plain packed lane.  Labels are bit-identical either way
-    # (hub election is by original index — lsh.bucket_representatives).
+    # large inputs when enough rows are near-duplicates; 'delta' forces
+    # it; 'pack24' (historical name) ships the plain lane.  Either way
+    # every lane is adaptively bit-packed per chunk.  Labels are
+    # bit-identical across encodings (hub election is by original index —
+    # lsh.bucket_representatives).
     encoding: str = "auto"
+    # Lossy wire quantization: hash ids into a 2^b universe before
+    # anything ships (encode.quantize_ids).  0 = auto (engage
+    # _AUTO_QUANT_BITS when items exceed _AUTO_MIN_BYTES), -1 = never,
+    # 1..32 = forced width.  Applied identically to every encoding path,
+    # so cross-encoding label parity is preserved; accuracy is gated by
+    # the bench's ari_vs_planted >= 0.98.
+    wire_quant_bits: int = 0
 
 
 # Observability surface for bench.py: stats of the last single-host
 # cluster_sessions call (encoding chosen, lane sizes, wire bytes, host
-# encode seconds).  A plain dict, overwritten per call — not an API.
+# encode seconds, per-stage walls under "stages").  A plain dict,
+# overwritten per call — not an API.
 last_run_info: dict = {}
 
 
@@ -66,13 +94,6 @@ def _cluster_from_sig(sig, keys, threshold: float, n_iters: int):
     return propagate_labels(reps, valid, n_iters=n_iters)
 
 
-@partial(jax.jit, static_argnames=("n_bands", "threshold", "n_iters"))
-def _cluster_jax(items, a, b, n_bands: int, threshold: float, n_iters: int):
-    sig = minhash_signatures(items, a, b)
-    keys = band_keys(sig, n_bands)
-    return _cluster_from_sig(sig, keys, threshold, n_iters)
-
-
 # Module-level jit wrappers: wrapping inside cluster_sessions would key the
 # compile cache to a fresh function object per call and retrace every time.
 _cluster_from_sig_jit = jax.jit(
@@ -80,28 +101,19 @@ _cluster_from_sig_jit = jax.jit(
 
 
 @jax.jit
-def _decode_delta_packed(full_d, rep_d, counts_d, pos_d, val3_d):
+def _decode_delta_raw(full_d, rep_d, counts_d, pos_d, val_d):
     """Delta lane -> [D, S] uint32 rows, on device.
 
     Gather each delta row's base from the decoded full lane, then scatter
     its (position, value) diffs.  Flat diff stream is CSR-style: per-row
     counts cumsum to offsets; each flat slot finds its row by searchsorted.
     """
-    vals = _unpack24(val3_d)
     offsets = jnp.cumsum(counts_d.astype(jnp.int32))
     t = jnp.arange(pos_d.shape[0], dtype=jnp.int32)
     row = jnp.searchsorted(offsets, t, side="right").astype(jnp.int32)
-    base = full_d[rep_d]
-    return base.at[row, pos_d.astype(jnp.int32)].set(vals, mode="drop")
-
-
-@jax.jit
-def _decode_delta_raw(full_d, rep_d, counts_d, pos_d, val_d):
-    offsets = jnp.cumsum(counts_d.astype(jnp.int32))
-    t = jnp.arange(pos_d.shape[0], dtype=jnp.int32)
-    row = jnp.searchsorted(offsets, t, side="right").astype(jnp.int32)
-    base = full_d[rep_d]
-    return base.at[row, pos_d.astype(jnp.int32)].set(val_d, mode="drop")
+    base = full_d[rep_d.astype(jnp.int32)]
+    return base.at[row, pos_d.astype(jnp.int32)].set(
+        val_d.astype(jnp.uint32), mode="drop")
 
 
 @partial(jax.jit, static_argnames=("n", "threshold", "n_iters"))
@@ -140,6 +152,53 @@ def _validate_encoding(params: ClusterParams) -> None:
                          "expected auto | delta | pack24")
 
 
+def _quant_bits(items: np.ndarray, params: ClusterParams) -> int:
+    """Effective wire_quant_bits under the policy; 0 = off/no gain."""
+    b = params.wire_quant_bits
+    if b < 0 or items.size == 0:
+        return 0
+    if b == 0:
+        if items.nbytes < _AUTO_MIN_BYTES:
+            return 0
+        b = _AUTO_QUANT_BITS
+    if width_bits(int(items.max())) <= b:
+        return 0  # already at or below the target universe
+    return b
+
+
+def _maybe_quantize(items: np.ndarray,
+                    params: ClusterParams) -> tuple[np.ndarray, int]:
+    """Apply the wire_quant_bits policy; returns (items, effective bits)
+    with bits == 0 when quantization is off or gains nothing."""
+    b = _quant_bits(items, params)
+    return (quantize_ids(items, b) if b else items), b
+
+
+def _plan_wire(items: np.ndarray, params: ClusterParams):
+    """(items, enc, qbits): the single-host wire plan.
+
+    Order matters: the delta sketch groups on RAW ids — a quantized
+    universe collapses its (min, max) hash keys into a few hundred
+    distinct values, so chance collisions flood the verifier and the
+    encoder declines.  Quantization then applies elementwise to whatever
+    actually ships (full/val lanes, or the plain chunks).  Because
+    quantize_ids is per-value deterministic, delta decode reconstructs
+    exactly ``quantize_ids(items)`` on both paths, preserving
+    cross-encoding label parity."""
+    from dataclasses import replace
+
+    enc = _maybe_encode(items, params)
+    qbits = _quant_bits(items, params)
+    if qbits:
+        if enc is not None:
+            enc = replace(enc,
+                          full_rows=quantize_ids(enc.full_rows, qbits),
+                          val_flat=quantize_ids(enc.val_flat, qbits))
+        else:
+            items = quantize_ids(items, qbits)
+    return items, enc, qbits
+
+
 def _maybe_encode(items: np.ndarray, params: ClusterParams):
     """Apply the ClusterParams.encoding policy; None = ship plain lanes."""
     _validate_encoding(params)
@@ -151,42 +210,224 @@ def _maybe_encode(items: np.ndarray, params: ClusterParams):
     return encode_delta(items, min_delta_fraction=frac)
 
 
-def _cluster_encoded(items: np.ndarray, enc, a, b, params: ClusterParams,
-                     pack: bool) -> np.ndarray:
-    """Single-host encoded path: stream the full lane chunked (retaining
-    the decoded device rows), decode the delta lane against it, MinHash
-    both, cluster with original-order labels.
+# Auto-chunking threshold for H2D double-buffering: one chunk per
+# _CHUNK_BYTES of items, capped at _MAX_CHUNKS.  The cap is tuned for a
+# remote/tunneled PJRT link (round-4 sweep at 1M x 64: 8 chunks throttled
+# the link to ~21 MB/s vs ~27 MB/s for big single puts; 4 chunks kept big-
+# put bandwidth while still overlapping the ~1.8 s device compute behind
+# the transfer).
+_CHUNK_BYTES = 48 * 1024 * 1024
+_MAX_CHUNKS = 4
 
-    ``pack`` is the caller's should_pack24 decision over BOTH lanes: delta
-    values can exceed 2^24 even when every full-lane row packs, and the
-    wire format uses one width.
-    """
+# Ids at or above this value are shipped raw uint32 (the adaptive packer
+# refuses to pack the chunk) — the historical pack24 kill switch, kept as
+# a monkeypatchable escape hatch for the raw-wire path.
+_PACK_LIMIT = 1 << 24
+
+
+def should_pack24(items: np.ndarray) -> bool:
+    """True when `items` ids all fit the 24-bit universe (below
+    _PACK_LIMIT).  The adaptive packer (encode.pack_chunk) has superseded
+    this as the single-host wire decision; it remains THE mesh-feed pack
+    decision and a compat probe for external callers."""
+    return bool(items.size) and bool(items.max() < _PACK_LIMIT)
+
+
+def _stream_plan(items: np.ndarray, params: ClusterParams) -> int:
+    """Chunk step — THE chunking policy, shared by the streamed and
+    resumable paths so their chunks always align.  step >= n means
+    single-shot (chunking off or input too small to double-buffer); chunks
+    land on block_n boundaries so the pallas path pads at most the final
+    chunk."""
     n = items.shape[0]
+    n_chunks = params.h2d_chunks
+    if n_chunks == 0:
+        n_chunks = int(min(_MAX_CHUNKS, max(1, items.nbytes // _CHUNK_BYTES)))
+    if n_chunks <= 1 or n < 2 * params.block_n:
+        return max(n, 1)
+    step = -(-n // n_chunks)
+    return -(-step // params.block_n) * params.block_n
+
+
+@partial(jax.jit, static_argnames=("n", "bits"))
+def _unpack_bits(packed, n: int, bits: int, offset):
+    """uint8 bit stream -> [n] uint32 on device (little-endian bit order,
+    value i at stream bits [i*bits, (i+1)*bits), + offset bias).  Inverse
+    of encode.pack_bits_host; oracle: encode.unpack_bits_host.
+    Byte-multiple widths reshape-and-combine; sub-byte/odd widths gather
+    the (at most 5) bytes each value's bit window can span — out-of-range
+    tail reads are index-clamped and their bits always fall above the
+    width mask (see the contribution-bit argument in the PR notes)."""
+    if n == 0:
+        return jnp.zeros(0, jnp.uint32)
+    offset = jnp.asarray(offset, jnp.uint32)
+    if bits % 8 == 0:
+        k = bits // 8
+        b = packed[:n * k].reshape(n, k).astype(jnp.uint32)
+        out = b[:, 0]
+        for j in range(1, k):
+            out = out | (b[:, j] << jnp.uint32(8 * j))
+        return out + offset
+    start = jnp.arange(n, dtype=jnp.int32) * bits
+    byte0 = start >> 3
+    shift = (start & 7).astype(jnp.uint32)
+    idx = byte0[:, None] + jnp.arange(5, dtype=jnp.int32)[None, :]
+    b = packed[jnp.clip(idx, 0, packed.shape[0] - 1)].astype(jnp.uint32)
+    word0 = b[:, 0] | (b[:, 1] << 8) | (b[:, 2] << 16) | (b[:, 3] << 24)
+    low = word0 >> shift
+    hi = jnp.where(shift == 0, jnp.uint32(0),
+                   b[:, 4] << ((jnp.uint32(32) - shift) & jnp.uint32(31)))
+    val = (low | hi) & jnp.uint32((1 << bits) - 1)
+    return val + offset
+
+
+def _decode_wire(payload_d, wire: ChunkWire):
+    """Device payload + header -> decoded uint32 array of wire.shape."""
+    flat = _unpack_bits(payload_d, wire.n_values, wire.bits,
+                        np.uint32(wire.offset))
+    return flat.reshape(wire.shape)
+
+
+def _produce_chunk(chunk: np.ndarray, rec: StageRecorder):
+    """Host half of one chunk: adaptive pack (encode stage) + device_put
+    with a completion wait (h2d stage).  Runs on the producer thread when
+    overlap is on, so both stages hide behind the main thread's compute.
+    The wait doubles as backpressure — at most one chunk is being staged
+    beyond the one in flight.  (Over a tunneled PJRT link
+    block_until_ready can return before the wire drains; the h2d wall
+    then underreports and the surplus shows up in compute — documented in
+    PARITY.md.)"""
+    t0 = time.perf_counter()
+    wire = pack_chunk(chunk, _PACK_LIMIT)
+    rec.add("encode", time.perf_counter() - t0, wire.nbytes)
+    t0 = time.perf_counter()
+    payload_d = jax.device_put(wire.payload)
+    payload_d.block_until_ready()
+    rec.add("h2d", time.perf_counter() - t0, wire.nbytes)
+    return payload_d, wire
+
+
+def _iter_streamed(chunks: list, rec: StageRecorder, overlap: bool):
+    """Yield (device payload, ChunkWire) per chunk, double-buffered: with
+    overlap on (and >1 chunk), chunk k+1's pack + device_put run on a
+    single producer thread while the caller computes on chunk k.  JAX
+    transfers and dispatch are async, so transfer k+1 is on the wire
+    during compute k even on backends whose device_put returns early."""
+    if not overlap or len(chunks) <= 1:
+        for c in chunks:
+            yield _produce_chunk(c, rec)
+        return
+    from concurrent.futures import ThreadPoolExecutor
+
+    ex = ThreadPoolExecutor(max_workers=1, thread_name_prefix="tse1m-h2d")
+    try:
+        fut = ex.submit(_produce_chunk, chunks[0], rec)
+        for k in range(len(chunks)):
+            cur = fut.result()
+            if k + 1 < len(chunks):
+                fut = ex.submit(_produce_chunk, chunks[k + 1], rec)
+            yield cur
+    finally:
+        ex.shutdown(wait=False, cancel_futures=True)
+
+
+def _chunk_minhash(payload_d, wire: ChunkWire, a, b, params: ClusterParams,
+                   rec: StageRecorder, want_decoded: bool):
+    """One chunk's device half: decode + fused MinHash/band keys (compute
+    stage).  Byte-width chunks take the pallas fused-unpack kernel when
+    available (decoded bytes never round-trip HBM); ``want_decoded``
+    forces a materialized decode (the encoded path needs the full-lane
+    rows resident for the delta scatter)."""
     kw = dict(use_pallas=params.use_pallas, block_n=params.block_n)
-    full = enc.full_rows
-    step, _ = _stream_plan(full, params, pack)
-    chunks_d, parts = [], []
-    for i in range(0, full.shape[0], step):
-        cd = _put_chunk(full[i:i + step], pack)
+    with rec.stage("compute"):
+        decoded = None
+        if want_decoded or wire.bits % 8 != 0:
+            decoded = _decode_wire(payload_d, wire)
+            sig, keys = minhash_and_keys(decoded, a, b, params.n_bands, **kw)
+        else:
+            sig, keys = minhash_and_keys_packed(
+                payload_d, wire.shape, wire.bits // 8,
+                np.uint32(wire.offset), a, b, params.n_bands, **kw)
+        jax.block_until_ready(keys)
+    return sig, keys, decoded
+
+
+def _row_chunks(rows: np.ndarray, step: int) -> list:
+    return [rows[i:i + step] for i in range(0, max(rows.shape[0], 1), step)]
+
+
+def _put_delta_meta(enc, rec: StageRecorder):
+    """Pack the delta lanes (encode stage) and ship mask + rep + counts +
+    pos + val as ONE pytree device_put (h2d stage) — one dispatch instead
+    of the five sequential puts the previous layout paid (each put costs a
+    link round-trip over tunneled PJRT)."""
+    t0 = time.perf_counter()
+    meta = pack_delta_meta(enc)
+    rec.add("encode", time.perf_counter() - t0, meta.nbytes)
+    t0 = time.perf_counter()
+    mask_d, rep_d, counts_d, pos_d, val_d = jax.device_put(
+        (enc.mask_bits, meta.rep, meta.counts, meta.pos, meta.val.payload))
+    jax.block_until_ready((mask_d, rep_d, counts_d, pos_d, val_d))
+    rec.add("h2d", time.perf_counter() - t0, meta.nbytes)
+    return meta, mask_d, rep_d, counts_d, pos_d, val_d
+
+
+def _decode_delta_meta(meta, enc, full_d, rep_d, counts_d, pos_d, val_d):
+    """Unpack the bit-packed delta lanes on device and scatter-decode the
+    delta rows against the resident full lane."""
+    rep = _unpack_bits(rep_d, enc.n_delta, meta.rep_bits, np.uint32(0))
+    counts = _unpack_bits(counts_d, enc.n_delta, meta.counts_bits,
+                          np.uint32(0))
+    pos = _unpack_bits(pos_d, int(enc.pos_flat.shape[0]), meta.pos_bits,
+                       np.uint32(0))
+    vals = _unpack_bits(val_d, meta.val.n_values, meta.val.bits,
+                        np.uint32(meta.val.offset))
+    return _decode_delta_raw(full_d, rep, counts, pos, vals)
+
+
+def _cluster_encoded(items: np.ndarray, enc, a, b, params: ClusterParams,
+                     rec: StageRecorder) -> np.ndarray:
+    """Single-host encoded path: stream the full lane chunked + double-
+    buffered (retaining the decoded device rows), decode the delta lane
+    against it, MinHash both, cluster with original-order labels."""
+    n = items.shape[0]
+    step = _stream_plan(enc.full_rows, params)
+    chunks_d, parts, wire_bits = [], [], []
+    for payload_d, wire in _iter_streamed(_row_chunks(enc.full_rows, step),
+                                          rec, params.overlap):
+        sig, keys, cd = _chunk_minhash(payload_d, wire, a, b, params, rec,
+                                       want_decoded=True)
+        wire_bits.append(wire.bits)
         chunks_d.append(cd)
-        parts.append(minhash_and_keys(cd, a, b, params.n_bands, **kw))
+        parts.append((sig, keys))
     full_d = chunks_d[0] if len(chunks_d) == 1 else jnp.concatenate(chunks_d)
-    rep_d = jax.device_put(enc.rep_in_full)
-    counts_d = jax.device_put(enc.counts)
-    pos_d = jax.device_put(enc.pos_flat)
-    if pack:
-        delta_items = _decode_delta_packed(
-            full_d, rep_d, counts_d, pos_d,
-            jax.device_put(_pack24_host(enc.val_flat)))
-    else:
-        delta_items = _decode_delta_raw(full_d, rep_d, counts_d, pos_d,
-                                        jax.device_put(enc.val_flat))
-    dsig, dkeys = minhash_and_keys(delta_items, a, b, params.n_bands, **kw)
-    sig = jnp.concatenate([p[0] for p in parts] + [dsig])
-    keys = jnp.concatenate([p[1] for p in parts] + [dkeys])
-    labels = _cluster_encoded_labels(sig, keys, jax.device_put(enc.mask_bits),
-                                     n, params.threshold, params.n_iters)
-    return np.asarray(labels)
+    meta, mask_d, rep_d, counts_d, pos_d, val_d = _put_delta_meta(enc, rec)
+    with rec.stage("compute"):
+        delta_items = _decode_delta_meta(meta, enc, full_d, rep_d, counts_d,
+                                         pos_d, val_d)
+        dsig, dkeys = minhash_and_keys(delta_items, a, b, params.n_bands,
+                                       use_pallas=params.use_pallas,
+                                       block_n=params.block_n)
+        sig = jnp.concatenate([p[0] for p in parts] + [dsig])
+        keys = jnp.concatenate([p[1] for p in parts] + [dkeys])
+        labels = _cluster_encoded_labels(sig, keys, mask_d, n,
+                                         params.threshold, params.n_iters)
+        jax.block_until_ready(labels)
+    last_run_info["chunk_bits"] = wire_bits
+    with rec.stage("d2h", nbytes=labels.size * 4):
+        out = np.asarray(labels)
+    return out
+
+
+def _wire_mb(rec: StageRecorder) -> float:
+    return round(rec.nbytes.get("h2d", 0) / 2**20, 2)
+
+
+def _finish_run(rec: StageRecorder, t0: float) -> None:
+    rec.set_total(time.perf_counter() - t0)
+    stages = rec.as_dict()
+    last_run_info["stages"] = stages
+    record_last_stages(stages)
 
 
 def cluster_sessions(items, params: ClusterParams | None = None,
@@ -203,21 +444,21 @@ def cluster_sessions(items, params: ClusterParams | None = None,
     a, b = jnp.asarray(a), jnp.asarray(b)
 
     if mesh is not None:
-        # The base-delta wire encoding is a single-host H2D optimisation;
-        # mesh feeding ships raw shards (multi-host rows never transit one
-        # host's link), so params.encoding does not apply here — but a
-        # typo'd value must still fail here, not only in local testing.
+        # The base-delta + adaptive-width wire encoding is a single-host
+        # H2D optimisation; mesh feeding ships raw shards or the 24-bit
+        # byte pack (unpacked inside the shard_map kernel) — but a typo'd
+        # encoding value must still fail here, not only in local testing.
         _validate_encoding(params)
+        rec = StageRecorder()
+        t_all = time.perf_counter()
         last_run_info.clear()
-        last_run_info.update(encoding="mesh-raw")
         from ..parallel.mesh import pad_to_devices
 
-        sharding = jax.sharding.NamedSharding(
-            mesh, jax.sharding.PartitionSpec(axis, None))
         if isinstance(items, jax.Array):
             # Pre-sharded global array (the multi-host feeding path:
             # parallel/multihost.put_process_local — no single host holds
-            # all rows, so there is nothing to pad or device_put here).
+            # all rows, so there is nothing to pad, pack or device_put
+            # here).
             if items.shape[0] % mesh.devices.size:
                 raise ValueError(
                     "pre-sharded items must be padded to a multiple of the "
@@ -226,110 +467,84 @@ def cluster_sessions(items, params: ClusterParams | None = None,
                     "the logical row count")
             n = items.shape[0]
             items_d = items
+            packed = False
+            last_run_info.update(encoding="mesh-presharded")
         else:
             items = np.ascontiguousarray(items, dtype=np.uint32)
+            if params.wire_quant_bits > 0:  # explicit only: mesh links are
+                #                             local/ICI, auto stays off
+                items, qb = _maybe_quantize(items, params)
+                last_run_info.update(wire_quant_bits=qb)
             n = items.shape[0]
             items, _ = pad_to_devices(items, mesh)
-            items_d = jax.device_put(items, sharding)
+            packed = should_pack24(items)
+            with rec.stage("encode"):
+                payload = _pack24_host(items) if packed else items
+            sharding = jax.sharding.NamedSharding(
+                mesh, jax.sharding.PartitionSpec(
+                    axis, *([None] * (payload.ndim - 1))))
+            with rec.stage("h2d", nbytes=payload.nbytes):
+                items_d = jax.device_put(payload, sharding)
+                items_d.block_until_ready()
+            last_run_info.update(
+                encoding="mesh-pack24" if packed else "mesh-raw",
+                wire_mb=round(payload.nbytes / 2**20, 2))
         from .sharded import _sharded_cluster_kernel
 
         # Band-sharded tail (cluster/sharded.py): distributes the
         # bucket/verify/propagate stages, not just MinHash.
         kernel = _sharded_cluster_kernel(mesh, axis, params.n_bands,
-                                         params.threshold, params.n_iters)
-        labels = kernel(items_d, a, b)
+                                         params.threshold, params.n_iters,
+                                         packed=packed)
+        with rec.stage("compute"):
+            labels = kernel(items_d, a, b)
+            jax.block_until_ready(labels)
         if jax.process_count() > 1:
             # Multi-host: shards live on non-addressable devices, so a
             # plain np.asarray would fail — allgather across processes
             # (rides DCN; every host gets the full label vector).
             from jax.experimental import multihost_utils
 
-            return np.asarray(
-                multihost_utils.process_allgather(labels, tiled=True))[:n]
-        return np.asarray(labels)[:n]
+            with rec.stage("d2h"):
+                out = np.asarray(
+                    multihost_utils.process_allgather(labels,
+                                                      tiled=True))[:n]
+            _finish_run(rec, t_all)
+            return out
+        with rec.stage("d2h"):
+            out = np.asarray(labels)[:n]
+        _finish_run(rec, t_all)
+        return out
+
     items = np.ascontiguousarray(items, dtype=np.uint32)
-
-    import time as _time
-
-    t0 = _time.perf_counter()
-    enc = _maybe_encode(items, params)
-    pack = should_pack24(items)  # once: a full O(N*S) max scan
+    rec = StageRecorder()
+    t_all = time.perf_counter()
     last_run_info.clear()
+
+    t0 = time.perf_counter()
+    items, enc, qbits = _plan_wire(items, params)
+    rec.add("encode", time.perf_counter() - t0)
+    last_run_info.update(wire_quant_bits=qbits)
     if enc is not None:
         last_run_info.update(
-            encoding="delta", encode_s=round(_time.perf_counter() - t0, 4),
-            n_full=enc.n_full, n_delta=enc.n_delta,
-            wire_mb=round(enc.wire_bytes(pack) / 2**20, 1))
-        return _cluster_encoded(items, enc, a, b, params, pack)
+            encoding="delta", encode_s=round(time.perf_counter() - t0, 4),
+            n_full=enc.n_full, n_delta=enc.n_delta)
+        out = _cluster_encoded(items, enc, a, b, params, rec)
+        last_run_info["wire_mb"] = _wire_mb(rec)
+        _finish_run(rec, t_all)
+        return out
 
-    if params.use_pallas != "never":
-        last_run_info.update(
-            encoding="pack24" if pack else "raw",
-            wire_mb=round(items.shape[0] * items.shape[1]
-                          * (3 if pack else 4) / 2**20, 1))
-        sig, keys = _minhash_streamed(items, a, b, params, pack)
+    last_run_info.update(encoding="plain")
+    sig, keys = _minhash_streamed(items, a, b, params, rec)
+    with rec.stage("compute"):
         labels = _cluster_from_sig_jit(sig, keys, params.threshold,
                                        params.n_iters)
-        return np.asarray(labels)
-
-    # Explicit H2D placement up front (no device argument — keeps the array
-    # uncommitted so callers can still steer with jax.default_device).
-    # This two-step path ships raw uint32 (no 24-bit pack) — report it so.
-    last_run_info.update(encoding="raw",
-                         wire_mb=round(items.nbytes / 2**20, 1))
-    return np.asarray(_cluster_jax(jax.device_put(items), a, b,
-                                   params.n_bands, params.threshold,
-                                   params.n_iters))
-
-
-# Auto-chunking threshold for H2D double-buffering: one chunk per
-# _CHUNK_BYTES of items, capped at _MAX_CHUNKS.  The cap is tuned for a
-# remote/tunneled PJRT link (round-4 sweep at 1M x 64: 8 chunks throttled
-# the link to ~21 MB/s vs ~27 MB/s for big single puts; 4 chunks kept big-
-# put bandwidth while still overlapping the ~1.8 s device compute behind
-# the transfer).
-_CHUNK_BYTES = 48 * 1024 * 1024
-_MAX_CHUNKS = 4
-
-# Feature ids below 2^24 (the OSS-Fuzz coverage-region universe, and the
-# synth generator's default) travel as 3 packed bytes instead of a uint32
-# — a 25% cut of the dominant H2D transfer.  Inputs with larger ids fall
-# back to raw uint32 transparently.
-_PACK_LIMIT = 1 << 24
-
-
-def should_pack24(items: np.ndarray) -> bool:
-    """True when `items` takes the 24-bit packed H2D encoding (feature ids
-    all below _PACK_LIMIT) — THE pack decision the streamed pipeline ships;
-    probes (bench.py) must use this, not re-derive it."""
-    return bool(items.size) and bool(items.max() < _PACK_LIMIT)
-
-
-def _stream_plan(items: np.ndarray, params: ClusterParams,
-                 pack: bool | None = None) -> tuple[int, bool]:
-    """(chunk step, pack?) — THE chunking policy, shared by the streamed
-    and resumable paths so their chunks always align.  step >= n means
-    single-shot (chunking off or input too small to double-buffer); chunks
-    land on block_n boundaries so the pallas path pads at most the final
-    chunk.  ``pack`` skips the O(N*S) should_pack24 max scan when the
-    caller already decided it."""
-    n = items.shape[0]
-    n_chunks = params.h2d_chunks
-    if n_chunks == 0:
-        n_chunks = int(min(_MAX_CHUNKS, max(1, items.nbytes // _CHUNK_BYTES)))
-    if pack is None:
-        pack = should_pack24(items)
-    if n_chunks <= 1 or n < 2 * params.block_n:
-        return max(n, 1), pack
-    step = -(-n // n_chunks)
-    return -(-step // params.block_n) * params.block_n, pack
-
-
-def _put_chunk(chunk: np.ndarray, pack: bool):
-    """Stage one chunk on device (24-bit packed when the plan says so)."""
-    if pack:
-        return _unpack24(jax.device_put(_pack24_host(chunk)))
-    return jax.device_put(chunk)
+        jax.block_until_ready(labels)
+    with rec.stage("d2h", nbytes=labels.size * 4):
+        out = np.asarray(labels)
+    last_run_info["wire_mb"] = _wire_mb(rec)
+    _finish_run(rec, t_all)
+    return out
 
 
 @jax.jit
@@ -340,7 +555,8 @@ def _unpack24(packed):
 
 
 def _pack24_host(chunk: np.ndarray) -> np.ndarray:
-    """[n, S] uint32 (< 2^24) -> contiguous [n, S, 3] uint8 byte view."""
+    """[n, S] uint32 (< 2^24) -> contiguous [n, S, 3] uint8 byte view
+    (the mesh feed's pack; single-host chunks use encode.pack_chunk)."""
     if chunk.dtype.byteorder == ">":  # big-endian hosts: normalize first
         chunk = chunk.astype("<u4")
     return np.ascontiguousarray(
@@ -355,10 +571,13 @@ def cluster_sessions_resumable(items, params: ClusterParams | None = None,
     Each streamed chunk's (signatures, band keys) shard persists under
     ``checkpoint_dir`` as it completes (`cluster/checkpoint.py`); a killed
     run re-invoked with the same directory recomputes only unfinished
-    chunks, then proceeds to label propagation.  ``cleanup`` removes the
-    shards after a successful run.  With no directory this is exactly
-    `cluster_sessions`.  Single-host form; a pod job gives each process
-    its own directory for its local row range.
+    chunks, then proceeds to label propagation.  Pending chunks stream
+    through the same double-buffered producer as the non-checkpointed
+    path — the shard save (the kill window the chaos tests aim at) stays
+    on the main thread, strictly after that chunk's compute.  ``cleanup``
+    removes the shards after a successful run.  With no directory this is
+    exactly `cluster_sessions`.  Single-host form; a pod job gives each
+    process its own directory for its local row range.
     """
     params = params or ClusterParams()
     if checkpoint_dir is None:
@@ -371,13 +590,28 @@ def cluster_sessions_resumable(items, params: ClusterParams | None = None,
         return np.empty(0, np.int32)
     a, b = make_hash_params(params.n_hashes, params.seed)
     a, b = jnp.asarray(a), jnp.asarray(b)
-    kw = dict(use_pallas=params.use_pallas, block_n=params.block_n)
-    enc = _maybe_encode(items, params)
+    rec = StageRecorder()
+    t_all = time.perf_counter()
+    last_run_info.clear()
+    t0 = time.perf_counter()
+    # Shards hold signatures of the QUANTIZED universe, so a resume under
+    # a different quantization policy must read as a different run and
+    # refuse — the manifest meta carries the effective bits.
+    items, enc, qbits = _plan_wire(items, params)
+    rec.add("encode", time.perf_counter() - t0)
+    last_run_info.update(wire_quant_bits=qbits)
 
     if enc is None:
-        step, pack = _stream_plan(items, params)  # same chunks as streamed
-        ckpt = ClusterCheckpoint(checkpoint_dir, items, params, step)
-        parts = []
+        last_run_info.update(encoding="plain")
+        step = _stream_plan(items, params)  # same chunks as streamed
+        # The quant key appears only when quantization engaged: shard
+        # contents are unchanged otherwise, and the symmetric manifest
+        # comparison already refuses a quantized<->unquantized resume.
+        ckpt = ClusterCheckpoint(checkpoint_dir, items, params, step,
+                                 extra=({"wire_quant_bits": qbits}
+                                        if qbits else None))
+        parts: dict = {}
+        pending = []
         for idx, i in enumerate(range(0, n, step)):
             # A shard that exists but is torn (truncated npz) reads as
             # not-done and the chunk recomputes — resume must produce the
@@ -385,21 +619,34 @@ def cluster_sessions_resumable(items, params: ClusterParams | None = None,
             shard = (ckpt.load_chunk_or_none(idx)
                      if ckpt.chunk_done(idx) else None)
             if shard is not None:
-                parts.append((jax.device_put(shard[0]),
-                              jax.device_put(shard[1])))
+                with rec.stage("h2d", nbytes=shard[0].nbytes
+                               + shard[1].nbytes):
+                    parts[idx] = (jax.device_put(shard[0]),
+                                  jax.device_put(shard[1]))
                 continue
-            sig, keys = minhash_and_keys(_put_chunk(items[i:i + step], pack),
-                                         a, b, params.n_bands, **kw)
+            pending.append((idx, items[i:i + step]))
+        stream = _iter_streamed([c for _, c in pending], rec, params.overlap)
+        for (idx, _), (payload_d, wire) in zip(pending, stream):
+            sig, keys, _ = _chunk_minhash(payload_d, wire, a, b, params, rec,
+                                          want_decoded=False)
             # D2H for durability: the persisted shard IS the resume state.
-            ckpt.save_chunk(idx, np.asarray(sig), np.asarray(keys))
-            parts.append((sig, keys))
-        sig = jnp.concatenate([p[0] for p in parts])
-        keys = jnp.concatenate([p[1] for p in parts])
-        labels = np.asarray(_cluster_from_sig_jit(sig, keys, params.threshold,
-                                                  params.n_iters))
+            with rec.stage("d2h"):
+                sig_h, keys_h = np.asarray(sig), np.asarray(keys)
+            ckpt.save_chunk(idx, sig_h, keys_h)
+            parts[idx] = (sig, keys)
+        with rec.stage("compute"):
+            sig = jnp.concatenate([parts[i][0] for i in sorted(parts)])
+            keys = jnp.concatenate([parts[i][1] for i in sorted(parts)])
+            labels = _cluster_from_sig_jit(sig, keys, params.threshold,
+                                           params.n_iters)
+            jax.block_until_ready(labels)
+        with rec.stage("d2h", nbytes=labels.size * 4):
+            out = np.asarray(labels)
         if cleanup:
             ckpt.cleanup()
-        return labels
+        last_run_info["wire_mb"] = _wire_mb(rec)
+        _finish_run(rec, t_all)
+        return out
 
     # Encoded layout: one shard per full-lane chunk + one delta-lane shard.
     # The lane split is part of the manifest (it decides what each shard
@@ -408,90 +655,141 @@ def cluster_sessions_resumable(items, params: ClusterParams | None = None,
     # instead of concatenating mismatched shards.
     import hashlib
 
+    last_run_info.update(encoding="delta", n_full=enc.n_full,
+                         n_delta=enc.n_delta)
     full = enc.full_rows
-    pack = should_pack24(items)  # one width for both lanes
-    step, _ = _stream_plan(full, params, pack)
+    step = _stream_plan(full, params)
     n_full_chunks = max(1, -(-full.shape[0] // step))
     lane_fp = hashlib.blake2b(
         enc.mask_bits.tobytes() + enc.counts.tobytes(),
         digest_size=16).hexdigest()
+    extra = {"encoding": "delta", "lane_fingerprint": lane_fp}
+    if qbits:
+        extra["wire_quant_bits"] = qbits
     ckpt = ClusterCheckpoint(checkpoint_dir, items, params, step,
-                             extra={"encoding": "delta",
-                                    "lane_fingerprint": lane_fp},
-                             n_chunks=n_full_chunks + 1)
-    parts = []
+                             extra=extra, n_chunks=n_full_chunks + 1)
+    parts = {}
     chunks_d: list = [None] * n_full_chunks
+    pending = []
     for idx, i in enumerate(range(0, full.shape[0], step)):
         shard = (ckpt.load_chunk_or_none(idx)
                  if ckpt.chunk_done(idx) else None)
         if shard is not None:
-            parts.append((jax.device_put(shard[0]),
-                          jax.device_put(shard[1])))
+            with rec.stage("h2d", nbytes=shard[0].nbytes + shard[1].nbytes):
+                parts[idx] = (jax.device_put(shard[0]),
+                              jax.device_put(shard[1]))
             continue
-        cd = _put_chunk(full[i:i + step], pack)
+        pending.append((idx, full[i:i + step]))
+    stream = _iter_streamed([c for _, c in pending], rec, params.overlap)
+    for (idx, _), (payload_d, wire) in zip(pending, stream):
+        sig, keys, cd = _chunk_minhash(payload_d, wire, a, b, params, rec,
+                                       want_decoded=True)
         chunks_d[idx] = cd
-        sig, keys = minhash_and_keys(cd, a, b, params.n_bands, **kw)
-        ckpt.save_chunk(idx, np.asarray(sig), np.asarray(keys))
-        parts.append((sig, keys))
+        with rec.stage("d2h"):
+            sig_h, keys_h = np.asarray(sig), np.asarray(keys)
+        ckpt.save_chunk(idx, sig_h, keys_h)
+        parts[idx] = (sig, keys)
     didx = n_full_chunks
     dshard = ckpt.load_chunk_or_none(didx) if ckpt.chunk_done(didx) else None
     if dshard is not None:
-        dpart = (jax.device_put(dshard[0]), jax.device_put(dshard[1]))
+        with rec.stage("h2d", nbytes=dshard[0].nbytes + dshard[1].nbytes):
+            dpart = (jax.device_put(dshard[0]), jax.device_put(dshard[1]))
     else:
         # Delta decode needs the full lane device-resident; chunks whose
         # shards were loaded from disk never shipped their rows this run,
         # so put them now (raw rows only — their signatures are done).
         for idx, i in enumerate(range(0, full.shape[0], step)):
             if chunks_d[idx] is None:
-                chunks_d[idx] = _put_chunk(full[i:i + step], pack)
+                payload_d, wire = _produce_chunk(full[i:i + step], rec)
+                with rec.stage("compute"):
+                    chunks_d[idx] = _decode_wire(payload_d, wire)
         full_d = (chunks_d[0] if len(chunks_d) == 1
                   else jnp.concatenate(chunks_d))
-        rep_d = jax.device_put(enc.rep_in_full)
-        counts_d = jax.device_put(enc.counts)
-        pos_d = jax.device_put(enc.pos_flat)
-        if pack:
-            delta_items = _decode_delta_packed(
-                full_d, rep_d, counts_d, pos_d,
-                jax.device_put(_pack24_host(enc.val_flat)))
-        else:
-            delta_items = _decode_delta_raw(full_d, rep_d, counts_d, pos_d,
-                                            jax.device_put(enc.val_flat))
-        dsig, dkeys = minhash_and_keys(delta_items, a, b, params.n_bands,
-                                       **kw)
-        ckpt.save_chunk(didx, np.asarray(dsig), np.asarray(dkeys))
+        meta, mask_d, rep_d, counts_d, pos_d, val_d = _put_delta_meta(enc,
+                                                                      rec)
+        with rec.stage("compute"):
+            delta_items = _decode_delta_meta(meta, enc, full_d, rep_d,
+                                             counts_d, pos_d, val_d)
+            dsig, dkeys = minhash_and_keys(delta_items, a, b, params.n_bands,
+                                           use_pallas=params.use_pallas,
+                                           block_n=params.block_n)
+        with rec.stage("d2h"):
+            dsig_h, dkeys_h = np.asarray(dsig), np.asarray(dkeys)
+        ckpt.save_chunk(didx, dsig_h, dkeys_h)
         dpart = (dsig, dkeys)
-    sig = jnp.concatenate([p[0] for p in parts] + [dpart[0]])
-    keys = jnp.concatenate([p[1] for p in parts] + [dpart[1]])
-    labels = np.asarray(_cluster_encoded_labels(
-        sig, keys, jax.device_put(enc.mask_bits), n, params.threshold,
-        params.n_iters))
+    with rec.stage("compute"):
+        sig = jnp.concatenate([parts[i][0] for i in sorted(parts)]
+                              + [dpart[0]])
+        keys = jnp.concatenate([parts[i][1] for i in sorted(parts)]
+                               + [dpart[1]])
+        labels = _cluster_encoded_labels(
+            sig, keys, jax.device_put(enc.mask_bits), n, params.threshold,
+            params.n_iters)
+        jax.block_until_ready(labels)
+    with rec.stage("d2h", nbytes=labels.size * 4):
+        out = np.asarray(labels)
     if cleanup:
         ckpt.cleanup()
-    return labels
+    last_run_info["wire_mb"] = _wire_mb(rec)
+    _finish_run(rec, t_all)
+    return out
 
 
 def _minhash_streamed(items: np.ndarray, a, b, params: ClusterParams,
-                      pack: bool | None = None):
-    """items -> (signatures, band keys), overlapping H2D with compute.
+                      rec: StageRecorder):
+    """items -> (signatures, band keys), overlapping encode + H2D with
+    compute.
 
-    The ~N*S*4-byte items transfer is the dominant wall-time cost on a
-    remote/tunneled PJRT backend, while MinHash itself is cheap.  jax's
-    device_put and kernel dispatch are both async, so transferring the item
-    axis in chunks lets chunk i+1 stream while chunk i computes.  Chunks are
-    equal-sized (the last may be short), so at most two kernel shapes are
-    compiled.  Results are concatenated on device; labels are unchanged vs
-    the unchunked path because MinHash is row-independent.
+    The ~N*S-byte items transfer is the dominant wall-time cost on a
+    remote/tunneled PJRT backend, while MinHash itself is cheap.  Chunks
+    are equal-sized (the last may be short), so at most two kernel shapes
+    are compiled.  Results are concatenated on device; labels are
+    unchanged vs the unchunked path because MinHash is row-independent.
     """
-    n = items.shape[0]
-    step, pack = _stream_plan(items, params, pack)
-    kw = dict(use_pallas=params.use_pallas, block_n=params.block_n)
-    if step >= n:
-        return minhash_and_keys(_put_chunk(items, pack), a, b,
-                                params.n_bands, **kw)
-    parts = []
-    for i in range(0, n, step):
-        parts.append(minhash_and_keys(_put_chunk(items[i:i + step], pack),
-                                      a, b, params.n_bands, **kw))
+    step = _stream_plan(items, params)
+    parts, wire_bits = [], []
+    for payload_d, wire in _iter_streamed(_row_chunks(items, step), rec,
+                                          params.overlap):
+        sig, keys, _ = _chunk_minhash(payload_d, wire, a, b, params, rec,
+                                      want_decoded=False)
+        wire_bits.append(wire.bits)
+        parts.append((sig, keys))
+    last_run_info["chunk_bits"] = wire_bits
+    if len(parts) == 1:
+        return parts[0]
     sig = jnp.concatenate([p[0] for p in parts])
     keys = jnp.concatenate([p[1] for p in parts])
     return sig, keys
+
+
+def wire_payloads(items, params: ClusterParams | None = None):
+    """(payloads, info): the EXACT host->device payload arrays the single-
+    host pipeline would ship for `items` under `params` — quantization,
+    delta lanes and adaptive bit-packing included.  bench.py's transfer
+    probe times these, so the probe cannot drift from the shipped format.
+    """
+    params = params or ClusterParams()
+    _validate_encoding(params)
+    items = np.ascontiguousarray(items, dtype=np.uint32)
+    items, enc, qbits = _plan_wire(items, params)
+    payloads, chunk_bits = [], []
+    if enc is None:
+        step = _stream_plan(items, params)
+        for chunk in _row_chunks(items, step):
+            wire = pack_chunk(chunk, _PACK_LIMIT)
+            payloads.append(wire.payload)
+            chunk_bits.append(wire.bits)
+        info = dict(encoding="plain")
+    else:
+        step = _stream_plan(enc.full_rows, params)
+        for chunk in _row_chunks(enc.full_rows, step):
+            wire = pack_chunk(chunk, _PACK_LIMIT)
+            payloads.append(wire.payload)
+            chunk_bits.append(wire.bits)
+        meta = pack_delta_meta(enc)
+        payloads += [enc.mask_bits, meta.rep, meta.counts, meta.pos,
+                     meta.val.payload]
+        info = dict(encoding="delta", n_full=enc.n_full, n_delta=enc.n_delta)
+    info.update(wire_quant_bits=qbits, chunk_bits=chunk_bits,
+                wire_mb=round(sum(p.nbytes for p in payloads) / 2**20, 2))
+    return payloads, info

@@ -42,7 +42,7 @@ from .minhash import band_keys, minhash_signatures
 
 @lru_cache(maxsize=32)
 def _sharded_cluster_kernel(mesh, axis: str, n_bands: int, threshold: float,
-                            n_iters: int):
+                            n_iters: int, packed: bool = False):
     # lru_cache'd factory (parallel/rq_mesh.py pattern): a jit wrapper
     # built per call would discard its compile cache every time.
     n_dev = mesh.shape[axis]
@@ -50,6 +50,14 @@ def _sharded_cluster_kernel(mesh, axis: str, n_bands: int, threshold: float,
     # bands keyed by global row id — every dummy bucket is a singleton, so
     # its rep is itself and it contributes no edges (label-neutral).
     pad_bands = (-n_bands) % n_dev
+
+    # ``packed``: the feed ships [N, S, 3] uint8 (pipeline._pack24_host)
+    # instead of raw uint32 — a 25% cut of the mesh H2D placement — and
+    # each device unpacks only its own row shard here, inside the
+    # shard_map body, so decoded bytes never cross the host link.  The
+    # combine is plain jnp (not pallas): it fuses into the row-local
+    # MinHash chain under jit.
+    items_spec = P(axis, None, None) if packed else P(axis, None)
 
     # check_vma off: the shared row-local kernels (minhash_signatures,
     # band_keys) build fori_loop carries with jnp.full/iota — replicated in
@@ -59,8 +67,11 @@ def _sharded_cluster_kernel(mesh, axis: str, n_bands: int, threshold: float,
     # propagation reductions cross the mesh through `pmin`.
     @jax.jit
     @partial(shard_map, mesh=mesh, check_vma=False,
-             in_specs=(P(axis, None), P(None), P(None)), out_specs=P(None))
+             in_specs=(items_spec, P(None), P(None)), out_specs=P(None))
     def kernel(items_loc, a, b):
+        if packed:
+            p = items_loc.astype(jnp.uint32)               # [N/d, S, 3]
+            items_loc = p[..., 0] | (p[..., 1] << 8) | (p[..., 2] << 16)
         sig_loc = minhash_signatures(items_loc, a, b)      # [N/d, H]
         keys_loc = band_keys(sig_loc, n_bands)             # [N/d, B]
         if pad_bands:

@@ -77,6 +77,14 @@ class Config:
     # Per-statement timeout: Postgres `SET statement_timeout`, sqlite
     # busy_timeout.  0 = engine default (off).
     db_statement_timeout_ms: int = 0
+    # -- observability / dispatch (observability/, utils/compat.py) --------
+    # Persistent XLA compilation-cache directory (None = off).  Repeat
+    # runs skip kernel recompiles — each fresh compile costs several
+    # dispatch round-trips (129 ms each on the measured tunneled-PJRT
+    # link).  Activated by cli startup and bench.py via
+    # utils.compat.enable_persistent_compilation_cache; env override
+    # TSE1M_XLA_CACHE_DIR.
+    xla_cache_dir: str | None = None
 
     @property
     def result_ok(self) -> tuple[str, ...]:
@@ -122,6 +130,7 @@ def load_config(ini_path: str | None = None) -> Config:
                                                  cfg.db_retry_max_delay)
             cfg.db_statement_timeout_ms = fw.getint(
                 "db_statement_timeout_ms", cfg.db_statement_timeout_ms)
+            cfg.xla_cache_dir = fw.get("xla_cache_dir", cfg.xla_cache_dir)
 
     cfg.backend = os.environ.get("TSE1M_BACKEND", cfg.backend)
     cfg.engine = os.environ.get("TSE1M_ENGINE", cfg.engine)
@@ -131,6 +140,8 @@ def load_config(ini_path: str | None = None) -> Config:
     if "TSE1M_TEST_MODE" in os.environ:
         cfg.test_mode = os.environ["TSE1M_TEST_MODE"].lower() in ("1", "true", "yes")
     cfg.fault_plan = os.environ.get("TSE1M_FAULT_PLAN", cfg.fault_plan)
+    cfg.xla_cache_dir = os.environ.get("TSE1M_XLA_CACHE_DIR",
+                                       cfg.xla_cache_dir)
     if "TSE1M_DB_RETRY_ATTEMPTS" in os.environ:
         cfg.db_retry_attempts = int(os.environ["TSE1M_DB_RETRY_ATTEMPTS"])
     if "TSE1M_DB_STATEMENT_TIMEOUT_MS" in os.environ:

@@ -1,0 +1,127 @@
+"""Per-stage pipeline telemetry (encode / h2d / compute / d2h).
+
+The north-star wall is dominated by stages a single wall clock cannot
+separate: host bit-pack encoding, the H2D transfer, device compute, and
+the label fetch.  BENCH_r05 showed 1.86 s of device compute inside a
+15.2 s wall — the other 13 s were wire and host encode, invisible in the
+bench JSON.  This module is the one place those stages are measured:
+
+- :class:`StageRecorder` — thread-safe per-stage (wall seconds, bytes)
+  accumulator.  The double-buffered streaming pipeline records `encode`
+  and `h2d` from its producer thread while `compute` accrues on the main
+  thread, so summed stage walls exceed the elapsed wall exactly when the
+  overlap works; :meth:`as_dict` reports that surplus as
+  ``h2d_overlap_fraction`` (fraction of H2D seconds hidden behind the
+  other stages — 0 means fully sequential, 1 means the wire was free).
+- a module-level handoff slot (:func:`record_last_stages` /
+  :func:`pop_last_stages`) so layers that cannot see each other —
+  `cluster/pipeline.py` producing timings, `resilience/runner.py`
+  embedding them into ``run_manifest.json``, `bench.py` emitting
+  ``stage_*`` keys — share one record without coupling their APIs.
+
+Stage names are part of the bench-JSON contract (``stage_<name>_s`` /
+``stage_<name>_mb`` keys, PARITY.md "Wire format & streaming pipeline"):
+``encode`` host-side packing, ``h2d`` host->device transfer, ``compute``
+device dispatch+wait, ``d2h`` device->host result fetch.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import defaultdict
+
+STAGES = ("encode", "h2d", "compute", "d2h")
+
+
+class StageRecorder:
+    """Accumulates (wall seconds, payload bytes) per pipeline stage.
+
+    Thread-safe: the streaming pipeline's producer thread records encode
+    and h2d concurrently with the main thread's compute.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.wall: dict[str, float] = defaultdict(float)
+        self.nbytes: dict[str, int] = defaultdict(int)
+        self.total_wall_s: float = 0.0
+
+    def add(self, stage: str, seconds: float, nbytes: int = 0) -> None:
+        with self._lock:
+            self.wall[stage] += seconds
+            self.nbytes[stage] += nbytes
+
+    @contextlib.contextmanager
+    def stage(self, name: str, nbytes: int = 0):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add(name, time.perf_counter() - t0, nbytes)
+
+    def set_total(self, seconds: float) -> None:
+        self.total_wall_s = seconds
+
+    def h2d_overlap_fraction(self) -> float:
+        """Fraction of H2D seconds hidden behind other stages.
+
+        ``hidden = sum(stage walls) - elapsed wall`` is the time at least
+        two stages ran concurrently; expressing it as a fraction of the
+        H2D wall answers the question the double-buffer exists for: how
+        much of the wire time did compute/encode absorb?
+        """
+        h2d = self.wall.get("h2d", 0.0)
+        if h2d <= 0.0 or self.total_wall_s <= 0.0:
+            return 0.0
+        hidden = sum(self.wall.values()) - self.total_wall_s
+        return round(min(1.0, max(0.0, hidden / h2d)), 4)
+
+    def as_dict(self) -> dict:
+        """Flat bench-JSON form: stage_<name>_s / stage_<name>_mb keys."""
+        out: dict = {}
+        for name in sorted(self.wall):
+            out[f"stage_{name}_s"] = round(self.wall[name], 4)
+            if self.nbytes.get(name):
+                out[f"stage_{name}_mb"] = round(self.nbytes[name] / 2**20, 2)
+        if self.total_wall_s:
+            out["stage_total_wall_s"] = round(self.total_wall_s, 4)
+        out["h2d_overlap_fraction"] = self.h2d_overlap_fraction()
+        return out
+
+
+# -- cross-layer handoff ----------------------------------------------------
+# Last completed run's stage dict.  Written by the pipeline (and anything
+# else that times stages), consumed destructively by resilience.StepRunner
+# (into run_manifest.json) and non-destructively by bench.py.  A plain
+# slot, not an API: one producer at a time, same contract as
+# cluster.pipeline.last_run_info.
+_last_stages: dict | None = None
+_last_lock = threading.Lock()
+
+
+def record_last_stages(stages: dict) -> None:
+    global _last_stages
+    with _last_lock:
+        _last_stages = dict(stages)
+
+
+def peek_last_stages() -> dict | None:
+    with _last_lock:
+        return dict(_last_stages) if _last_stages is not None else None
+
+
+def pop_last_stages() -> dict | None:
+    """Take (and clear) the last run's stage record — StepRunner calls
+    this after each step so a step that timed nothing doesn't inherit a
+    predecessor's stages."""
+    global _last_stages
+    with _last_lock:
+        out = _last_stages
+        _last_stages = None
+        return out
+
+
+__all__ = ["STAGES", "StageRecorder", "record_last_stages",
+           "peek_last_stages", "pop_last_stages"]

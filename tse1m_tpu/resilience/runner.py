@@ -31,6 +31,10 @@ class StepRecord:
     error: str | None = None      # one-line summary
     traceback: str | None = None  # full text, failures only
     detail: str | None = None     # e.g. why a step was skipped/missing
+    # Per-stage pipeline telemetry (observability.StageRecorder as_dict:
+    # stage_*_s / stage_*_mb / h2d_overlap_fraction) when the step's body
+    # recorded any — e.g. a cluster step's encode/h2d/compute/d2h split.
+    stages: dict | None = None
 
 
 class StepRunner:
@@ -56,10 +60,14 @@ class StepRunner:
     def run(self, name: str, fn, *args, **kwargs) -> StepRecord:
         """Run one step isolated; never raises (the record carries the
         failure)."""
+        from ..observability import pop_last_stages
+
         rec = StepRecord(name=name, status="running")
         self.steps.append(rec)
         t0 = time.time()
         attempts = [0]
+        pop_last_stages()  # drop a predecessor's stages; only telemetry
+        #                    recorded BY this step may attach to it
 
         def attempt():
             attempts[0] += 1
@@ -82,6 +90,7 @@ class StepRunner:
                 raise
         rec.attempts = attempts[0]
         rec.wall_s = round(time.time() - t0, 3)
+        rec.stages = pop_last_stages()
         self._write()
         return rec
 
