@@ -250,7 +250,7 @@ def _timed_h2d(payload, reps: int = 3) -> tuple:
     samples = []
     for _ in range(reps):
         t0 = time.perf_counter()
-        d = jax.device_put(payload)
+        d = jax.device_put(payload)  # graftlint: disable=wire-layer -- raw-link probe measures the wire itself
         int(d[(0,) * payload.ndim])
         samples.append(time.perf_counter() - t0)
     med = statistics.median(samples)
@@ -281,6 +281,21 @@ def main() -> int:
                    help="if >0, also ARI-check a host-clustered subsample "
                         "(the BASELINE.json acceptance gate: >= 0.98 vs the "
                         "CPU/pandas baseline)")
+    p.add_argument("--sanitize", action="store_true",
+                   default=os.environ.get("BENCH_SANITIZE", "")
+                   not in ("", "0"),
+                   help="run the timed iterations under the runtime "
+                        "sanitizer (tse1m_tpu/lint/runtime.py): implicit "
+                        "host->device transfers raise, and the XLA compile "
+                        "count must stay within --compile-budget (also "
+                        "BENCH_SANITIZE=1)")
+    p.add_argument("--compile-budget", type=int,
+                   default=int(os.environ.get("BENCH_COMPILE_BUDGET", 2)),
+                   help="max XLA compiles allowed during the timed "
+                        "steady-state iterations under --sanitize (the "
+                        "warmup run compiles everything first; steady "
+                        "state should be 0 — 2 leaves headroom for "
+                        "backend-dependent constant folding)")
     args = p.parse_args()
     iters = max(1, args.iters)
 
@@ -312,30 +327,44 @@ def main() -> int:
     profile_dir = os.environ.get("TSE1M_PROFILE_DIR")
 
     def timed(prm):
+        """Timed steady-state runs; under --sanitize the whole window runs
+        with the transfer guard up and a compile budget — a warm hot loop
+        that implicitly stages bytes or recompiles fails the bench instead
+        of silently regressing (lint/runtime.py)."""
         import contextlib
 
+        sanitize_ctx = contextlib.nullcontext()
+        if args.sanitize:
+            from tse1m_tpu.lint.runtime import sanitized
+
+            sanitize_ctx = sanitized(args.compile_budget)
         runs = []
-        for i in range(iters):
-            ctx = contextlib.nullcontext()
-            if profile_dir and i == 0:
-                ctx = jax.profiler.trace(
-                    os.path.join(profile_dir, "cluster"))
-            t0 = time.perf_counter()
-            with ctx:
-                labels = cluster_sessions(items, prm)
-            runs.append(time.perf_counter() - t0)
-        return labels, runs
+        with sanitize_ctx as san:
+            for i in range(iters):
+                ctx = contextlib.nullcontext()
+                if profile_dir and i == 0:
+                    ctx = jax.profiler.trace(
+                        os.path.join(profile_dir, "cluster"))
+                t0 = time.perf_counter()
+                with ctx:
+                    labels = cluster_sessions(items, prm)
+                runs.append(time.perf_counter() - t0)
+        return labels, runs, san
 
     try:
         cluster_sessions(items, params)  # compile + warm
-        labels, runs = timed(params)
-    except Exception as e:  # pallas path unavailable on this backend
+        labels, runs, sanitizer = timed(params)
+    except Exception as e:  # pallas path unavailable on this backend  # graftlint: disable=broad-except -- probe fallback; bench must run on every backend
+        from tse1m_tpu.lint.runtime import SanitizerViolation
+
+        if isinstance(e, SanitizerViolation):
+            raise  # a sanitizer trip is the regression, not a missing path
         print(f"# pallas path failed ({type(e).__name__}: {e}); "
               "falling back to fused-jax", file=sys.stderr)
         params = ClusterParams(n_hashes=args.hashes, n_bands=args.bands,
                                use_pallas="never")
         cluster_sessions(items, params)
-        labels, runs = timed(params)
+        labels, runs, sanitizer = timed(params)
 
     wall = statistics.median(runs)
     # Snapshot now: the ARI subsample below runs cluster_sessions again and
@@ -369,7 +398,7 @@ def main() -> int:
         from tse1m_tpu.cluster.pipeline import _cluster_from_sig_jit
 
         a, b = make_hash_params(params.n_hashes, params.seed)
-        items_d = jax.device_put(items)
+        items_d = jax.device_put(items)  # graftlint: disable=wire-layer -- compute-only probe pre-stages items to exclude the link
         float(items_d[0, 0])  # finish the staging transfer
         samples = []
         for _ in range(3):
@@ -385,7 +414,7 @@ def main() -> int:
 
     try:
         compute_s = compute_only()
-    except Exception as e:
+    except Exception as e:  # graftlint: disable=broad-except -- optional probe; bench JSON stays valid without it
         print(f"# compute-only probe failed ({type(e).__name__}: {e})",
               file=sys.stderr)
         compute_s = None
@@ -418,7 +447,7 @@ def main() -> int:
         samples = []
         for _ in range(3):
             t0 = time.perf_counter()
-            ds = [jax.device_put(p) for p in payloads]
+            ds = [jax.device_put(p) for p in payloads]  # graftlint: disable=wire-layer -- transfer probe times the pipeline's own payloads
             int(_touch(*ds))
             samples.append(time.perf_counter() - t0)
         med = statistics.median(samples)
@@ -437,7 +466,7 @@ def main() -> int:
 
     try:
         transfer_stats = transfer_probe()
-    except Exception as e:
+    except Exception as e:  # graftlint: disable=broad-except -- optional probe; bench JSON stays valid without it
         print(f"# transfer probe failed ({type(e).__name__}: {e})",
               file=sys.stderr)
         transfer_stats = {}
@@ -481,9 +510,14 @@ def main() -> int:
     result.update({f"cluster_{k}": v for k, v in cluster_info.items()})
     result.update(stage_info)
     result.update(transfer_stats)
+    if sanitizer is not None:
+        # Runtime-sanitizer proof for this bench round: the timed window
+        # ran under the transfer guard (zero implicit H2D transfers, or it
+        # would have raised) within the compile budget.
+        result.update(sanitizer.as_dict())
     try:
         result.update(bench_link())
-    except Exception as e:
+    except Exception as e:  # graftlint: disable=broad-except -- optional probe; bench JSON stays valid without it
         print(f"# link probe failed ({type(e).__name__}: {e})",
               file=sys.stderr)
     if args.extract_builds > 0:

@@ -1,0 +1,20 @@
+"""Host ops and traced-value control flow inside jit bodies."""
+import jax
+import numpy as np
+
+
+@jax.jit
+def uses_numpy(x):
+    return x + np.float32(1.0)
+
+
+@jax.jit
+def syncs(x):
+    return float(x[0]) + x.sum().item()
+
+
+@jax.jit
+def branches(x):
+    if x > 0:
+        return x
+    return -x

@@ -1,0 +1,7 @@
+"""Final-path write-mode open: a crash mid-write leaves a torn file."""
+import json
+
+
+def save(path, payload):
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f)

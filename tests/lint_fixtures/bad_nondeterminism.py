@@ -1,0 +1,12 @@
+"""Wall clock + global RNG in a chaos-replayed plane (spoofed path)."""
+import random
+import time
+from datetime import datetime
+
+
+def jittery_wait():
+    time.sleep(random.uniform(0.0, 0.1))
+
+
+def stamp():
+    return time.time(), datetime.now()

@@ -1,0 +1,10 @@
+"""Direct HTTP / raw cursor calls skipping the retry engine."""
+import requests
+
+
+def fetch(url):
+    return requests.get(url, timeout=5)
+
+
+def raw_sql(db, sql):
+    return db.cursor.execute(sql)

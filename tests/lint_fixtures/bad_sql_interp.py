@@ -1,0 +1,13 @@
+"""Raw identifier/value interpolation into SQL text."""
+
+
+def count_rows(db, table):
+    return db.query(f"SELECT COUNT(*) FROM {table}")
+
+
+def fmt(table):
+    return "DELETE FROM {}".format(table)
+
+
+def percent(table):
+    return "DROP TABLE %s" % table

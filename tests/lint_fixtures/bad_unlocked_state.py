@@ -1,0 +1,30 @@
+"""Lock-owning class mutating shared state outside its lock."""
+import threading
+
+
+class Recorder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0.0
+
+    def add(self, x):
+        with self._lock:
+            self.total += x
+
+    def reset(self):
+        self.total = 0.0
+
+
+_lock = threading.Lock()
+_shared = None
+
+
+def set_shared(v):
+    global _shared
+    with _lock:
+        _shared = v
+
+
+def clear_shared():
+    global _shared
+    _shared = None

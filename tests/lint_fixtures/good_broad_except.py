@@ -1,0 +1,40 @@
+"""Narrow, guarded, chained, or suppressed handlers all pass."""
+from tse1m_tpu.resilience import InjectedFault, reraise_if_fault
+
+
+def narrow(path):
+    try:
+        return open(path).read()
+    except (OSError, ValueError):
+        return None
+
+
+def guarded(fn):
+    try:
+        return fn()
+    except Exception as e:
+        reraise_if_fault(e)
+        return None
+
+
+def isinstance_guard(fn):
+    try:
+        return fn()
+    except Exception as e:
+        if isinstance(e, InjectedFault):
+            raise
+        return None
+
+
+def chained(fn):
+    try:
+        return fn()
+    except Exception as e:
+        raise RuntimeError("wrapped") from e
+
+
+def suppressed(fn):
+    try:
+        return fn()
+    except Exception:  # graftlint: disable=broad-except -- fixture reason
+        return None

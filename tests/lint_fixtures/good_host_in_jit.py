@@ -1,0 +1,22 @@
+"""jnp ops, static-arg control flow, and untraced helpers all pass."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@partial(jax.jit, static_argnames=("bits",))
+def unpack(x, bits):
+    if bits % 8 == 0:
+        return x >> jnp.uint32(bits)
+    return x & jnp.uint32((1 << bits) - 1)
+
+
+@jax.jit
+def pure_device(x):
+    return jnp.where(x > 0, x, -x)
+
+
+def host_helper(x):
+    return float(np.asarray(x)[0])

@@ -1,0 +1,22 @@
+"""tmp+rename (inline or via utils.atomic) and read modes all pass."""
+import json
+import os
+
+from tse1m_tpu.utils.atomic import atomic_write
+
+
+def save_inline(path, payload):
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
+
+
+def save_helper(path, payload):
+    with atomic_write(path) as f:
+        json.dump(payload, f)
+
+
+def load(path):
+    with open(path, "r", encoding="utf-8") as f:
+        return json.load(f)

@@ -1,0 +1,14 @@
+"""Seeded RNG, monotonic clocks, caller-passed dates all pass."""
+import random
+import time
+
+
+_RNG = random.Random(0)
+
+
+def jittery_wait():
+    time.sleep(_RNG.uniform(0.0, 0.1))
+
+
+def elapsed(t0):
+    return time.monotonic() - t0

@@ -1,0 +1,10 @@
+"""I/O through the blessed layers passes."""
+from tse1m_tpu.collect.transport import FetchPolicy, HttpFetcher
+
+
+def fetch(url):
+    return HttpFetcher(FetchPolicy()).get(url)
+
+
+def through_db(db, sql, params):
+    return db.query(sql, params)

@@ -1,0 +1,19 @@
+"""Blessed interpolations: ident helpers, int(), placeholder lists."""
+from tse1m_tpu.db.ident import col_list, quote_ident
+
+
+def count_rows(db, table):
+    return db.query(f"SELECT COUNT(*) FROM {quote_ident(table)}")
+
+
+def insert(table, cols):
+    ph = ", ".join("?" * len(cols))
+    return f"INSERT INTO {quote_ident(table)} ({col_list(cols)}) VALUES ({ph})"
+
+
+def timeout(ms):
+    return f"SET statement_timeout = {int(ms)}"
+
+
+def no_sql(name):
+    return f"hello {name}"

@@ -1,0 +1,24 @@
+"""Every shared mutation under the lock (or no lock declared at all)."""
+import threading
+
+
+class Recorder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0.0
+
+    def add(self, x):
+        with self._lock:
+            self.total += x
+
+    def reset(self):
+        with self._lock:
+            self.total = 0.0
+
+
+class PlainCounter:  # no lock: single-threaded by design, out of scope
+    def __init__(self):
+        self.n = 0
+
+    def bump(self):
+        self.n += 1

@@ -1,0 +1,100 @@
+"""Runtime sanitizer (tse1m_tpu/lint/runtime.py): the transfer guard
+catches implicit host->device staging, the compile counter sees real XLA
+compiles, and the cluster hot loop passes BOTH warm — zero implicit
+transfers, zero steady-state compiles."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tse1m_tpu.lint.runtime import (CompileCounter, SanitizerViolation,
+                                    no_implicit_transfers, sanitized,
+                                    self_check)
+
+
+def test_compile_counter_sees_fresh_compile():
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda v: v * 3 + 1)
+    x = jnp.arange(7)
+    with CompileCounter() as cold:
+        f(x).block_until_ready()
+    assert cold.count is not None and cold.count >= 1
+    with CompileCounter() as warm:
+        f(x).block_until_ready()
+    assert warm.count == 0
+    with CompileCounter() as reshaped:
+        f(jnp.arange(13)).block_until_ready()
+    assert reshaped.count >= 1
+
+
+def test_transfer_guard_blocks_implicit_staging():
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda a, s: a + s)
+    x = jnp.arange(4, dtype=jnp.uint32)
+    f(x, np.uint32(3))  # compile with the implicit-staging call shape
+    with no_implicit_transfers() as active:
+        assert active
+        with pytest.raises(Exception, match="[Dd]isallow"):
+            f(x, np.uint32(3))          # np scalar staged implicitly
+        jax.device_put(np.arange(3))    # explicit staging stays legal
+
+
+def test_sanitized_enforces_compile_budget():
+    import jax
+    import jax.numpy as jnp
+
+    g = jax.jit(lambda v: v - 2)
+    with pytest.raises(SanitizerViolation, match="compile budget"):
+        with sanitized(compile_budget=0):
+            g(jnp.arange(31)).block_until_ready()  # fresh shape: compiles
+    # the report still carries what happened when no budget is set
+    with sanitized() as report:
+        g(jnp.arange(57)).block_until_ready()
+    assert report.compile_count >= 1
+    assert report.transfer_guard_active is True
+
+
+def test_self_check():
+    out = self_check()
+    assert out["sanitizer_available"] is True
+    assert out["sanitizer_compile_count"] == 0
+    assert out["sanitizer_transfer_guard"] is True
+
+
+@pytest.mark.parametrize("encoding", ["auto", "delta", "pack24"])
+def test_cluster_hot_loop_is_sanitizer_clean(encoding):
+    """THE acceptance property: a warm cluster run performs zero implicit
+    host->device transfers and zero XLA compiles, for every wire
+    encoding — labels unchanged under the guard."""
+    from tse1m_tpu.cluster import ClusterParams, cluster_sessions
+    from tse1m_tpu.data.synth import synth_session_sets
+
+    items, _ = synth_session_sets(3000, seed=3)
+    params = ClusterParams(encoding=encoding, h2d_chunks=2)
+    warm = cluster_sessions(items, params)  # compile + stage everything
+    with sanitized(compile_budget=0) as report:
+        labels = cluster_sessions(items, params)
+    np.testing.assert_array_equal(labels, warm)
+    assert report.compile_count == 0
+    assert report.transfer_guard_active is True
+
+
+def test_cluster_resumable_is_sanitizer_clean(tmp_path):
+    """The checkpointed path (shard save/load included) also stays
+    implicit-transfer-free."""
+    from tse1m_tpu.cluster import ClusterParams, cluster_sessions_resumable
+    from tse1m_tpu.data.synth import synth_session_sets
+
+    items, _ = synth_session_sets(2500, seed=5)
+    params = ClusterParams(encoding="pack24", h2d_chunks=2)
+    warm = cluster_sessions_resumable(items, params,
+                                      checkpoint_dir=str(tmp_path / "a"))
+    with sanitized(compile_budget=0):
+        labels = cluster_sessions_resumable(
+            items, params, checkpoint_dir=str(tmp_path / "b"))
+    np.testing.assert_array_equal(labels, warm)

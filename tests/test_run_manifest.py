@@ -168,7 +168,13 @@ def test_cli_all_runs_survivors_and_reports_failures(tmp_path, stub_rqs,
         assert os.path.exists(os.path.join(out, short + ".ran")), short
     payload = _read(os.path.join(out, "run_manifest.json"))
     by_name = {s["name"]: s for s in payload["steps"]}
-    assert set(by_name) == {"rq1", "rq2a", "rq2b", "rq3", "rq4a", "rq4b"}
+    assert set(by_name) == {"graftlint", "rq1", "rq2a", "rq2b", "rq3",
+                            "rq4a", "rq4b"}
+    # the correctness step records its structured summary per run
+    lint = by_name["graftlint"]
+    assert lint["status"] == "ok"
+    assert lint["result"]["new_findings"] == 0
+    assert lint["result"]["runtime"]["sanitizer_available"] is True
     assert by_name["rq3"]["status"] == "failed"
     assert "permanent rq fault" in by_name["rq3"]["error"]
     assert "permanent rq fault" in by_name["rq3"]["traceback"]

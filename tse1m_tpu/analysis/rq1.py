@@ -23,6 +23,7 @@ from ..config import Config
 from ..db import queries
 from ..db.ingest import parse_array, pg_array_literal
 from ..utils.logging import get_logger
+from ..utils.atomic import atomic_write
 from ..utils.manifest import RunManifest
 from ..utils.timing import PhaseTimer
 
@@ -56,7 +57,7 @@ def save_raw_issues_csv(ctx: StudyContext, result, path: str) -> int:
         log.warning("no linked issues; skipping %s", path)
         return 0
     header = [f"issue_{i}" for i in range(len(rows[0]))]
-    with open(path, "w", newline="", encoding="utf-8") as f:
+    with atomic_write(path, newline="") as f:
         w = csv.writer(f)
         w.writerow(header)
         w.writerows(rows)
@@ -64,7 +65,7 @@ def save_raw_issues_csv(ctx: StudyContext, result, path: str) -> int:
 
 
 def save_stats_csv(result, path: str) -> None:
-    with open(path, "w", newline="", encoding="utf-8") as f:
+    with atomic_write(path, newline="") as f:
         w = csv.writer(f)
         w.writerow(["Iteration", "Total_Projects", "Detected_Projects_Count"])
         for it, tot, det in zip(result.iterations, result.total_projects,

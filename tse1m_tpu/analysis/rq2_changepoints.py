@@ -20,6 +20,7 @@ from .common import StudyContext, fmt_ts_ns, limit_date_ns
 from ..config import Config
 from ..db.ingest import parse_array, pg_array_literal
 from ..utils.logging import get_logger
+from ..utils.atomic import atomic_write
 from ..utils.manifest import RunManifest
 from ..utils.timing import PhaseTimer
 
@@ -86,14 +87,14 @@ def run_rq2_changepoints(cfg: Config | None = None, db=None) -> dict:
         all_rows = []
         for project, rows in per_project.items():
             path = os.path.join(change_dir, f"{project}.csv")
-            with open(path, "w", newline="", encoding="utf-8") as f:
+            with atomic_write(path, newline="") as f:
                 w = csv.writer(f)
                 w.writerow(HEADER)
                 w.writerows(rows)
             all_rows.extend(rows)
         merged = os.path.join(out_dir, "all_coverage_change_analysis.csv")
         if all_rows:
-            with open(merged, "w", newline="", encoding="utf-8") as f:
+            with atomic_write(merged, newline="") as f:
                 w = csv.writer(f)
                 w.writerow(HEADER)
                 w.writerows(all_rows)

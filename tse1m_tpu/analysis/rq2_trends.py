@@ -28,6 +28,7 @@ import numpy as np
 from .common import StudyContext, limit_date_ns
 from ..config import Config
 from ..utils.logging import get_logger
+from ..utils.atomic import atomic_write
 from ..utils.manifest import RunManifest
 from ..utils.timing import PhaseTimer
 
@@ -46,7 +47,7 @@ def _plt():
 def save_ragged_csv(result, path: str) -> int:
     """Row i = coverage values of every project alive at session i."""
     S = result.matrix.shape[1]
-    with open(path, "w", newline="") as f:
+    with atomic_write(path, newline="") as f:
         w = csv.writer(f)
         if S == 0:
             w.writerow([])
@@ -215,7 +216,7 @@ def run_rq2_trends(cfg: Config | None = None, db=None,
                 _, sw_p = shapiro(trend)
                 if sw_p > 0.05:
                     normal += 1
-            except Exception:
+            except ValueError:  # shapiro rejects degenerate trends
                 pass
     if tested:
         print(f"Projects tested for normality (N >= 3 sessions): {tested}")

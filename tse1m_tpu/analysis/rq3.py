@@ -27,6 +27,7 @@ import numpy as np
 from .common import StudyContext, limit_date_ns
 from ..config import Config
 from ..utils.logging import get_logger
+from ..utils.atomic import atomic_write
 from ..utils.manifest import RunManifest
 from ..utils.timing import PhaseTimer
 
@@ -120,7 +121,7 @@ def statistical_tests(detected: np.ndarray, non_detected: np.ndarray) -> dict:
 
 
 def save_changes_csv(path: str, pct, cov, tot) -> None:
-    with open(path, "w", newline="", encoding="utf-8") as f:
+    with atomic_write(path, newline="") as f:
         w = csv.writer(f)
         w.writerow(["CoverageChangePercent", "CoveredLinesChange",
                     "TotalLinesChange"])

@@ -34,6 +34,7 @@ from .common import StudyContext, limit_date_ns
 from .corpus import GROUP_LABELS, g4_prepost, load_corpus_groups
 from ..config import Config
 from ..utils.logging import get_logger
+from ..utils.atomic import atomic_write
 from ..utils.manifest import RunManifest
 from ..utils.timing import PhaseTimer
 
@@ -51,7 +52,7 @@ def _plt():
 
 def save_trend_csv(result, path: str) -> None:
     g1r, g2r = result.rates("g1"), result.rates("g2")
-    with open(path, "w", newline="", encoding="utf-8") as f:
+    with atomic_write(path, newline="") as f:
         w = csv.writer(f)
         w.writerow(["Iteration", "G1_Total_Projects", "G1_Detected_Count",
                     "G1_Detection_Rate_pct", "G2_Total_Projects",
@@ -65,7 +66,7 @@ def save_trend_csv(result, path: str) -> None:
 
 def save_intro_csv(prepost, path: str) -> int:
     rows = sorted(prepost.intro_iteration.items(), key=lambda kv: kv[1])
-    with open(path, "w", newline="", encoding="utf-8") as f:
+    with atomic_write(path, newline="") as f:
         w = csv.writer(f)
         w.writerow(["Project", "Introduction_Iteration"])
         w.writerows(rows)

@@ -32,6 +32,7 @@ from .corpus import CorpusGroups, load_corpus_groups
 from ..backend.pandas_backend import floor_day_ns
 from ..config import Config
 from ..utils.logging import get_logger
+from ..utils.atomic import atomic_write
 from ..utils.manifest import RunManifest
 from ..utils.timing import PhaseTimer
 
@@ -155,7 +156,7 @@ def session_bm_pvalues(result, g1_idx, g2_idx, min_n: int = 5) -> np.ndarray:
                     warnings.simplefilter("ignore")
                     _, p_values[s] = brunnermunzel(g2_d, g1_d,
                                                    alternative="two-sided")
-            except Exception:
+            except ValueError:  # brunnermunzel rejects degenerate groups
                 pass
     return p_values
 
@@ -237,7 +238,7 @@ def print_trend_summary(summary: dict, percentiles=PERCENTILES) -> None:
 
 def save_trend_csv(result, p_values, path: str) -> None:
     S = result.matrix.shape[1]
-    with open(path, "w", newline="", encoding="utf-8") as f:
+    with atomic_write(path, newline="") as f:
         w = csv.writer(f)
         header = ["Session"]
         for g in ("G2", "G1"):

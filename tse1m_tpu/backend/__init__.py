@@ -64,6 +64,10 @@ def _probed_rtt_s() -> float | None:
                 log.info("backend=auto: TPU dispatch RTT %.1f ms "
                          "(per-RQ routing active)", _auto_rtt_s * 1e3)
         except Exception as e:
+            from ..resilience import reraise_if_fault
+
+            reraise_if_fault(e)  # a game-day fault here must not be
+            #                      misread as "no TPU available"
             log.warning("backend=auto: device probe failed (%s: %s); "
                         "using pandas", type(e).__name__, e)
             _auto_rtt_s = -1.0

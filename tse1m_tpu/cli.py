@@ -91,9 +91,11 @@ def _cmd_stats(args) -> int:
     db = DB(config=cfg).connect()
     try:
         db.require_study_tables()
+        from .db.ident import quote_ident
+
         for table in ("project_info", "buildlog_data", "total_coverage",
                       "issues"):
-            n = db.query(f"SELECT COUNT(*) FROM {table}")[0][0]
+            n = db.query(f"SELECT COUNT(*) FROM {quote_ident(table)}")[0][0]
             print(f"{table:16s} {n:>12,} rows")
         sql, params = queries.count_projects()
         freq = db.query(sql, params)
@@ -175,6 +177,12 @@ def _cmd_rq(args) -> int:
     wanted = list(specs) if args.cmd == "all" else [args.cmd]
     manifest_path = os.path.join(cfg.result_dir, "run_manifest.json")
     runner = StepRunner(manifest_path)
+    if args.cmd == "all":
+        # Correctness plane first: the static lint pass + a runtime
+        # sanitizer self-check, recorded per run in the manifest.  A
+        # non-baselined finding fails THIS step (nonzero exit, full
+        # summary in the record) while the RQs still run to completion.
+        runner.run("graftlint", _lint_step)
     for name in wanted:
         mod_name, fn_name = specs[name]
         try:
@@ -196,6 +204,35 @@ def _cmd_rq(args) -> int:
         log.info("all %d step(s) ok (manifest: %s)", len(runner.steps),
                  manifest_path)
     return runner.exit_code()
+
+
+def _lint_step() -> dict:
+    """The ``cli all`` correctness step: whole-repo graftlint plus the
+    runtime-sanitizer self-check, returned as the step's structured
+    result (resilience.StepRunner embeds dict returns — and, via
+    LintError.step_result, the summary of a FAILING lint too)."""
+    from .lint import run_repo_lint
+    from .lint.runtime import self_check
+
+    runtime = self_check()
+    summary = run_repo_lint()  # raises LintError on non-baselined findings
+    summary["runtime"] = runtime
+    return summary
+
+
+def _cmd_lint(args) -> int:
+    from .lint import main as lint_main
+
+    argv = list(args.paths)
+    if args.json:
+        argv.append("--json")
+    if args.write_baseline:
+        argv.append("--write-baseline")
+    if args.no_baseline:
+        argv.append("--no-baseline")
+    if args.rules:
+        argv += ["--rules", args.rules]
+    return lint_main(argv)
 
 
 def _cmd_collect(args) -> int:
@@ -401,6 +438,17 @@ def main(argv=None) -> int:
     p.add_argument("--ids-file", default=None)
     p.add_argument("--workers", type=int, default=8)
     p.set_defaults(fn=_cmd_collect)
+
+    p = sub.add_parser("lint",
+                       help="graftlint: enforce the repo's JAX/DB/"
+                            "resilience invariants (LINTING.md)")
+    p.add_argument("paths", nargs="*",
+                   help="files to lint (default: tse1m_tpu/ + bench.py)")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--write-baseline", action="store_true")
+    p.add_argument("--no-baseline", action="store_true")
+    p.add_argument("--rules", default=None)
+    p.set_defaults(fn=_cmd_lint)
 
     p = sub.add_parser("cluster", help="MinHash+LSH session dedup demo")
     p.add_argument("--n", type=int, default=100_000)

@@ -59,8 +59,14 @@ def minhash_signatures(items: jax.Array, a: jax.Array, b: jax.Array) -> jax.Arra
     return jax.lax.fori_loop(0, s, body, init)
 
 
+@partial(jax.jit, static_argnames=("n_bands",))
 def band_keys(sig: jax.Array, n_bands: int) -> jax.Array:
     """[N, H] signatures -> [N, B] uint32 LSH band keys.
+
+    Jitted (n_bands static) so the FNV constants embed as compile-time
+    constants instead of staging eagerly per call — the runtime sanitizer
+    (lint/runtime.py) runs the hot loop under a transfer guard that
+    rejects exactly that implicit per-call staging.
 
     Each band folds its H/B signature rows with an FNV-1a-style mix, salted
     by the band index so identical row-chunks in different bands can't

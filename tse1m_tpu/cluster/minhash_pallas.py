@@ -214,6 +214,10 @@ def minhash_and_keys_packed(payload_d, shape: tuple, k: int, offset, a, b,
     """
     global _FUSED_UNPACK_OK
     rows, s = shape
+    # Explicit conversion BEFORE any jit boundary: a raw np scalar would
+    # be staged implicitly per chunk (lint/runtime.no_implicit_transfers).
+    # graftlint: disable=wire-layer -- 4-byte offset scalar of the wire's own decode path (fused unpack kernel)
+    offset = jax.device_put(np.uint32(offset))
     if use_pallas == "auto":
         use_pallas = "force" if jax.default_backend() == "tpu" else "never"
     if use_pallas in ("force", "interpret") and rows and _FUSED_UNPACK_OK:
@@ -231,7 +235,7 @@ def minhash_and_keys_packed(payload_d, shape: tuple, k: int, offset, a, b,
                 payload2d, a, b_eff, k, n_bands, block_n,
                 use_pallas == "interpret")
             return sig[:rows], keys[:rows]
-        except Exception as e:  # Mosaic lowering gap: unfuse, don't fail
+        except Exception as e:  # Mosaic lowering gap: unfuse, don't fail  # graftlint: disable=broad-except -- compiler rejections are arbitrary; fallback is bit-identical
             _FUSED_UNPACK_OK = False
             from ..utils.logging import get_logger
 

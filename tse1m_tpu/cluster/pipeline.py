@@ -282,9 +282,14 @@ def _unpack_bits(packed, n: int, bits: int, offset):
 
 
 def _decode_wire(payload_d, wire: ChunkWire):
-    """Device payload + header -> decoded uint32 array of wire.shape."""
+    """Device payload + header -> decoded uint32 array of wire.shape.
+
+    The offset ships as an EXPLICIT scalar conversion: handed to the jit
+    as a raw np.uint32 it would be staged implicitly per call — the
+    regression class lint/runtime.no_implicit_transfers exists to catch.
+    """
     flat = _unpack_bits(payload_d, wire.n_values, wire.bits,
-                        np.uint32(wire.offset))
+                        jax.device_put(np.uint32(wire.offset)))
     return flat.reshape(wire.shape)
 
 
@@ -347,7 +352,8 @@ def _chunk_minhash(payload_d, wire: ChunkWire, a, b, params: ClusterParams,
         else:
             sig, keys = minhash_and_keys_packed(
                 payload_d, wire.shape, wire.bits // 8,
-                np.uint32(wire.offset), a, b, params.n_bands, **kw)
+                jax.device_put(np.uint32(wire.offset)), a, b, params.n_bands,
+                **kw)
         jax.block_until_ready(keys)
     return sig, keys, decoded
 
@@ -374,14 +380,16 @@ def _put_delta_meta(enc, rec: StageRecorder):
 
 def _decode_delta_meta(meta, enc, full_d, rep_d, counts_d, pos_d, val_d):
     """Unpack the bit-packed delta lanes on device and scatter-decode the
-    delta rows against the resident full lane."""
-    rep = _unpack_bits(rep_d, enc.n_delta, meta.rep_bits, np.uint32(0))
-    counts = _unpack_bits(counts_d, enc.n_delta, meta.counts_bits,
-                          np.uint32(0))
+    delta rows against the resident full lane.  Offsets convert
+    explicitly (see _decode_wire) so the hot loop stays implicit-
+    transfer-free under the runtime sanitizer."""
+    zero = jax.device_put(np.uint32(0))
+    rep = _unpack_bits(rep_d, enc.n_delta, meta.rep_bits, zero)
+    counts = _unpack_bits(counts_d, enc.n_delta, meta.counts_bits, zero)
     pos = _unpack_bits(pos_d, int(enc.pos_flat.shape[0]), meta.pos_bits,
-                       np.uint32(0))
+                       zero)
     vals = _unpack_bits(val_d, meta.val.n_values, meta.val.bits,
-                        np.uint32(meta.val.offset))
+                        jax.device_put(np.uint32(meta.val.offset)))
     return _decode_delta_raw(full_d, rep, counts, pos, vals)
 
 

@@ -27,6 +27,7 @@ import pandas as pd
 
 from .checkpoint import CsvBatchCheckpointer, processed_ids_from_csvs
 from .transport import Fetcher
+from ..resilience import reraise_if_fault
 from ..utils.logging import get_logger
 
 log = get_logger("collect.buildlogs")
@@ -302,6 +303,9 @@ class BuildLogAnalyzer:
             try:
                 resp = self.fetcher.get(url)
             except Exception as e:
+                # The fetcher already retried (transport.py); an injected
+                # fault that survived it must still surface here.
+                reraise_if_fault(e)
                 log.warning("log fetch failed for %s: %s", build_id, e)
                 resp = None
             return parse_build_log(

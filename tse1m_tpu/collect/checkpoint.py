@@ -100,7 +100,10 @@ class CsvBatchCheckpointer:
         for path in files:
             try:
                 frames.append(pd.read_csv(path))
-            except Exception as e:
+            except (OSError, ValueError) as e:
+                # pandas parse failures (ParserError/EmptyDataError/
+                # UnicodeDecodeError) are ValueError subclasses; anything
+                # broader — including an injected fault — must surface.
                 log.warning("skipping unreadable batch %s: %s", path, e)
         if not frames:
             return 0
@@ -152,7 +155,7 @@ def processed_ids_from_csvs(base_dir: str, id_column: str = "id",
                             continue
                         s = str(raw)
                         found.add(int(s) if s.isdigit() else s)
-            except Exception as e:
+            except (OSError, ValueError, csv.Error) as e:
                 log.warning("could not scan %s: %s", path, e)
     return found
 
@@ -163,7 +166,7 @@ def last_date_in_csv(path: str, column: str = "date") -> date | None:
         return None
     try:
         df = pd.read_csv(path)
-    except Exception:
+    except (OSError, ValueError):
         return None
     if column not in df.columns or df.empty:
         return None

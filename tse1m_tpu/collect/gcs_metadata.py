@@ -19,6 +19,7 @@ from dataclasses import dataclass, field
 
 from .checkpoint import CsvBatchCheckpointer
 from .transport import Fetcher
+from ..resilience import reraise_if_fault
 from ..utils.logging import get_logger
 
 log = get_logger("collect.gcs")
@@ -68,6 +69,7 @@ class GcsMetadataCollector:
             try:
                 resp = self.fetcher.get(url, params=params or None)
             except Exception as e:
+                reraise_if_fault(e)  # retried upstream; faults stay visible
                 log.error("page fetch failed (%s); finalising partial run", e)
                 break
             self.pages_fetched += 1

@@ -30,6 +30,8 @@ from typing import Callable, Protocol
 import pandas as pd
 
 from .checkpoint import processed_ids_from_csvs
+from ..resilience import reraise_if_fault
+from ..utils.atomic import atomic_write
 from ..utils.logging import get_logger
 
 log = get_logger("collect.issues")
@@ -213,6 +215,9 @@ def assemble_issue_record(page: RawIssuePage,
         try:
             table = client.fetch_revisions(sub_url)
         except Exception as e:
+            # Selenium raises arbitrary driver exceptions — stay broad,
+            # but keep the fault plane visible through this seat.
+            reraise_if_fault(e)
             log.warning("revision sub-scrape failed for %s: %s", sub_url, e)
             continue
         if table is None:
@@ -241,7 +246,9 @@ def save_issue_batch(records: list[dict], directory: str,
     header = sorted({k for r in records for k in r})
     import csv
 
-    with open(path, "w", newline="", encoding="utf-8") as f:
+    # Atomic: a worker killed mid-batch must not leave a torn CSV that
+    # plan_run later reads as "these ids are processed".
+    with atomic_write(path, newline="") as f:
         w = csv.DictWriter(f, fieldnames=header)
         w.writeheader()
         for r in records:
@@ -267,6 +274,7 @@ def run_scraper_window(client_factory: Callable[[], IssuePageClient],
             batch.append(assemble_issue_record(page, client))
             done += 1
         except Exception as e:
+            reraise_if_fault(e)  # chaos plans must see through the restart
             log.error("window %d: unhandled error on issue %s: %s",
                       window_index, issue_no, e)
             if batch:
@@ -277,8 +285,8 @@ def run_scraper_window(client_factory: Callable[[], IssuePageClient],
             if close:
                 try:
                     close()
-                except Exception:
-                    pass
+                except Exception as ce:  # best-effort teardown of a dead client
+                    reraise_if_fault(ce)
             client = client_factory()
         if len(batch) >= save_interval:
             save_issue_batch(batch, out_dir, file_counter)
@@ -290,8 +298,8 @@ def run_scraper_window(client_factory: Callable[[], IssuePageClient],
     if close:
         try:
             close()
-        except Exception:
-            pass
+        except Exception as ce:  # best-effort teardown at window end
+            reraise_if_fault(ce)
     log.info("window %d finished: %d issues", window_index, done)
     return done
 
@@ -382,7 +390,7 @@ def merge_window_csvs(results_dir: str, merged_csv: str) -> int:
                 try:
                     frames.append(pd.read_csv(os.path.join(root, name),
                                               low_memory=False))
-                except Exception as e:
+                except (OSError, ValueError) as e:
                     log.warning("skipping %s: %s", name, e)
     if not frames:
         return 0

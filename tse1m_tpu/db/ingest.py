@@ -22,6 +22,7 @@ import os
 from typing import Iterable, Sequence
 
 from .connection import DB
+from .ident import col_list, quote_ident
 from .schema import create_schema
 from ..utils.logging import get_logger
 
@@ -133,14 +134,18 @@ def _read_csv(path: str) -> Iterable[dict]:
 
 def _upsert_sql(db: DB, table: str, cols: Sequence[str], conflict: Sequence[str]) -> str:
     """Dialect-consistent upsert: re-ingesting a corrected CSV updates the
-    row on both engines (last-write-wins)."""
-    collist = ", ".join(cols)
+    row on both engines (last-write-wins).  Table/column names pass the
+    identifier validator — they come from our own loader tables today,
+    but this seat is the template every future loader copies."""
     qs = ",".join("?" * len(cols))
     if db.dialect == "sqlite":
-        return f"INSERT OR REPLACE INTO {table} ({collist}) VALUES ({qs})"
-    updates = ", ".join(f"{c} = EXCLUDED.{c}" for c in cols if c not in conflict)
-    return (f"INSERT INTO {table} ({collist}) VALUES ({qs}) "
-            f"ON CONFLICT ({', '.join(conflict)}) DO UPDATE SET {updates}")
+        return (f"INSERT OR REPLACE INTO {quote_ident(table)} "
+                f"({col_list(cols)}) VALUES ({qs})")
+    updates = ", ".join(f"{quote_ident(c)} = EXCLUDED.{quote_ident(c)}"
+                        for c in cols if c not in conflict)
+    return (f"INSERT INTO {quote_ident(table)} ({col_list(cols)}) "
+            f"VALUES ({qs}) "
+            f"ON CONFLICT ({col_list(conflict)}) DO UPDATE SET {updates}")
 
 
 def load_project_info(db: DB, rows: Iterable[dict]) -> int:

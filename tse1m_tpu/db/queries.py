@@ -27,6 +27,7 @@ from __future__ import annotations
 from typing import Sequence
 
 from ..config import DEFAULT_LIMIT_DATE, FIXED_STATUSES, RESULT_OK
+from .ident import validate_ident
 
 Query = tuple[str, tuple]
 
@@ -198,12 +199,14 @@ def severity_issues(severity: str, targets: Sequence[str], dialect: str,
 
 def total_coverage_each_project(project: str, export_type: str,
                                 limit_date: str = DEFAULT_LIMIT_DATE) -> Query:
-    # queries1.py:120-129; export_type is a column name -> whitelisted.
+    # queries1.py:120-129; export_type is a column name -> whitelisted,
+    # and validated as an identifier (db/ident.py) for defense in depth.
     if export_type not in _COVERAGE_COLUMNS:
         raise ValueError(f"export_type must be one of {sorted(_COVERAGE_COLUMNS)}")
     return (
         "SELECT covered_line, total_line FROM total_coverage "
-        f"WHERE project = ? AND {export_type} IS NOT NULL AND {export_type} != 0 "
+        f"WHERE project = ? AND {validate_ident(export_type)} IS NOT NULL "
+        f"AND {validate_ident(export_type)} != 0 "
         "AND date < ? ORDER BY date",
         (project, limit_date),
     )

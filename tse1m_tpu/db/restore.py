@@ -30,6 +30,7 @@ from __future__ import annotations
 import re
 
 from ..utils.logging import get_logger
+from .ident import col_list, quote_ident
 from .schema import SCHEMA_TABLES, create_schema
 
 log = get_logger("db.restore")
@@ -108,9 +109,12 @@ def restore_sql_dump(db, path: str, create: bool = True,
                 table = m.group(1).lower()
                 cols = [c.strip().strip('"') for c in m.group(2).split(",")]
                 if table in counts:
+                    # The COPY header is attacker-controlled text in a
+                    # hostile dump; identifiers must validate before they
+                    # touch SQL (db/ident.py).
                     ph = ", ".join("?" * len(cols))
-                    sql = (f"INSERT INTO {table} ({', '.join(cols)}) "
-                           f"VALUES ({ph})")
+                    sql = (f"INSERT INTO {quote_ident(table)} "
+                           f"({col_list(cols)}) VALUES ({ph})")
                     in_copy = (table, sql, [])
                 else:
                     log.info("restore: skipping COPY into unknown table %s",

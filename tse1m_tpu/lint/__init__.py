@@ -1,0 +1,33 @@
+"""graftlint — the repo's self-hosted static-analysis + runtime sanitizer
+plane.
+
+PR 1 and PR 2 introduced invariants that nothing enforced: atomic
+tmp+rename writes, fault transparency (``resilience.InjectedFault`` must
+never be swallowed), validated SQL identifiers, a single blessed wire
+layer for host<->device transfers, locked shared state, one retry
+engine, and deterministic replay.  Regressions against any of these only
+surfaced as chaos-test flakes.  This package is the cheap mechanical
+check that keeps those expensive properties true as the code grows (the
+b-bit-minwise argument applied to correctness tooling):
+
+- :mod:`engine` — AST rule engine: per-rule suppression comments
+  (``# graftlint: disable=RULE -- reason``), a committed baseline for
+  grandfathered findings, machine-readable JSON output.
+- :mod:`rules` — the rule catalog (see LINTING.md for rationale).
+- :mod:`runtime` — the runtime half: ``jax.transfer_guard`` wiring and a
+  jit compile counter, asserting the cluster hot loop performs zero
+  implicit host->device transfers within a bounded compile budget.
+
+Run it: ``python -m tse1m_tpu.lint`` (or ``python -m tse1m_tpu.cli
+lint``).  Exit 0 means every finding is fixed, suppressed with a reason,
+or baselined.
+"""
+
+from .engine import (BASELINE_DEFAULT, Baseline, Finding, LintError,
+                     lint_paths, load_source, main, repo_root,
+                     run_repo_lint)
+from .rules import RULES
+
+__all__ = ["BASELINE_DEFAULT", "Baseline", "Finding", "LintError", "RULES",
+           "lint_paths", "load_source", "main", "repo_root",
+           "run_repo_lint"]

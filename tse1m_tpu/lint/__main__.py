@@ -1,0 +1,8 @@
+"""``python -m tse1m_tpu.lint`` — run graftlint over the repo."""
+
+import sys
+
+from .engine import main
+
+if __name__ == "__main__":
+    sys.exit(main())

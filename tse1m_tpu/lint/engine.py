@@ -1,0 +1,361 @@
+"""The graftlint rule engine.
+
+Mechanics, in one place so every rule stays a pure AST visitor:
+
+- **Targets** — by default the ``tse1m_tpu/`` package plus the repo's
+  top-level driver scripts (``bench.py``).  Tests are deliberately out of
+  scope: chaos drivers legitimately SIGKILL processes, monkeypatch
+  clocks, and write files non-atomically.
+- **Suppressions** — ``# graftlint: disable=rule-a,rule-b -- reason`` on
+  the finding's line suppresses those rules for that line;
+  ``# graftlint: disable-file=rule-a -- reason`` anywhere in the file
+  suppresses the rule file-wide.  The ``-- reason`` tail is required by
+  convention (LINTING.md) and surfaced in ``--json`` output so a
+  reasonless suppression is visible in review.
+- **Baseline** — a committed JSON file of grandfathered findings.  A
+  finding matches a baseline entry on (rule, path, normalized source
+  line text) with multiplicity, so edits elsewhere in the file don't
+  invalidate it, while touching the offending line itself does.
+  ``--write-baseline`` regenerates the file (preserving reasons of
+  entries that still match); new findings then fail the run until fixed,
+  suppressed, or explicitly re-baselined.
+- **Output** — human lines (``path:line:col: rule: message``) or
+  ``--json`` for machines (CI, and the ``cli all`` run-manifest step).
+
+Exit codes: 0 clean, 1 non-baselined findings, 2 usage/internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import re
+import sys
+from dataclasses import asdict, dataclass, field
+
+BASELINE_DEFAULT = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*(disable|disable-file)="
+    r"(?P<rules>[\w,-]+)"
+    r"(?:\s+--\s*(?P<reason>.*))?")
+
+
+class LintError(RuntimeError):
+    """Non-baselined findings (carries the machine summary for the
+    run-manifest step)."""
+
+    def __init__(self, message: str, step_result: dict | None = None):
+        super().__init__(message)
+        self.step_result = step_result
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str          # repo-relative, posix form
+    line: int          # 1-based
+    col: int           # 0-based
+    message: str
+    text: str = ""     # stripped source line (baseline matching key)
+    baselined: bool = False
+    suppressed: bool = False
+
+    def key(self) -> tuple:
+        return (self.rule, self.path, self.text)
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}"
+
+
+@dataclass
+class FileSource:
+    """One parsed target file, shared by every rule."""
+
+    path: str                    # repo-relative posix
+    abspath: str
+    text: str
+    lines: list[str]
+    tree: ast.AST
+    # line -> set of rule names disabled on that line; "*" = all
+    line_disables: dict[int, set] = field(default_factory=dict)
+    file_disables: set = field(default_factory=set)
+    # (scope, rules) -> reason strings, for the JSON report
+    suppress_reasons: list = field(default_factory=list)
+
+
+def load_source(abspath: str, relpath: str) -> FileSource:
+    with open(abspath, encoding="utf-8") as f:
+        text = f.read()
+    lines = text.splitlines()
+    tree = ast.parse(text, filename=relpath)
+    src = FileSource(path=relpath, abspath=abspath, text=text, lines=lines,
+                     tree=tree)
+    for i, line in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group("rules").split(",") if r.strip()}
+        reason = (m.group("reason") or "").strip()
+        if m.group(1) == "disable-file":
+            src.file_disables |= rules
+        else:
+            # A trailing comment suppresses its own line; a standalone
+            # comment line suppresses the NEXT line (long statements).
+            target = i + 1 if line.strip().startswith("#") else i
+            src.line_disables.setdefault(target, set()).update(rules)
+        src.suppress_reasons.append(
+            {"line": i, "scope": m.group(1), "rules": sorted(rules),
+             "reason": reason})
+    return src
+
+
+class Baseline:
+    """The committed set of grandfathered findings.
+
+    Entries carry a multiplicity ``count`` (identical offending lines in
+    one file collapse into one entry) and a human ``reason``."""
+
+    def __init__(self, entries: list[dict] | None = None):
+        self.entries = entries or []
+        self._budget: dict[tuple, int] = {}
+        for e in self.entries:
+            k = (e["rule"], e["path"], e["text"])
+            self._budget[k] = self._budget.get(k, 0) + int(e.get("count", 1))
+        self._used: dict[tuple, int] = {}
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.exists(path):
+            return cls([])
+        with open(path, encoding="utf-8") as f:
+            return cls(json.load(f).get("findings", []))
+
+    def absorb(self, finding: Finding) -> bool:
+        """True (and consume one unit of budget) if the finding is
+        grandfathered."""
+        k = finding.key()
+        used = self._used.get(k, 0)
+        if used < self._budget.get(k, 0):
+            self._used[k] = used + 1
+            return True
+        return False
+
+    def stale_entries(self) -> list[dict]:
+        """Entries whose budget was never (fully) consumed — the finding
+        they grandfathered was fixed and they can be deleted."""
+        out = []
+        for e in self.entries:
+            k = (e["rule"], e["path"], e["text"])
+            if self._used.get(k, 0) < self._budget.get(k, 0):
+                out.append(e)
+                # report each key once even when count > 1
+                self._used[k] = self._budget[k]
+        return out
+
+    @staticmethod
+    def write(path: str, findings: list[Finding],
+              old: "Baseline | None" = None,
+              default_reason: str = "grandfathered pre-graftlint finding") \
+            -> int:
+        """Serialize ``findings`` (the non-suppressed ones) as the new
+        baseline, keeping the reason of any entry that already existed."""
+        reasons = {}
+        if old is not None:
+            for e in old.entries:
+                reasons[(e["rule"], e["path"], e["text"])] = \
+                    e.get("reason", default_reason)
+        grouped: dict[tuple, dict] = {}
+        for f in findings:
+            if f.suppressed:
+                continue
+            k = f.key()
+            if k in grouped:
+                grouped[k]["count"] += 1
+            else:
+                grouped[k] = {"rule": f.rule, "path": f.path, "line": f.line,
+                              "text": f.text, "count": 1,
+                              "message": f.message,
+                              "reason": reasons.get(k, default_reason)}
+        payload = {"comment": "graftlint baseline — grandfathered findings. "
+                              "Matching is (rule, path, line text) with "
+                              "multiplicity; fix the line or re-run "
+                              "--write-baseline to update.",
+                   "findings": sorted(grouped.values(),
+                                      key=lambda e: (e["path"], e["line"],
+                                                     e["rule"]))}
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(payload, fh, indent=2)
+            fh.write("\n")
+        os.replace(tmp, path)
+        return len(grouped)
+
+
+def repo_root() -> str:
+    """The directory holding the ``tse1m_tpu`` package (== the repo root
+    in every supported layout)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def default_targets(root: str | None = None) -> list[str]:
+    root = root or repo_root()
+    targets = []
+    pkg = os.path.join(root, "tse1m_tpu")
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in sorted(filenames):
+            if name.endswith(".py"):
+                targets.append(os.path.join(dirpath, name))
+    for script in ("bench.py",):
+        p = os.path.join(root, script)
+        if os.path.exists(p):
+            targets.append(p)
+    return targets
+
+
+def lint_paths(paths: list[str], rules: dict | None = None,
+               root: str | None = None,
+               baseline: Baseline | None = None) -> list[Finding]:
+    """Run ``rules`` over ``paths``; returns every finding with its
+    ``suppressed``/``baselined`` flags resolved (callers filter)."""
+    from .rules import RULES
+
+    rules = rules if rules is not None else RULES
+    root = root or repo_root()
+    findings: list[Finding] = []
+    for abspath in paths:
+        rel = os.path.relpath(os.path.abspath(abspath), root)
+        rel = rel.replace(os.sep, "/")
+        try:
+            src = load_source(abspath, rel)
+        except (OSError, SyntaxError) as e:
+            findings.append(Finding(rule="parse-error", path=rel, line=1,
+                                    col=0, message=f"could not lint: {e}"))
+            continue
+        for name, rule_fn in rules.items():
+            for f in rule_fn(src):
+                f.rule = name
+                if not f.text and 1 <= f.line <= len(src.lines):
+                    f.text = src.lines[f.line - 1].strip()
+                disabled = src.line_disables.get(f.line, set())
+                if (name in src.file_disables or name in disabled
+                        or "*" in disabled):
+                    f.suppressed = True
+                findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    if baseline is not None:
+        for f in findings:
+            if not f.suppressed:
+                f.baselined = baseline.absorb(f)
+    return findings
+
+
+def summarize(findings: list[Finding],
+              stale: list[dict] | None = None) -> dict:
+    new = [f for f in findings if not f.suppressed and not f.baselined]
+    by_rule: dict[str, int] = {}
+    for f in new:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    return {
+        "ok": not new,
+        "new_findings": len(new),
+        "baselined": sum(1 for f in findings if f.baselined),
+        "suppressed": sum(1 for f in findings if f.suppressed),
+        "by_rule": dict(sorted(by_rule.items())),
+        "stale_baseline_entries": len(stale or []),
+    }
+
+
+def run_repo_lint(baseline_path: str = BASELINE_DEFAULT,
+                  root: str | None = None) -> dict:
+    """Programmatic whole-repo lint (the ``cli all`` manifest step).
+
+    Returns the JSON summary when clean; raises :class:`LintError`
+    carrying the summary when there are non-baselined findings."""
+    root = root or repo_root()
+    baseline = Baseline.load(baseline_path)
+    findings = lint_paths(default_targets(root), root=root,
+                          baseline=baseline)
+    summary = summarize(findings, baseline.stale_entries())
+    if not summary["ok"]:
+        new = [f for f in findings if not f.suppressed and not f.baselined]
+        detail = "; ".join(f"{f.location()} {f.rule}" for f in new[:5])
+        raise LintError(
+            f"graftlint: {len(new)} non-baselined finding(s): {detail}",
+            step_result=summary)
+    return summary
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tse1m_tpu.lint",
+        description="graftlint: enforce the repo's JAX, DB and resilience "
+                    "invariants (rule catalog: LINTING.md)")
+    ap.add_argument("paths", nargs="*",
+                    help="files to lint (default: tse1m_tpu/ + bench.py)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--baseline", default=BASELINE_DEFAULT,
+                    help="baseline file (default: %(default)s)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignoring the baseline")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline from the current findings "
+                         "(keeps reasons of entries that still match)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated subset of rules to run")
+    args = ap.parse_args(argv)
+
+    from .rules import RULES
+
+    rules = RULES
+    if args.rules:
+        wanted = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = wanted - set(RULES)
+        if unknown:
+            print(f"unknown rule(s): {', '.join(sorted(unknown))}; "
+                  f"available: {', '.join(sorted(RULES))}", file=sys.stderr)
+            return 2
+        rules = {k: v for k, v in RULES.items() if k in wanted}
+
+    root = repo_root()
+    paths = ([os.path.abspath(p) for p in args.paths] if args.paths
+             else default_targets(root))
+    old = Baseline.load(args.baseline)
+    baseline = None if (args.no_baseline or args.write_baseline) else old
+    findings = lint_paths(paths, rules=rules, root=root, baseline=baseline)
+
+    if args.write_baseline:
+        n = Baseline.write(args.baseline, findings, old=old)
+        print(f"graftlint: baseline rewritten with {n} entr"
+              f"{'y' if n == 1 else 'ies'} -> {args.baseline}")
+        return 0
+
+    # Stale-entry detection only makes sense against the full target set:
+    # an explicit-path run never visits most baselined files.
+    stale = (baseline.stale_entries()
+             if baseline is not None and not args.paths else [])
+    summary = summarize(findings, stale)
+    new = [f for f in findings if not f.suppressed and not f.baselined]
+    if args.json:
+        report = dict(summary)
+        report["findings"] = [asdict(f) for f in new]
+        report["stale_baseline"] = stale
+        print(json.dumps(report, indent=2))
+    else:
+        for f in new:
+            print(f"{f.location()}: {f.rule}: {f.message}")
+        for e in stale:
+            print(f"note: stale baseline entry ({e['rule']} at {e['path']}: "
+                  f"{e['text'][:60]!r}) — finding fixed, entry can be "
+                  "removed", file=sys.stderr)
+        print(f"graftlint: {summary['new_findings']} new, "
+              f"{summary['baselined']} baselined, "
+              f"{summary['suppressed']} suppressed"
+              + (f", {len(stale)} stale baseline entries" if stale else ""),
+              file=sys.stderr)
+    return 1 if new else 0

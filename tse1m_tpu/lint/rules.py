@@ -1,0 +1,626 @@
+"""The graftlint rule catalog (rationale per rule in LINTING.md).
+
+Every rule is a pure function ``FileSource -> list[Finding]`` over the
+parsed AST; the engine resolves suppressions and the baseline.  Rules are
+tuned to THIS repo's failure modes — they prefer a small number of
+high-signal findings over generic-linter breadth, and each encodes an
+invariant some PR actually shipped:
+
+- ``broad-except``        fault transparency (resilience plane, PR 1)
+- ``nonatomic-write``     atomic tmp+rename writes (checkpointers, PR 1)
+- ``sql-interp``          validated SQL identifiers (db/ident.py)
+- ``host-in-jit``         no host ops / traced-value control flow in
+                          jit/shard_map/pallas bodies (silent recompiles
+                          or device->host syncs)
+- ``wire-layer``          host<->device transfers only in the blessed
+                          wire layer (cluster/encode.py + pipeline.py,
+                          PR 2)
+- ``unlocked-shared-state``  lock-owning classes/modules must mutate
+                          shared state under their lock (producer-thread
+                          overlap, PR 2)
+- ``retry-bypass``        all HTTP/DB I/O through the retry engine (PR 1)
+- ``nondeterminism``      no wall-clock/global-RNG in chaos-replayed
+                          planes (seeded fault plans must replay)
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .engine import FileSource, Finding
+
+
+def _f(src: FileSource, node: ast.AST, message: str) -> Finding:
+    return Finding(rule="", path=src.path, line=getattr(node, "lineno", 1),
+                   col=getattr(node, "col_offset", 0), message=message)
+
+
+def _parents(tree: ast.AST) -> dict:
+    out = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            out[child] = node
+    return out
+
+
+def _dotted(node: ast.AST) -> str:
+    """'jax.device_put' for Attribute chains, 'open' for Names, '' else."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _enclosing_function(node: ast.AST, parents: dict):
+    while node is not None:
+        node = parents.get(node)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return node
+    return None
+
+
+# -- 1. broad-except ---------------------------------------------------------
+
+_BROAD = {"Exception", "BaseException"}
+_FAULT_GUARDS = {"reraise_if_fault"}
+
+
+def _is_broad(type_node) -> bool:
+    if type_node is None:  # bare `except:` — also swallows KeyboardInterrupt
+        return True
+    if isinstance(type_node, ast.Tuple):
+        return any(_is_broad(e) for e in type_node.elts)
+    name = _dotted(type_node)
+    return name.rsplit(".", 1)[-1] in _BROAD
+
+
+def _handler_reraises(handler: ast.ExceptHandler) -> bool:
+    """The handler is fault-transparent: it re-raises (bare ``raise``,
+    conditionally is fine — that is exactly the prescribed
+    ``if isinstance(e, InjectedFault): raise`` guard), chains a new
+    exception (``raise X(...) from e`` propagates loudly), or routes
+    through ``resilience.reraise_if_fault``."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise) and (node.exc is None
+                                            or node.cause is not None):
+            return True
+        if isinstance(node, ast.Call):
+            if _dotted(node.func).rsplit(".", 1)[-1] in _FAULT_GUARDS:
+                return True
+    return False
+
+
+def broad_except(src: FileSource) -> list[Finding]:
+    out = []
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Try):
+            continue
+        for h in node.handlers:
+            if _is_broad(h.type) and not _handler_reraises(h):
+                kind = ("bare except" if h.type is None
+                        else f"except {_dotted(h.type) or '...'}")
+                out.append(_f(src, h,
+                              f"{kind} can swallow resilience.InjectedFault"
+                              " — narrow it, re-raise faults (`if "
+                              "isinstance(e, InjectedFault): raise` / "
+                              "resilience.reraise_if_fault(e)), or "
+                              "suppress with a reason"))
+    return out
+
+
+# -- 2. nonatomic-write ------------------------------------------------------
+
+def _is_tmp_target(arg: ast.AST) -> bool:
+    """The open() target is already a tmp-file the caller will rename."""
+    if isinstance(arg, ast.Name) and "tmp" in arg.id.lower():
+        return True
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value.endswith(".tmp")
+    if isinstance(arg, ast.BinOp):  # path + ".tmp"
+        return _is_tmp_target(arg.right) or _is_tmp_target(arg.left)
+    if isinstance(arg, ast.Attribute) and "tmp" in arg.attr.lower():
+        return True
+    if isinstance(arg, ast.Call):  # tmp_path(...), .with_suffix(".tmp")
+        inner = _dotted(arg.func).rsplit(".", 1)[-1].lower()
+        if "tmp" in inner:
+            return True
+        return any(_is_tmp_target(a) for a in arg.args)
+    return False
+
+
+def nonatomic_write(src: FileSource) -> list[Finding]:
+    parents = _parents(src.tree)
+    out = []
+    for node in ast.walk(src.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "open" and len(node.args) >= 2):
+            continue
+        mode = node.args[1]
+        if not (isinstance(mode, ast.Constant) and isinstance(mode.value, str)
+                and "w" in mode.value):
+            continue
+        if _is_tmp_target(node.args[0]):
+            continue
+        fn = _enclosing_function(node, parents)
+        scope = fn if fn is not None else src.tree
+        renames = any(
+            isinstance(n, ast.Call)
+            and _dotted(n.func) in ("os.replace", "os.rename")
+            for n in ast.walk(scope))
+        if renames:
+            continue
+        out.append(_f(src, node,
+                      "non-atomic write-mode open() — a crash mid-write "
+                      "leaves a torn file; write to `path + \".tmp\"` then "
+                      "os.replace (see collect/checkpoint.py), or suppress "
+                      "with a reason"))
+    return out
+
+
+# -- 3. sql-interp -----------------------------------------------------------
+
+_SQL_RE = re.compile(
+    r"\b(SELECT|INSERT|UPDATE|DELETE|CREATE|DROP|ALTER|COPY|PRAGMA|SET)\b")
+# Interpolations that cannot inject: the db/ident.py helpers, integer
+# coercion, and db/queries.py's qmark placeholder-list builder.
+_SQL_BLESSED = {"quote_ident", "validate_ident", "col_list", "int", "_in"}
+
+
+def _blessed_expr(node: ast.AST, env: dict, depth: int = 0) -> bool:
+    """True when the interpolated expression cannot inject: constants,
+    the blessed helpers, placeholder-list composition (``",".join("?" *
+    len(cols))``), and names assigned (in the same scope) from blessed
+    expressions."""
+    if depth > 6:
+        return False
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Name):
+        bound = env.get(node.id)
+        return bound is not None and _blessed_expr(bound, env, depth + 1)
+    if isinstance(node, ast.Call):
+        name = _dotted(node.func).rsplit(".", 1)[-1]
+        if name in _SQL_BLESSED or name == "len":
+            return True
+        if (isinstance(node.func, ast.Attribute) and node.func.attr == "join"
+                and isinstance(node.func.value, ast.Constant)):
+            return all(_blessed_expr(a, env, depth + 1) for a in node.args)
+        return False
+    if isinstance(node, ast.BinOp):
+        return (_blessed_expr(node.left, env, depth + 1)
+                and _blessed_expr(node.right, env, depth + 1))
+    if isinstance(node, ast.IfExp):
+        return (_blessed_expr(node.body, env, depth + 1)
+                and _blessed_expr(node.orelse, env, depth + 1))
+    if isinstance(node, (ast.GeneratorExp, ast.ListComp)):
+        return _blessed_expr(node.elt, env, depth + 1)
+    if isinstance(node, ast.JoinedStr):
+        return all(_blessed_expr(v.value, env, depth + 1)
+                   for v in node.values
+                   if isinstance(v, ast.FormattedValue))
+    return False
+
+
+def _scope_env(scope: ast.AST) -> dict:
+    """name -> assigned expression, for single-name assignments in the
+    scope (simple local dataflow; reassignment keeps the LAST binding,
+    which is the common builder pattern here)."""
+    env: dict = {}
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            env[node.targets[0].id] = node.value
+        elif isinstance(node, ast.AugAssign) \
+                and isinstance(node.target, ast.Name):
+            env[node.target.id] = None  # composed further — unknown
+    return env
+
+
+_SQL_MSG = ("SQL string built by interpolation — route identifiers through "
+            "db/ident.py (quote_ident/validate_ident/col_list) or bind "
+            "values as parameters")
+
+
+def sql_interp(src: FileSource) -> list[Finding]:
+    parents = _parents(src.tree)
+    envs: dict = {}
+
+    def env_for(node: ast.AST) -> dict:
+        scope = _enclosing_function(node, parents) or src.tree
+        if id(scope) not in envs:
+            envs[id(scope)] = _scope_env(scope)
+        return envs[id(scope)]
+
+    out = []
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.JoinedStr):
+            literal = "".join(v.value for v in node.values
+                              if isinstance(v, ast.Constant)
+                              and isinstance(v.value, str))
+            if not _SQL_RE.search(literal):
+                continue
+            env = env_for(node)
+            bad = [v for v in node.values
+                   if isinstance(v, ast.FormattedValue)
+                   and not _blessed_expr(v.value, env)]
+            if bad:
+                out.append(_f(src, node, _SQL_MSG))
+        elif (isinstance(node, ast.Call)
+              and isinstance(node.func, ast.Attribute)
+              and node.func.attr == "format"
+              and isinstance(node.func.value, ast.Constant)
+              and isinstance(node.func.value.value, str)
+              and _SQL_RE.search(node.func.value.value)):
+            env = env_for(node)
+            if not all(_blessed_expr(a, env) for a in node.args) or not all(
+                    _blessed_expr(k.value, env) for k in node.keywords):
+                out.append(_f(src, node, _SQL_MSG))
+        elif (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mod)
+              and isinstance(node.left, ast.Constant)
+              and isinstance(node.left.value, str)
+              and _SQL_RE.search(node.left.value)):
+            env = env_for(node)
+            right = (node.right.elts if isinstance(node.right, ast.Tuple)
+                     else [node.right])
+            if not all(_blessed_expr(r, env) for r in right):
+                out.append(_f(src, node, _SQL_MSG))
+    return out
+
+
+# -- 4. host-in-jit ----------------------------------------------------------
+
+def _jit_call_target(call: ast.Call):
+    """(is_jit_wrap, static_argnames) for jax.jit(...) / jit(...) /
+    partial(jax.jit, ...) call nodes."""
+    name = _dotted(call.func).rsplit(".", 1)[-1]
+    if name == "jit":
+        return True, _static_argnames(call)
+    if name == "partial" and call.args:
+        inner = call.args[0]
+        if _dotted(inner).rsplit(".", 1)[-1] == "jit":
+            return True, _static_argnames(call)
+    return False, ()
+
+
+def _static_argnames(call: ast.Call) -> tuple:
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                return tuple(e.value for e in v.elts
+                             if isinstance(e, ast.Constant))
+    return ()
+
+
+def _collect_traced_functions(src: FileSource) -> dict:
+    """name -> static_argnames for functions whose BODY is traced:
+    jit-decorated, jit-wrapped at module level, shard_map-decorated, or
+    passed as a pallas_call kernel."""
+    traced: dict[str, tuple] = {}
+    for node in ast.walk(src.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                name = _dotted(dec).rsplit(".", 1)[-1]
+                if name in ("jit", "shard_map"):
+                    traced[node.name] = ()
+                elif isinstance(dec, ast.Call):
+                    is_jit, statics = _jit_call_target(dec)
+                    dec_name = _dotted(dec.func).rsplit(".", 1)[-1]
+                    if is_jit or dec_name == "shard_map":
+                        traced[node.name] = statics
+        elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            call = node.value
+            is_jit, statics = _jit_call_target(call)
+            inner = None
+            if _dotted(call.func).rsplit(".", 1)[-1] == "jit" and call.args:
+                inner = call.args[0]
+            elif (_dotted(call.func).rsplit(".", 1)[-1] == "partial"
+                  and len(call.args) >= 2):
+                inner = call.args[1]
+            if is_jit and isinstance(inner, ast.Name):
+                traced[inner.id] = statics
+        elif isinstance(node, ast.Call):
+            if _dotted(node.func).rsplit(".", 1)[-1] == "pallas_call":
+                if node.args:
+                    kern = node.args[0]
+                    if isinstance(kern, ast.Name):
+                        traced.setdefault(kern.id, ())
+                    elif (isinstance(kern, ast.Call) and kern.args
+                          and isinstance(kern.args[0], ast.Name)):
+                        traced.setdefault(kern.args[0].id, ())
+    return traced
+
+
+def host_in_jit(src: FileSource) -> list[Finding]:
+    traced = _collect_traced_functions(src)
+    if not traced:
+        return []
+    out = []
+    for node in ast.walk(src.tree):
+        if not (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name in traced):
+            continue
+        statics = set(traced[node.name])
+        args = node.args
+        params = {a.arg for a in (args.posonlyargs + args.args
+                                  + args.kwonlyargs)}
+        # Keyword-only params default to static in this codebase's idiom
+        # (block_n/interpret style knobs); positional params are traced
+        # unless named in static_argnames.
+        dyn = params - statics - {a.arg for a in args.kwonlyargs} - {"self"}
+        for inner in ast.walk(node):
+            if isinstance(inner, ast.Attribute):
+                base = inner.value
+                if isinstance(base, ast.Name) and base.id in ("np", "numpy"):
+                    out.append(_f(src, inner,
+                                  f"host numpy (`np.{inner.attr}`) inside "
+                                  f"traced body `{node.name}` — runs at "
+                                  "trace time / forces a host sync; use "
+                                  "jnp or hoist to the call site"))
+            elif isinstance(inner, ast.Call):
+                fn_name = _dotted(inner.func)
+                if (fn_name in ("float", "int", "bool") and inner.args
+                        and not isinstance(inner.args[0], ast.Constant)
+                        and not (isinstance(inner.args[0], ast.Name)
+                                 and inner.args[0].id in statics)):
+                    out.append(_f(src, inner,
+                                  f"host `{fn_name}()` on a value inside "
+                                  f"traced body `{node.name}` — implicit "
+                                  "device->host transfer (or a tracer "
+                                  "error); keep it on device or mark the "
+                                  "arg static"))
+                elif (isinstance(inner.func, ast.Attribute)
+                      and inner.func.attr == "item"):
+                    out.append(_f(src, inner,
+                                  f"`.item()` inside traced body "
+                                  f"`{node.name}` — blocking device->host "
+                                  "transfer"))
+            elif isinstance(inner, (ast.If, ast.While)):
+                names = {n.id for n in ast.walk(inner.test)
+                         if isinstance(n, ast.Name)}
+                hot = names & dyn
+                if hot:
+                    out.append(_f(src, inner,
+                                  "Python control flow on traced value(s) "
+                                  f"{sorted(hot)} inside `{node.name}` — "
+                                  "recompiles per value (or tracer error); "
+                                  "use jnp.where/lax.cond or mark static"))
+    return out
+
+
+# -- 5. wire-layer -----------------------------------------------------------
+
+# The blessed wire layer: the ONLY seats allowed to move bytes across the
+# host<->device link.  Everything else must feed through them so wire
+# accounting (StageRecorder h2d/d2h bytes) and the adaptive encoder can't
+# be bypassed.
+_WIRE_LAYER = ("tse1m_tpu/cluster/encode.py", "tse1m_tpu/cluster/pipeline.py")
+
+
+def wire_layer(src: FileSource) -> list[Finding]:
+    if src.path in _WIRE_LAYER:
+        return []
+    out = []
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Call):
+            name = _dotted(node.func)
+            if name.rsplit(".", 1)[-1] in ("device_put", "device_get"):
+                out.append(_f(src, node,
+                              f"`{name}` outside the wire layer "
+                              f"({', '.join(_WIRE_LAYER)}) — transfers "
+                              "bypass wire accounting and the adaptive "
+                              "encoder; route through the pipeline or "
+                              "baseline with a reason"))
+    return out
+
+
+# -- 6. unlocked-shared-state ------------------------------------------------
+
+def _is_lock_ctor(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and _dotted(node.func).rsplit(".", 1)[-1] in ("Lock", "RLock"))
+
+
+def _self_attr_written(target: ast.AST) -> str | None:
+    """'x' for targets self.x / self.x[...] — the shared attr mutated."""
+    node = target
+    while isinstance(node, (ast.Subscript, ast.Starred)):
+        node = node.value
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name) and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _under_lock(node: ast.AST, parents: dict, lock_names: set) -> bool:
+    while node is not None:
+        node = parents.get(node)
+        if isinstance(node, ast.With):
+            for item in node.items:
+                expr = item.context_expr
+                attr = None
+                if isinstance(expr, ast.Attribute):
+                    attr = expr.attr
+                elif isinstance(expr, ast.Name):
+                    attr = expr.id
+                elif isinstance(expr, ast.Call):
+                    attr = _dotted(expr.func).rsplit(".", 1)[-1]
+                if attr in lock_names:
+                    return True
+    return False
+
+
+def unlocked_shared_state(src: FileSource) -> list[Finding]:
+    parents = _parents(src.tree)
+    out = []
+    # Class-owned locks: any self-attribute mutation outside __init__ must
+    # hold the lock (the class declared its state shared by creating one).
+    for cls in ast.walk(src.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        locks = set()
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+                for t in node.targets:
+                    name = _self_attr_written(t)
+                    if name:
+                        locks.add(name)
+        if not locks:
+            continue
+        for meth in cls.body:
+            if not isinstance(meth, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if meth.name == "__init__":
+                continue
+            for node in ast.walk(meth):
+                targets = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [node.target]
+                for t in targets:
+                    attr = _self_attr_written(t)
+                    if attr and attr not in locks and not _under_lock(
+                            node, parents, locks):
+                        out.append(_f(src, node,
+                                      f"`self.{attr}` mutated outside "
+                                      f"`with self.{next(iter(locks))}` in "
+                                      f"lock-owning class {cls.name} — "
+                                      "racy with the producer thread"))
+    # Module-level locks guarding globals.
+    mod_locks = set()
+    guarded: set = set()
+    for node in src.tree.body:
+        if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+            mod_locks |= {t.id for t in node.targets
+                          if isinstance(t, ast.Name)}
+    if mod_locks:
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                names = {t.id for t in targets if isinstance(t, ast.Name)}
+                if names and _under_lock(node, parents, mod_locks):
+                    guarded |= names
+        for fn in ast.walk(src.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = (node.targets if isinstance(node, ast.Assign)
+                               else [node.target])
+                    names = {t.id for t in targets
+                             if isinstance(t, ast.Name)} & guarded
+                    if names and not _under_lock(node, parents, mod_locks):
+                        out.append(_f(src, node,
+                                      f"global(s) {sorted(names)} mutated "
+                                      "outside the module lock that guards "
+                                      "them elsewhere"))
+    return out
+
+
+# -- 7. retry-bypass ---------------------------------------------------------
+
+_TRANSPORT = "tse1m_tpu/collect/transport.py"
+_DB_LAYER = ("tse1m_tpu/db/connection.py", "tse1m_tpu/db/pglib.py")
+_HTTP_FNS = {"get", "post", "put", "head", "delete", "request", "Session"}
+
+
+def retry_bypass(src: FileSource) -> list[Finding]:
+    out = []
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not isinstance(func, ast.Attribute):
+            continue
+        base = func.value
+        if (src.path != _TRANSPORT
+                and isinstance(base, ast.Name) and base.id == "requests"
+                and func.attr in _HTTP_FNS):
+            out.append(_f(src, node,
+                          f"direct `requests.{func.attr}` bypasses the "
+                          "retry engine — use collect.transport."
+                          "HttpFetcher (backoff, Retry-After, fault "
+                          "injection seats)"))
+        if func.attr == "urlopen":
+            out.append(_f(src, node,
+                          "`urlopen` bypasses the retry engine — use "
+                          "collect.transport.HttpFetcher"))
+        if (src.path not in _DB_LAYER
+                and func.attr in ("execute", "executemany", "executescript")):
+            is_cursor = (
+                (isinstance(base, ast.Attribute) and base.attr == "cursor")
+                or (isinstance(base, ast.Name)
+                    and base.id in ("cursor", "cur")))
+            if is_cursor:
+                out.append(_f(src, node,
+                              "raw cursor execute bypasses the DB retry/"
+                              "reconnect engine — use DB.execute/query/"
+                              "executeMany/run_transaction"))
+    return out
+
+
+# -- 8. nondeterminism -------------------------------------------------------
+
+# Planes replayed under seeded fault plans / chaos tests: wall-clock and
+# global-RNG reads there make a replay diverge from the recorded run.
+_REPLAY_PLANES = ("tse1m_tpu/resilience/", "tse1m_tpu/collect/",
+                  "tse1m_tpu/db/", "tse1m_tpu/cluster/")
+_RANDOM_OK = {"Random", "SystemRandom", "getstate", "seed"}
+
+
+def nondeterminism(src: FileSource) -> list[Finding]:
+    if not src.path.startswith(_REPLAY_PLANES):
+        return []
+    out = []
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _dotted(node.func)
+        if name in ("time.time", "time.time_ns"):
+            out.append(_f(src, node,
+                          f"wall clock `{name}()` in a chaos-replayed "
+                          "plane — use time.monotonic for intervals, or "
+                          "suppress if this is pure telemetry"))
+        elif name in ("datetime.now", "datetime.utcnow", "date.today",
+                      "datetime.datetime.now", "datetime.date.today"):
+            out.append(_f(src, node,
+                          f"`{name}()` in a chaos-replayed plane — pass "
+                          "the date in from the caller so a replay sees "
+                          "the recorded value"))
+        elif (name.startswith("random.")
+              and name.split(".", 1)[1] not in _RANDOM_OK
+              and name.count(".") == 1):
+            out.append(_f(src, node,
+                          f"global-RNG `{name}()` in a chaos-replayed "
+                          "plane — draw from a seeded random.Random "
+                          "(resilience.faults idiom)"))
+        elif (name.startswith("np.random.") or name.startswith(
+                "numpy.random.")) and not name.endswith("default_rng"):
+            out.append(_f(src, node,
+                          f"legacy global `{name}()` — use a seeded "
+                          "np.random.default_rng"))
+    return out
+
+
+RULES = {
+    "broad-except": broad_except,
+    "nonatomic-write": nonatomic_write,
+    "sql-interp": sql_interp,
+    "host-in-jit": host_in_jit,
+    "wire-layer": wire_layer,
+    "unlocked-shared-state": unlocked_shared_state,
+    "retry-bypass": retry_bypass,
+    "nondeterminism": nondeterminism,
+}
+
+__all__ = ["RULES"]

@@ -1,0 +1,186 @@
+"""The runtime half of the sanitizer plane.
+
+The static rules can prove a transfer SEAT sits in the wire layer; only
+the runtime can prove the hot loop actually performs **zero implicit
+host->device transfers** and stays within a **bounded compile budget**.
+Two mechanisms, both degradation-gated (no hard dependency on any
+particular jax version):
+
+- :func:`no_implicit_transfers` — ``jax.transfer_guard_host_to_device
+  ("disallow")``: any *implicit* staging (a numpy array or Python scalar
+  silently uploaded as a jit argument) raises, while the wire layer's
+  explicit ``device_put``/``jnp.asarray`` conversions stay legal.  This
+  is exactly the regression class PR 2 fought: a stray np scalar in a
+  jit call re-ships bytes every chunk.
+- :class:`CompileCounter` — counts XLA backend compiles via
+  ``jax.monitoring``'s ``/jax/core/compile/backend_compile_duration``
+  event.  A warm steady-state run must compile NOTHING; a growing count
+  between bench rounds is the silent-recompile signature (shape drift,
+  weak-type flapping, cache-key churn).
+
+:func:`sanitized` combines both for the bench / test harness:
+
+    with sanitized(compile_budget=0) as san:
+        cluster_sessions(items, params)        # warm run
+    # raises SanitizerViolation if anything compiled
+
+jax.monitoring has no listener-removal API, so ONE module listener is
+installed lazily and counters snapshot its monotonic total.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+from ..utils.logging import get_logger
+
+log = get_logger("lint.runtime")
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_state_lock = threading.Lock()
+_compiles_total = 0
+_listener_installed = False
+_listener_ok: bool | None = None
+
+
+class SanitizerViolation(AssertionError):
+    """The hot loop broke a runtime invariant (implicit transfer or
+    compile budget)."""
+
+
+def _install_listener() -> bool:
+    """Idempotently install the global compile-event listener; returns
+    availability."""
+    global _listener_installed, _listener_ok
+    with _state_lock:
+        if _listener_installed:
+            return bool(_listener_ok)
+        _listener_installed = True
+        try:
+            import jax.monitoring as monitoring
+
+            def _on_event(event: str, duration: float = 0.0, **kw) -> None:
+                global _compiles_total
+                if event == _COMPILE_EVENT:
+                    with _state_lock:
+                        _compiles_total += 1
+
+            monitoring.register_event_duration_secs_listener(_on_event)
+            _listener_ok = True
+        except Exception as e:  # graftlint: disable=broad-except -- jax absent/too old; sanitizer degrades to unavailable
+            log.warning("compile counter unavailable (%s: %s)",
+                        type(e).__name__, e)
+            _listener_ok = False
+    return bool(_listener_ok)
+
+
+def compiles_so_far() -> int | None:
+    """Process-lifetime backend-compile count (None when the monitoring
+    hook is unavailable)."""
+    if not _install_listener():
+        return None
+    with _state_lock:
+        return _compiles_total
+
+
+class CompileCounter:
+    """Context manager: XLA backend compiles that happened inside the
+    block.  ``count`` is None when jax.monitoring is unavailable."""
+
+    def __init__(self) -> None:
+        self.count: int | None = None
+        self._start: int | None = None
+
+    def __enter__(self) -> "CompileCounter":
+        self._start = compiles_so_far()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        end = compiles_so_far()
+        if self._start is not None and end is not None:
+            self.count = end - self._start
+
+
+@contextlib.contextmanager
+def no_implicit_transfers():
+    """Disallow implicit host->device staging inside the block (explicit
+    device_put/jnp.asarray conversions — the wire layer — stay legal).
+    Degrades to a no-op when this jax has no transfer guard."""
+    try:
+        import jax
+
+        guard = jax.transfer_guard_host_to_device
+    except (ImportError, AttributeError) as e:
+        log.warning("transfer guard unavailable (%s: %s)",
+                    type(e).__name__, e)
+        yield False
+        return
+    with guard("disallow"):
+        yield True
+
+
+class SanitizerReport:
+    """What the sanitized block observed — embeddable in bench JSON and
+    the run manifest."""
+
+    def __init__(self) -> None:
+        self.transfer_guard_active = False
+        self.compile_count: int | None = None
+        self.compile_budget: int | None = None
+
+    def as_dict(self) -> dict:
+        return {
+            "sanitizer_transfer_guard": self.transfer_guard_active,
+            "sanitizer_compile_count": self.compile_count,
+            "sanitizer_compile_budget": self.compile_budget,
+        }
+
+
+@contextlib.contextmanager
+def sanitized(compile_budget: int | None = None):
+    """Run the block under the full sanitizer: implicit H2D transfers
+    raise immediately (via the transfer guard), and on exit the compile
+    count is checked against ``compile_budget`` (None = record only).
+
+    Yields a :class:`SanitizerReport`; raises
+    :class:`SanitizerViolation` when the budget is exceeded."""
+    report = SanitizerReport()
+    report.compile_budget = compile_budget
+    with no_implicit_transfers() as guard_on:
+        report.transfer_guard_active = bool(guard_on)
+        with CompileCounter() as counter:
+            yield report
+    report.compile_count = counter.count
+    if (compile_budget is not None and counter.count is not None
+            and counter.count > compile_budget):
+        raise SanitizerViolation(
+            f"compile budget exceeded: {counter.count} XLA compiles in a "
+            f"sanitized block budgeted for {compile_budget} — a warm hot "
+            "loop should not be compiling (shape drift / weak-type "
+            "flapping / cache-key churn)")
+
+
+def self_check() -> dict:
+    """Cheap per-run proof that the sanitizer plane works on this
+    process's jax: a tiny jitted op under the guard, warm call budget 0.
+    Returns the report dict (the ``cli all`` manifest step embeds it)."""
+    try:
+        import jax
+        import jax.numpy as jnp
+    except ImportError:
+        return {"sanitizer_available": False}
+    f = jax.jit(lambda v: v * 2 + 1)
+    x = jnp.arange(8, dtype=jnp.int32)
+    f(x).block_until_ready()  # compile outside the sanitized window
+    with sanitized(compile_budget=0) as report:
+        f(x).block_until_ready()
+    out = report.as_dict()
+    out["sanitizer_available"] = True
+    return out
+
+
+__all__ = ["CompileCounter", "SanitizerReport", "SanitizerViolation",
+           "compiles_so_far", "no_implicit_transfers", "sanitized",
+           "self_check"]

@@ -62,7 +62,11 @@ class StageRecorder:
             self.add(name, time.perf_counter() - t0, nbytes)
 
     def set_total(self, seconds: float) -> None:
-        self.total_wall_s = seconds
+        # Under the lock like every other mutation: the producer thread
+        # can still be adding its last h2d record when the main thread
+        # closes out the run (caught by graftlint unlocked-shared-state).
+        with self._lock:
+            self.total_wall_s = seconds
 
     def h2d_overlap_fraction(self) -> float:
         """Fraction of H2D seconds hidden behind other stages.

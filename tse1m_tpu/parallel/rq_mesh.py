@@ -55,6 +55,7 @@ def _device_f64_exact(device) -> bool:
     if key not in _F64_EXACT:
         canary = np.array([1.0 + 2.0 ** -50, np.pi, 1e300], dtype=np.float64)
         with enable_x64(True):
+            # graftlint: disable=wire-layer -- 4-byte mesh-liveness canary, not a data transfer
             back = np.asarray(jax.device_get(jax.device_put(canary, device)))
         _F64_EXACT[key] = bool(np.array_equal(canary, back))
     return _F64_EXACT[key]
@@ -187,7 +188,7 @@ def _percentile_mesh_kernel(mesh: Mesh):
                        P(None, AXIS)),
              out_specs=(P(None, AXIS), P(None, AXIS)))
     def kernel(x, m, lo_, hi_):
-        big = jnp.float32(np.finfo(np.float32).max)
+        big = jnp.finfo(jnp.float32).max
         srt = jnp.sort(jnp.where(m, x, big), axis=-1)  # valid entries first
         vlo = jnp.take_along_axis(srt, lo_.T, axis=-1).T
         vhi = jnp.take_along_axis(srt, hi_.T, axis=-1).T

@@ -30,14 +30,15 @@ import os
 
 from .faults import (FaultPlan, FaultRule, InjectedConnectionDrop,
                      InjectedFault, active_plan, clear_plan, fault_point,
-                     install_plan)
+                     install_plan, reraise_if_fault)
 from .retry import RetryError, RetryPolicy, retry_call
 from .runner import StepRunner
 
 __all__ = [
     "FaultPlan", "FaultRule", "InjectedConnectionDrop", "InjectedFault",
     "RetryError", "RetryPolicy", "StepRunner", "active_plan", "clear_plan",
-    "fault_point", "install_plan", "io_retry_policy", "retry_call",
+    "fault_point", "install_plan", "io_retry_policy", "reraise_if_fault",
+    "retry_call",
 ]
 
 

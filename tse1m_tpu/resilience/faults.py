@@ -110,7 +110,9 @@ class FaultPlan:
         return {"seed": self.seed, "rules": rules}
 
     def save(self, path: str) -> str:
-        with open(path, "w", encoding="utf-8") as f:
+        from ..utils.atomic import atomic_write
+
+        with atomic_write(path) as f:
             json.dump(self.to_dict(), f, indent=2)
         return path
 
@@ -215,3 +217,16 @@ def fault_point(site: str, path: str | None = None) -> None:
     plan = active_plan()
     if plan is not None:
         plan.fire(site, path=path)
+
+
+def reraise_if_fault(exc: BaseException) -> None:
+    """Fault-transparency guard for handlers that must stay broad.
+
+    A seat like the issue scraper's client-restart loop genuinely has to
+    catch *anything* (Selenium raises arbitrary driver exceptions), but a
+    broad handler that also eats :class:`InjectedFault` makes the chaos
+    tests blind at that seat.  Calling this first keeps the handler broad
+    for real failures while injected faults propagate — graftlint's
+    ``broad-except`` rule recognises the call as fault-safe."""
+    if isinstance(exc, InjectedFault):
+        raise exc

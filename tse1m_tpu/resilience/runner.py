@@ -7,6 +7,7 @@ leaves an accurate partial record on disk.  ``exit_code()`` reflects
 partial failure — previously ``cli all`` aborted every remaining RQ on
 the first exception and a missing module still exited 0.
 """
+# graftlint: disable-file=nondeterminism -- time.time() here stamps manifest telemetry (started_at/wall_s), never replay control flow
 
 from __future__ import annotations
 
@@ -35,6 +36,11 @@ class StepRecord:
     # stage_*_s / stage_*_mb / h2d_overlap_fraction) when the step's body
     # recorded any — e.g. a cluster step's encode/h2d/compute/d2h split.
     stages: dict | None = None
+    # Structured step output: a step function that returns a dict gets it
+    # embedded verbatim (e.g. the graftlint step's finding counts and
+    # sanitizer self-check); a failing step may attach one via a
+    # ``step_result`` attribute on the raised exception.
+    result: dict | None = None
 
 
 class StepRunner:
@@ -74,10 +80,15 @@ class StepRunner:
             return fn(*args, **kwargs)
 
         try:
-            retry_call(attempt, policy=self.policy, site=f"step:{name}")
+            ret = retry_call(attempt, policy=self.policy, site=f"step:{name}")
             rec.status = "ok"
+            if isinstance(ret, dict):
+                rec.result = ret
         except BaseException as e:  # noqa: BLE001 — isolation is the point
             cause = e.__cause__ if isinstance(e, RetryError) and e.__cause__ else e
+            res = getattr(cause, "step_result", None)
+            if isinstance(res, dict):
+                rec.result = res
             rec.error = f"{type(cause).__name__}: {cause}".strip().rstrip(":")
             rec.status = "failed"
             rec.traceback = traceback.format_exc()

@@ -12,6 +12,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Any
 
+from .atomic import atomic_write
+
 
 @dataclass
 class RunManifest:
@@ -36,7 +38,7 @@ class RunManifest:
                 "device_count": jax.device_count(),
                 "devices": [str(d) for d in jax.devices()],
             }
-        except Exception:  # jax absent or uninitialised — manifest still valid
+        except Exception:  # graftlint: disable=broad-except -- jax absent or uninitialised; manifest still valid
             return {}
 
     def record_backend(self, backend) -> None:
@@ -62,6 +64,6 @@ class RunManifest:
             **self.extra,
         }
         path = os.path.join(out_dir, f"{self.name}_manifest.json")
-        with open(path, "w", encoding="utf-8") as f:
+        with atomic_write(path) as f:
             json.dump(payload, f, indent=2, default=str)
         return path
