@@ -281,6 +281,22 @@ def main() -> int:
                    help="if >0, also ARI-check a host-clustered subsample "
                         "(the BASELINE.json acceptance gate: >= 0.98 vs the "
                         "CPU/pandas baseline)")
+    p.add_argument("--sig-store", default=os.environ.get("BENCH_SIG_STORE")
+                   or None,
+                   help="persistent signature-store directory "
+                        "(cluster/store.py): after the cold timed runs, "
+                        "run the store-enabled pipeline twice (populate "
+                        "if needed, then warm) and emit "
+                        "cluster_warm_wall_s / cache_hit_rate / "
+                        "cache_wire_saved_mb.  Persists across "
+                        "invocations — a second bench run starts warm "
+                        "(also BENCH_SIG_STORE)")
+    p.add_argument("--warm-novel-frac", type=float,
+                   default=float(os.environ.get("BENCH_WARM_NOVEL", 0.0)),
+                   help="append this fraction of fresh synthetic rows to "
+                        "the warm run's input (the continuous-fuzzing "
+                        "accretion shape); 0 re-clusters the identical "
+                        "corpus and asserts warm labels == cold labels")
     p.add_argument("--sanitize", action="store_true",
                    default=os.environ.get("BENCH_SANITIZE", "")
                    not in ("", "0"),
@@ -308,6 +324,14 @@ def main() -> int:
         from tse1m_tpu.utils.compat import enable_persistent_compilation_cache
 
         enable_persistent_compilation_cache(cache_dir)
+
+    # Record-and-reuse auto-router calibration (backend/auto.py): persist
+    # measured per-RQ walls so the next bench round's `auto` column routes
+    # on this round's measurements instead of bootstrap priors — the
+    # BENCH_r05 rq2tr mispick cannot recur across rounds.  Opt out with
+    # TSE1M_ROUTER_CAL="".
+    os.environ.setdefault("TSE1M_ROUTER_CAL",
+                          "data/result_data/router_calibration.json")
 
     import jax
 
@@ -453,6 +477,7 @@ def main() -> int:
         med = statistics.median(samples)
         return {
             "transfer_mb": round(nbytes / 2**20, 1),
+            "transfer_bytes": nbytes,
             "transfer_s": round(med, 4),
             # The tunnel varies ~2x minute-to-minute; the per-rep list
             # (and best) keep one slow window from reading as the bound.
@@ -470,6 +495,82 @@ def main() -> int:
         print(f"# transfer probe failed ({type(e).__name__}: {e})",
               file=sys.stderr)
         transfer_stats = {}
+
+    # Wire-accounting drift guard (outside the probe's failure guard on
+    # purpose — a mismatch must FAIL the bench, not degrade it): the
+    # probe's byte inventory must equal the H2D bytes the timed run's
+    # StageRecorder actually recorded, so `transfer_mb` can never diverge
+    # from what the pipeline ships.  A nonzero drift means wire_payloads
+    # and the pipeline disagree about the wire format — a lying artifact.
+    wire_drift = None
+    if transfer_stats and cluster_info.get("wire_bytes") is not None:
+        wire_drift = (transfer_stats["transfer_bytes"]
+                      - cluster_info["wire_bytes"])
+        if wire_drift != 0:
+            raise AssertionError(
+                f"wire accounting drift: transfer probe inventories "
+                f"{transfer_stats['transfer_bytes']} B but the timed run "
+                f"recorded {cluster_info['wire_bytes']} B over h2d")
+
+    def bench_warm_store() -> dict:
+        """Signature-store warm rounds: one store-enabled run to populate
+        (a no-op when the on-disk store already covers the corpus), then
+        ONE timed warm run — under the runtime sanitizer when --sanitize,
+        proving the warm path stays zero-implicit-transfer and within the
+        compile budget.  With --warm-novel-frac 0 the warm labels are
+        asserted equal to the cold run's elementwise."""
+        import contextlib
+
+        import numpy as np
+
+        from dataclasses import replace
+
+        from tse1m_tpu.cluster.pipeline import last_run_info as lri
+
+        store_params = replace(params, sig_store=args.sig_store)
+        warm_items = items
+        k_nov = int(args.n * args.warm_novel_frac)
+        if k_nov > 0:
+            nov, _ = synth_session_sets(k_nov, set_size=args.set_size,
+                                        seed=args.seed + 7919)
+            warm_items = np.concatenate([items, nov])
+        # Cover the BASE corpus (a no-op when a previous invocation's
+        # on-disk store already has it) so the timed run below is the
+        # realistic warm shape: yesterday's corpus cached, the novel
+        # tail seen for the first time.
+        cluster_sessions(items, store_params)
+        ctx = contextlib.nullcontext()
+        if args.sanitize:
+            from tse1m_tpu.lint.runtime import sanitized
+
+            ctx = sanitized(args.compile_budget)
+        t0 = time.perf_counter()
+        with ctx:
+            warm_labels = cluster_sessions(warm_items, store_params)
+        warm_wall = time.perf_counter() - t0
+        winfo = dict(lri)
+        if k_nov == 0 and not np.array_equal(warm_labels, labels):
+            raise AssertionError(
+                "warm store labels differ from the cold run's — the "
+                "incremental path broke label parity")
+        warm_wire = winfo.get("wire_mb", 0.0)
+        return {
+            "cluster_warm_wall_s": round(warm_wall, 4),
+            "cache_hit_rate": winfo.get("cache_hit_rate"),
+            "cache_mode": winfo.get("cache_mode"),
+            "cache_novel_rows": winfo.get("cache_novel_rows"),
+            "cache_warm_wire_mb": warm_wire,
+            # Wire the warm run did NOT ship, vs the measured cold run.
+            "cache_wire_saved_mb": round(
+                max(0.0, cluster_info.get("wire_mb", 0.0) - warm_wire), 2),
+            "cache_warm_novel_frac": args.warm_novel_frac,
+            "cache_warm_sanitized": bool(args.sanitize),
+        }
+
+    warm_stats = {}
+    if args.sig_store:
+        warm_stats = bench_warm_store()
+
     ari = adjusted_rand_index(labels, truth)
     ari_host = None
     if args.ari_sample > 0:
@@ -510,6 +611,9 @@ def main() -> int:
     result.update({f"cluster_{k}": v for k, v in cluster_info.items()})
     result.update(stage_info)
     result.update(transfer_stats)
+    if wire_drift is not None:
+        result["wire_drift_bytes"] = wire_drift
+    result.update(warm_stats)
     if sanitizer is not None:
         # Runtime-sanitizer proof for this bench round: the timed window
         # ran under the transfer guard (zero implicit H2D transfers, or it

@@ -58,6 +58,33 @@ def run_cluster(args) -> int:
     return 0
 
 
+def run_store(args) -> int:
+    """Store-enabled clustering (cluster/store.py): populate or warm
+    against ``--store-dir``; labels land in ``--out`` as .npy.  The chaos
+    tests SIGKILL this mid store-shard write (site ``store.sig.save``) or
+    mid state commit (``store.state.save``) and assert the next run
+    detects the torn artifact, recomputes, and produces labels identical
+    to an uninterrupted storeless run."""
+    import json
+
+    import numpy as np
+
+    from tse1m_tpu.cluster import ClusterParams, cluster_sessions
+    from tse1m_tpu.cluster.pipeline import last_run_info
+    from tse1m_tpu.data.synth import synth_session_sets
+
+    items = synth_session_sets(args.n, set_size=16, seed=args.seed)[0]
+    params = ClusterParams(n_hashes=32, n_bands=4, use_pallas="never",
+                           sig_store=args.store_dir)
+    labels = cluster_sessions(items, params)
+    np.save(args.out, labels)
+    if args.info:
+        with open(args.info, "w") as f:
+            json.dump({k: v for k, v in last_run_info.items()
+                       if k != "stages"}, f)
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -77,6 +104,14 @@ def main(argv=None) -> int:
     p.add_argument("--no-overlap", action="store_true")
     p.add_argument("--info", default=None)
     p.set_defaults(fn=run_cluster)
+
+    p = sub.add_parser("store")
+    p.add_argument("--store-dir", required=True)
+    p.add_argument("--out", required=True)
+    p.add_argument("--n", type=int, default=1024)
+    p.add_argument("--seed", type=int, default=13)
+    p.add_argument("--info", default=None)
+    p.set_defaults(fn=run_store)
 
     args = ap.parse_args(argv)
     return args.fn(args)

@@ -77,6 +77,69 @@ def test_slow_device_measurement_flips_back():
     assert be._pick("rq2cp", 713_000)[0] == "pandas"
 
 
+def test_unmeasured_engine_gets_explored():
+    """The BENCH_r05 mispick (rq2tr_auto 0.345 s vs pure jax 0.138 s):
+    once the host was measured, the device's bootstrap prior could never
+    win the argmin, so it was never tried.  An unmeasured engine whose
+    prior is within the exploration band of the measured incumbent must
+    be routed once to get measured."""
+    be = AutoBackend(rtt_s=0.11)
+    rows = 415_000
+    assert be._pick("rq2tr", rows)[0] == "pandas"      # prior: host wins
+    be._observe("rq2tr", "pandas", rows, wall_s=0.31)  # the r05 host wall
+    # device prior (4 RTT = 0.44 s) is inside the band: must be tried
+    assert be._pick("rq2tr", rows)[0] == "jax"
+    be._observe("rq2tr", "jax", rows, wall_s=0.14)     # the r05 device wall
+    # both measured: measured winner sticks
+    assert be._pick("rq2tr", rows)[0] == "jax"
+    assert be._pick("rq2tr", rows)[0] == "jax"         # no flapping
+    # hopeless priors are NOT explored (rq1-shaped: host wins 8x)
+    be._observe("rq1", "pandas", 1_000_000, wall_s=0.018)
+    assert be._pick("rq1", 1_000_000)[0] == "pandas"
+
+
+def test_calibration_persists_across_instances(tmp_path):
+    """Record-and-reuse: measured walls saved to cal_path seed the next
+    AutoBackend on this machine, so a fresh process routes on last run's
+    measurements instead of re-learning from priors."""
+    path = str(tmp_path / "router_cal.json")
+    be = AutoBackend(rtt_s=0.11, cal_path=path)
+    be._observe("rq2tr", "pandas", 415_000, wall_s=0.31)
+    be._observe("rq2tr", "jax", 415_000, wall_s=0.14)
+    be2 = AutoBackend(rtt_s=0.11, cal_path=path)
+    assert be2._cost[("rq2tr", "jax")] == pytest.approx(
+        be._cost[("rq2tr", "jax")])
+    assert be2._pick("rq2tr", 415_000)[0] == "jax"
+    # a corrupt file degrades to priors, never crashes
+    with open(path, "w") as f:
+        f.write("{ not json")
+    be3 = AutoBackend(rtt_s=0.11, cal_path=path)
+    assert be3._cost == {}
+    # unknown rqs/engines in the file are ignored
+    import json
+
+    with open(path, "w") as f:
+        json.dump({"cost_per_row": {"rq9:cuda": 1.0, "rq1:pandas": 2e-8}},
+                  f)
+    be4 = AutoBackend(rtt_s=0.11, cal_path=path)
+    assert be4._cost == {("rq1", "pandas"): 2e-8}
+
+
+def test_get_backend_passes_cal_path_from_env(monkeypatch, tmp_path):
+    import jax
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(backend_mod, "_dispatch_rtt_s", lambda: 0.11)
+    path = str(tmp_path / "cal.json")
+    monkeypatch.setenv("TSE1M_ROUTER_CAL", path)
+    be = get_backend(Config(backend="auto"))
+    assert be._cal_path == path
+    # empty env disables persistence
+    monkeypatch.setenv("TSE1M_ROUTER_CAL", "")
+    backend_mod._auto_rtt_s = None
+    assert get_backend(Config(backend="auto"))._cal_path is None
+
+
 def test_first_device_call_excluded_from_calibration(study_cfg, study_db):
     """The first device call per RQ pays jit compilation and must not be
     recorded as that engine's steady-state cost."""

@@ -136,6 +136,95 @@ def test_cluster_overlap_sigkill_resume_equals_uninterrupted(tmp_path):
         assert key in stages, stages
 
 
+def test_store_shard_sigkill_resume_equals_uninterrupted(tmp_path):
+    """SIGKILL mid signature-store shard write (cluster/store.py, site
+    ``store.sig.save``: temp files written, not yet renamed/committed):
+    the next run must see no committed shard, sweep the torn temps,
+    recompute, and land on labels identical to an uninterrupted run —
+    including when a COMMITTED shard is additionally truncated on disk
+    afterwards (mirroring cluster/checkpoint.py's torn-shard handling)."""
+    import json
+
+    clean_out = str(tmp_path / "clean.npy")
+    run_driver(["store", "--store-dir", str(tmp_path / "store_clean"),
+                "--out", clean_out])
+    want = np.load(clean_out)
+
+    plan_path = str(tmp_path / "plan.json")
+    FaultPlan([FaultRule(site="store.sig.save", kind="kill")]).save(plan_path)
+    store_dir = str(tmp_path / "store_chaos")
+    out = str(tmp_path / "chaos.npy")
+    run_driver(["store", "--store-dir", store_dir, "--out", out],
+               fault_plan_path=plan_path, expect_kill=True)
+    assert not os.path.exists(out)
+    # the torn write is visible (temps), but no shard was committed
+    assert glob.glob(os.path.join(store_dir, "*.tmp.npy"))
+    with open(os.path.join(store_dir, "store_manifest.json")) as f:
+        assert json.load(f)["shards"] == []
+
+    # Resume without the plan: populate completes; torn temps are swept.
+    info_path = str(tmp_path / "info.json")
+    run_driver(["store", "--store-dir", store_dir, "--out", out,
+                "--info", info_path])
+    np.testing.assert_array_equal(np.load(out), want)
+    assert not glob.glob(os.path.join(store_dir, "*.tmp.npy"))
+
+    # Warm re-run hits the cache and merges.
+    run_driver(["store", "--store-dir", store_dir, "--out", out,
+                "--info", info_path])
+    info = json.load(open(info_path))
+    assert info["cache_mode"] == "merge" and info["cache_hit_rate"] > 0.9
+
+    # Torn committed shard: truncate it on disk — the next run must
+    # detect the unreadable shard, drop it, recompute its rows, and
+    # still produce identical labels.
+    shard = sorted(glob.glob(os.path.join(store_dir, "sig_*.npy")))[0]
+    with open(shard, "rb+") as f:
+        f.truncate(os.path.getsize(shard) // 2)
+    run_driver(["store", "--store-dir", store_dir, "--out", out,
+                "--info", info_path])
+    np.testing.assert_array_equal(np.load(out), want)
+
+
+def test_store_state_sigkill_falls_back_to_cached_sigs(tmp_path):
+    """SIGKILL mid LSH-state commit (site ``store.state.save``): the
+    signature shards are already durable, so the next run starts with a
+    full signature cache but no mergeable state — it must take the
+    union path on cached signatures and produce identical labels."""
+    import json
+
+    clean_out = str(tmp_path / "clean.npy")
+    run_driver(["store", "--store-dir", str(tmp_path / "store_clean"),
+                "--out", clean_out])
+    want = np.load(clean_out)
+
+    plan_path = str(tmp_path / "plan.json")
+    FaultPlan([FaultRule(site="store.state.save",
+                         kind="kill")]).save(plan_path)
+    store_dir = str(tmp_path / "store_chaos")
+    out = str(tmp_path / "chaos.npy")
+    run_driver(["store", "--store-dir", store_dir, "--out", out],
+               fault_plan_path=plan_path, expect_kill=True)
+    assert not os.path.exists(out)
+    # shards committed before the kill...
+    assert glob.glob(os.path.join(store_dir, "sig_*.npy"))
+    # ...but no state was.
+    assert not os.path.exists(os.path.join(store_dir, "state.json"))
+
+    info_path = str(tmp_path / "info.json")
+    run_driver(["store", "--store-dir", store_dir, "--out", out,
+                "--info", info_path])
+    np.testing.assert_array_equal(np.load(out), want)
+    info = json.load(open(info_path))
+    assert info["cache_mode"] == "union" and info["cache_hit_rate"] > 0.9
+
+    # with the state now committed, the next run merges
+    run_driver(["store", "--store-dir", store_dir, "--out", out,
+                "--info", info_path])
+    np.testing.assert_array_equal(np.load(out), want)
+    assert json.load(open(info_path))["cache_mode"] == "merge"
+
+
 @pytest.mark.slow
 def test_cluster_sigkill_twice_then_resume(tmp_path):
     """Two consecutive kills at different chunks, then a clean resume —

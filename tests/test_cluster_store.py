@@ -1,0 +1,327 @@
+"""Persistent signature store + incremental clustering (ISSUE 4 tentpole).
+
+The acceptance property is exactness: a warm run — any mix of cached
+signatures, novel rows, accreted tails — must produce labels equal
+ELEMENTWISE (hence ARI == 1.0) to a cold batch run over the same input,
+across encodings and quantization widths.  Plus the store mechanics:
+content addressing, policy refusal, torn/evicted shard handling, and the
+wire-savings contract the bench keys report.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import replace
+
+import numpy as np
+import pytest
+
+from tse1m_tpu.cluster import (ClusterParams, adjusted_rand_index,
+                               cluster_sessions, cluster_sessions_resumable)
+from tse1m_tpu.cluster.pipeline import last_run_info
+from tse1m_tpu.cluster.store import (SignatureStore, digests_fingerprint,
+                                     row_digests)
+from tse1m_tpu.data.synth import synth_session_sets
+
+POLICY = {"n_hashes": 32, "seed": 0, "quant_bits": 0}
+
+
+def _params(store_dir=None, **kw):
+    base = dict(n_hashes=32, n_bands=4, use_pallas="never",
+                sig_store=str(store_dir) if store_dir else None)
+    base.update(kw)
+    return ClusterParams(**base)
+
+
+# -- content digests ---------------------------------------------------------
+
+def test_row_digests_deterministic_and_distinct():
+    rng = np.random.default_rng(0)
+    items = rng.integers(0, 1 << 24, size=(5000, 16), dtype=np.uint32)
+    d1, d2 = row_digests(items), row_digests(items.copy())
+    np.testing.assert_array_equal(d1, d2)
+    # distinct rows -> distinct 128-bit digests (overwhelmingly)
+    assert len({bytes(r) for r in d1}) == 5000
+    # equal rows -> equal digests regardless of position
+    dup = items.copy()
+    dup[7] = dup[0]
+    dd = row_digests(dup)
+    np.testing.assert_array_equal(dd[7], dd[0])
+    # single-element change flips the digest
+    mod = items.copy()
+    mod[3, 5] ^= 1
+    assert bytes(row_digests(mod)[3]) != bytes(d1[3])
+
+
+def test_row_digests_width_sensitive():
+    a = np.zeros((1, 8), np.uint32)
+    b = np.zeros((1, 16), np.uint32)
+    assert bytes(row_digests(a)[0]) != bytes(row_digests(b)[0])
+
+
+# -- store mechanics ---------------------------------------------------------
+
+def test_store_probe_append_dedupe(tmp_path):
+    store = SignatureStore(str(tmp_path), POLICY)
+    rng = np.random.default_rng(1)
+    items = rng.integers(0, 1 << 24, size=(100, 16), dtype=np.uint32)
+    d = row_digests(items)
+    sig = rng.integers(0, 1 << 32, size=(100, 32), dtype=np.uint32)
+    hit, _, _ = store.bulk_probe(d)
+    assert not hit.any()
+    assert store.append(d, sig) == 100
+    # duplicate append is a no-op; intra-batch duplicates keep the first
+    assert store.append(d, sig) == 0
+    dup_d = np.concatenate([d[:2], d[:2]])
+    dup_s = np.concatenate([sig[:2], sig[:2]])
+    assert store.append(dup_d, dup_s) == 0
+    hit, sh, rw = store.bulk_probe(d)
+    assert hit.all()
+    got = store.load_signatures(sh, rw)
+    np.testing.assert_array_equal(got, sig)
+    # reopened store sees the same rows (manifest-committed)
+    store2 = SignatureStore(str(tmp_path), POLICY)
+    assert store2.n_rows == 100
+    hit, sh, rw = store2.bulk_probe(d[::3])
+    np.testing.assert_array_equal(store2.load_signatures(sh, rw), sig[::3])
+
+
+def test_store_policy_mismatch_refuses(tmp_path):
+    SignatureStore(str(tmp_path), POLICY)
+    with pytest.raises(ValueError, match="different policy"):
+        SignatureStore(str(tmp_path), {**POLICY, "n_hashes": 64})
+    with pytest.raises(ValueError, match="quant_bits"):
+        SignatureStore(str(tmp_path), {**POLICY, "quant_bits": 10})
+
+
+def test_store_torn_shard_reads_as_absent(tmp_path):
+    store = SignatureStore(str(tmp_path), POLICY)
+    rng = np.random.default_rng(2)
+    items = rng.integers(0, 1 << 24, size=(50, 16), dtype=np.uint32)
+    d = row_digests(items)
+    store.append(d, rng.integers(0, 9, size=(50, 32), dtype=np.uint32))
+    shard = os.path.join(str(tmp_path), "sig_00000.npy")
+    with open(shard, "rb+") as f:
+        f.truncate(os.path.getsize(shard) // 2)
+    store2 = SignatureStore(str(tmp_path), POLICY)
+    assert store2.n_rows == 0
+    hit, _, _ = store2.bulk_probe(d)
+    assert not hit.any()
+
+
+def test_store_eviction_fifo_and_state_invalidation(tmp_path):
+    # each shard: 10 rows x 32 hashes x 4 B = 1280 B; cap at 2.5 shards
+    store = SignatureStore(str(tmp_path), POLICY, max_bytes=3200)
+    rng = np.random.default_rng(3)
+    batches = []
+    for i in range(3):
+        items = rng.integers(0, 1 << 24, size=(10, 16), dtype=np.uint32)
+        d = row_digests(items)
+        batches.append(d)
+        store.append(d, rng.integers(0, 9, size=(10, 32), dtype=np.uint32))
+    # oldest shard evicted; newest two remain
+    assert len(store.shards) == 2
+    assert not store.bulk_probe(batches[0])[0].any()
+    assert store.bulk_probe(batches[2])[0].all()
+    # a state whose locator references the evicted shard reads as unusable
+    labels = np.zeros(10, np.int32)
+    locator = np.zeros((10, 2), np.int32)  # shard 0 = evicted
+    tables = ([np.zeros(0, np.uint32)] * 4, [np.zeros(0, np.int32)] * 4)
+    assert store.save_state(labels, locator, tables, batches[0], 4, 0.5)
+    assert store.load_state(4, 0.5) is None
+
+
+def test_store_state_roundtrip_and_mismatch(tmp_path):
+    store = SignatureStore(str(tmp_path), POLICY)
+    rng = np.random.default_rng(4)
+    items = rng.integers(0, 1 << 24, size=(20, 16), dtype=np.uint32)
+    d = row_digests(items)
+    sig = rng.integers(0, 9, size=(20, 32), dtype=np.uint32)
+    store.append(d, sig)
+    _, sh, rw = store.bulk_probe(d)
+    labels = np.arange(20, dtype=np.int32)
+    keys = rng.integers(0, 99, size=(20, 4), dtype=np.uint32)
+    from tse1m_tpu.cluster.incremental import build_band_tables
+
+    tables = build_band_tables(keys)
+    assert store.save_state(labels, np.stack([sh, rw], 1), tables, d, 4, 0.5)
+    st = store.load_state(4, 0.5)
+    assert st is not None and st.n_rows == 20
+    np.testing.assert_array_equal(st.labels, labels)
+    assert st.matches_prefix(d)
+    assert not st.matches_prefix(d[::-1].copy())
+    # banding/threshold mismatch -> no merge shortcut, but no refusal
+    assert store.load_state(8, 0.5) is None
+    assert store.load_state(4, 0.6) is None
+
+
+def test_digests_fingerprint_order_sensitive():
+    d = np.arange(8, dtype=np.uint64).reshape(4, 2)
+    assert digests_fingerprint(d) != digests_fingerprint(d[::-1].copy())
+
+
+# -- label parity: warm == cold ----------------------------------------------
+
+def test_union_path_matches_cold_on_shuffled_corpus(tmp_path):
+    """100% signature hits but a reordered corpus: the union path must
+    reuse every cached signature and still label identically to cold."""
+    items, _ = synth_session_sets(1200, set_size=16, seed=5)
+    sp = _params(tmp_path / "s")
+    cluster_sessions(items, sp)  # populate
+    perm = np.random.default_rng(7).permutation(items.shape[0])
+    shuffled = items[perm]
+    warm = cluster_sessions(shuffled, sp)
+    info = dict(last_run_info)
+    assert info["cache_mode"] == "union"
+    assert info["cache_hit_rate"] > 0.95  # intra-corpus dups collapse a few
+    cold = cluster_sessions(shuffled, _params())
+    np.testing.assert_array_equal(warm, cold)
+
+
+def test_merge_bridges_old_components(tmp_path):
+    """A novel row whose set straddles two previously-separate clusters
+    must merge them — the union-find min-label semantics of the cold run,
+    including relabeling the larger old component.  Fixture (seed-pinned,
+    fully deterministic): clusters A and B share 6 of 16 ids (Jaccard
+    0.23, below threshold — separate), the bridge carries the shared core
+    plus half of each side (Jaccard ~0.52 to both); 2-row bands (32
+    hashes / 16 bands) make the bucket collisions actually fire."""
+    rng = np.random.default_rng(5)
+    common = rng.integers(0, 1 << 24, size=6, dtype=np.uint32)
+    ua = rng.integers(0, 1 << 24, size=10, dtype=np.uint32)
+    ub = rng.integers(0, 1 << 24, size=10, dtype=np.uint32)
+    a = np.concatenate([common, ua])
+    b = np.concatenate([common, ub])
+    base = np.concatenate([np.tile(a, (6, 1)), np.tile(b, (6, 1))])
+    bridge = np.concatenate([common, ua[:5], ub[:5]])[None, :]
+    union = np.concatenate([base, bridge])
+    # 1 novel row over a 13-row corpus: raise the merge ceiling so the
+    # tiny fixture still exercises the merge path
+    kw = dict(n_bands=16, merge_max_novel=0.2)
+    sp = _params(tmp_path / "s", **kw)
+    cluster_sessions(base, sp)  # populate: two components
+    assert len(set(cluster_sessions(base, _params(n_bands=16))
+                   .tolist())) == 2
+    warm = cluster_sessions(union, sp)
+    assert dict(last_run_info)["cache_mode"] == "merge"
+    cold = cluster_sessions(union, _params(n_bands=16))
+    np.testing.assert_array_equal(warm, cold)
+    assert len(set(cold.tolist())) == 1  # genuinely bridged
+
+
+@pytest.mark.parametrize("encoding", ["auto", "delta", "pack24"])
+@pytest.mark.parametrize("quant_bits", [0, -1, 8, 12])
+def test_warm_labels_equal_cold_across_encodings(tmp_path, encoding,
+                                                 quant_bits):
+    """The ISSUE acceptance grid: warm (K novel rows over a cached base)
+    labels are elementwise-identical to a cold batch run — ARI == 1.0 —
+    for every encoding x quantization combination."""
+    items, _ = synth_session_sets(600, set_size=16, seed=6)
+    novel, _ = synth_session_sets(25, set_size=16, seed=606)
+    union = np.concatenate([items, novel])
+    kw = dict(encoding=encoding, wire_quant_bits=quant_bits)
+    sp = _params(tmp_path / f"s_{encoding}_{quant_bits}", **kw)
+    cluster_sessions(items, sp)                       # populate
+    warm = cluster_sessions(union, sp)                # accreted warm run
+    assert dict(last_run_info)["cache_mode"] == "merge"
+    cold = cluster_sessions(union, _params(**kw))     # cold batch oracle
+    np.testing.assert_array_equal(warm, cold)
+    assert adjusted_rand_index(warm, cold) == 1.0
+
+
+def test_warm_run_ships_a_fraction_of_cold_wire(tmp_path):
+    """The wire contract behind `cache_wire_saved_mb`: a ≤1%-novel warm
+    run ships ≤10% of the cold run's bytes (here it ships ONLY the novel
+    tail, a ~1% sliver)."""
+    items, _ = synth_session_sets(4000, set_size=16, seed=8)
+    novel, _ = synth_session_sets(40, set_size=16, seed=808)
+    union = np.concatenate([items, novel])
+    cluster_sessions(union, _params())
+    cold_bytes = last_run_info["wire_bytes"]
+    sp = _params(tmp_path / "s")
+    cluster_sessions(items, sp)
+    warm = cluster_sessions(union, sp)
+    info = dict(last_run_info)
+    assert info["cache_mode"] == "merge"
+    assert info["wire_bytes"] <= 0.1 * cold_bytes
+    np.testing.assert_array_equal(warm, cluster_sessions(union, _params()))
+
+
+def test_resumable_populates_and_warm_merges(tmp_path):
+    """cluster_sessions_resumable integration: a chunk-checkpointed cold
+    run populates the store; the next resumable call warm-merges without
+    touching the chunked pipeline."""
+    items, _ = synth_session_sets(2048, set_size=16, seed=9)
+    cold = cluster_sessions(items, _params(h2d_chunks=4))
+    sp = _params(tmp_path / "s", h2d_chunks=4)
+    lab = cluster_sessions_resumable(items, sp,
+                                     checkpoint_dir=str(tmp_path / "ck"))
+    np.testing.assert_array_equal(lab, cold)
+    assert dict(last_run_info)["cache_mode"] == "populate"
+    lab2 = cluster_sessions_resumable(items, sp,
+                                      checkpoint_dir=str(tmp_path / "ck2"))
+    np.testing.assert_array_equal(lab2, cold)
+    assert dict(last_run_info)["cache_mode"] == "merge"
+    # the merge path never created chunk shards
+    assert not os.path.exists(str(tmp_path / "ck2"))
+
+
+def test_all_hit_warm_run_is_device_free(tmp_path):
+    """Re-clustering the identical corpus: zero new rows, zero wire,
+    labels straight from the merged state."""
+    items, _ = synth_session_sets(800, set_size=16, seed=10)
+    sp = _params(tmp_path / "s")
+    first = cluster_sessions(items, sp)
+    again = cluster_sessions(items, sp)
+    info = dict(last_run_info)
+    assert info["cache_mode"] == "merge"
+    assert info["cache_hit_rate"] == 1.0
+    assert info["cache_novel_rows"] == 0
+    assert info["wire_bytes"] == 0
+    np.testing.assert_array_equal(again, first)
+
+
+def test_store_stats_surface_in_last_run_info(tmp_path):
+    items, _ = synth_session_sets(500, set_size=16, seed=12)
+    sp = _params(tmp_path / "s")
+    cluster_sessions(items, sp)
+    info = dict(last_run_info)
+    assert info["encoding"] == "store"
+    for key in ("cache_hit_rate", "cache_mode", "cache_novel_rows",
+                "cache_store_rows", "wire_mb", "wire_bytes", "stages"):
+        assert key in info, key
+    # probe stage is part of the telemetry contract
+    assert "stage_probe_s" in info["stages"]
+
+
+# -- hypothesis property test ------------------------------------------------
+
+try:
+    from hypothesis import given, settings, strategies as st
+    _HAVE_HYPOTHESIS = True
+except ImportError:  # pragma: no cover - exercised only without extras
+    _HAVE_HYPOTHESIS = False
+
+
+if _HAVE_HYPOTHESIS:
+    @settings(max_examples=8, deadline=None)
+    @given(st.sampled_from(["auto", "delta", "pack24"]),
+           st.sampled_from([0, 8, 12]),
+           st.integers(min_value=1, max_value=40),
+           st.integers(min_value=0, max_value=3))
+    def test_property_incremental_ari_is_one(tmp_path_factory, encoding,
+                                             quant_bits, k_novel, seed):
+        """Property (ISSUE 4): for random (encoding, quant, K, seed), a
+        warm run with K novel rows labels the union identically to a
+        cold batch run (ARI == 1.0)."""
+        d = tmp_path_factory.mktemp("sigstore")
+        items, _ = synth_session_sets(300, set_size=16, seed=seed)
+        novel, _ = synth_session_sets(k_novel, set_size=16, seed=1000 + seed)
+        union = np.concatenate([items, novel])
+        kw = dict(encoding=encoding, wire_quant_bits=quant_bits)
+        sp = _params(d, **kw)
+        cluster_sessions(items, sp)
+        warm = cluster_sessions(union, sp)
+        cold = cluster_sessions(union, _params(**kw))
+        np.testing.assert_array_equal(warm, cold)
+        assert adjusted_rand_index(warm, cold) == 1.0

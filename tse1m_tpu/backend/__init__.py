@@ -82,9 +82,18 @@ def get_backend(cfg: Config):
             from .pandas_backend import PandasBackend
 
             return PandasBackend()
+        import os
+
         from .auto import AutoBackend
 
-        return AutoBackend(rtt)
+        # Record-and-reuse: measured per-RQ walls persist here and seed
+        # the next process's routing (TSE1M_ROUTER_CAL env or the INI's
+        # router_cal_path; empty/unset = in-memory only).  Env is read
+        # here, not only in load_config, because bench.py constructs
+        # Config() directly.
+        cal = os.environ.get("TSE1M_ROUTER_CAL",
+                             getattr(cfg, "router_cal_path", None) or "")
+        return AutoBackend(rtt, cal_path=cal or None)
     if choice == "jax_tpu":
         from .jax_backend import JaxBackend
 

@@ -52,6 +52,13 @@ _RTT_MULTIPLE = 4.0
 # EWMA weight of the newest observation — heavy enough to adapt within a
 # couple of calls, light enough that one noisy wall doesn't flap routing.
 _EWMA_ALPHA = 0.5
+# Exploration band: an engine that has never been measured on this
+# machine is tried once as long as its bootstrap prior is within this
+# factor of the measured incumbent.  Without it the router starves the
+# unmeasured engine forever: BENCH_r05's rq2tr stuck on the measured
+# host (0.31 s/call) while the never-tried device runs it in 0.14 s,
+# because the device prior (4 RTTs ≈ 0.5 s) always lost the argmin.
+_EXPLORE_FACTOR = 5.0
 
 # Which study tables set each RQ's "relevant rows" scale.
 _RQ_TABLES = {
@@ -74,7 +81,7 @@ class AutoBackend(Backend):
 
     name = "auto"
 
-    def __init__(self, rtt_s: float):
+    def __init__(self, rtt_s: float, cal_path: str | None = None):
         self._rtt_s = float(rtt_s)
         self._jax = None
         self._pd = None
@@ -84,6 +91,49 @@ class AutoBackend(Backend):
         # stable (the normal analysis pattern), re-measured when not.
         self._cost: dict = {}
         self._dev_compiled: set = set()  # rqs whose device path is warm
+        # Record-and-reuse (the BENCH_r05 mispick fix, second half): with
+        # ``cal_path`` set, measured per-row costs persist as JSON and
+        # seed the next process on the SAME machine — a fresh bench or
+        # CLI run routes on last round's measurements instead of
+        # re-paying the bootstrap priors' mistakes.  The file is
+        # machine-local state (device costs fold in this link's RTT).
+        self._cal_path = cal_path or None
+        self._load_calibration()
+
+    def _load_calibration(self) -> None:
+        if not self._cal_path:
+            return
+        import json
+        import os
+
+        if not os.path.exists(self._cal_path):
+            return
+        try:
+            with open(self._cal_path, encoding="utf-8") as f:
+                saved = json.load(f).get("cost_per_row", {})
+            for key, cost in saved.items():
+                rq, _, eng = key.partition(":")
+                if rq in _PRIOR_HOST_COEF and eng in ("jax", "pandas"):
+                    self._cost[(rq, eng)] = float(cost)
+            log.info("router calibration reloaded from %s (%d entries)",
+                     self._cal_path, len(self._cost))
+        except (OSError, ValueError, TypeError) as e:
+            log.warning("router calibration at %s unreadable (%s); "
+                        "starting from priors", self._cal_path, e)
+
+    def _save_calibration(self) -> None:
+        if not self._cal_path:
+            return
+        import json
+
+        from ..utils.atomic import atomic_write
+
+        try:
+            with atomic_write(self._cal_path) as f:
+                json.dump(self.calibration(), f, indent=2)
+        except OSError as e:
+            log.warning("could not persist router calibration to %s (%s)",
+                        self._cal_path, e)
 
     def _jax_be(self) -> Backend:
         if self._jax is None:
@@ -108,8 +158,24 @@ class AutoBackend(Backend):
         return _RTT_MULTIPLE * self._rtt_s
 
     def _pick(self, rq: str, rows: int) -> tuple:
-        if self._predict(rq, "jax", rows) < self._predict(rq, "pandas",
-                                                          rows):
+        pj = self._predict(rq, "jax", rows)
+        pp = self._predict(rq, "pandas", rows)
+        mj = (rq, "jax") in self._cost
+        mp = (rq, "pandas") in self._cost
+        if mj != mp:
+            # One engine is measured, the other still runs on a bootstrap
+            # prior; priors lose to measurements by default, so force one
+            # trial of the unmeasured engine unless its prior already
+            # loses hopelessly (> _EXPLORE_FACTOR× the incumbent).  Regret
+            # is bounded at one mispredicted call per (rq, engine); the
+            # measurement it buys fixes routing for the rest of the run
+            # (and, via cal_path, for future runs).
+            name, prior, incumbent = (("jax", pj, pp) if not mj
+                                      else ("pandas", pp, pj))
+            if prior <= _EXPLORE_FACTOR * incumbent:
+                return name, (self._jax_be() if name == "jax"
+                              else self._pd_be())
+        if pj < pp:
             return "jax", self._jax_be()
         return "pandas", self._pd_be()
 
@@ -120,6 +186,7 @@ class AutoBackend(Backend):
         prev = self._cost.get(key)
         self._cost[key] = (c if prev is None
                            else _EWMA_ALPHA * c + (1 - _EWMA_ALPHA) * prev)
+        self._save_calibration()
 
     def _run(self, rq: str, arrays, method: str, *args, **kw):
         rows = self._rows(arrays, *_RQ_TABLES[rq])

@@ -330,6 +330,13 @@ def _cmd_cluster(args) -> int:
     """North-star session dedup: MinHash+LSH clustering with an ARI report
     against the planted truth (and the host oracle on a subsample).
 
+    ``--sig-store`` points at the persistent content-addressed signature
+    store (cluster/store.py): re-runs probe cached MinHash signatures by
+    row content hash and ship only the novel tail; an accreted re-run
+    merges labels on host.  The store path and the run's cache stats are
+    recorded in ``<result_dir>/run_manifest.json`` (the step runner also
+    embeds the per-stage probe/load/h2d walls).
+
     Multi-host aware: under TSE1M_COORDINATOR/…_NUM_PROCESSES (see
     parallel/multihost.py) the mesh spans every host's devices and a
     barrier keeps the report phase from racing slow hosts.  Note the
@@ -341,13 +348,27 @@ def _cmd_cluster(args) -> int:
     the plain local run."""
     import json
 
-    from .cluster import ClusterParams, adjusted_rand_index, cluster_sessions, host_cluster
+    from .resilience import StepRunner
+
+    cfg = load_config()
+    sig_store = args.sig_store or cfg.sig_store
+    manifest_path = os.path.join(cfg.result_dir, "run_manifest.json")
+    runner = StepRunner(manifest_path)
+    rec = runner.run("cluster", _run_cluster_step, args, sig_store)
+    if rec.result is not None:
+        print(json.dumps(rec.result))
+    return runner.exit_code()
+
+
+def _run_cluster_step(args, sig_store: str | None) -> dict:
+    from .cluster import (ClusterParams, adjusted_rand_index,
+                          cluster_sessions, host_cluster)
     from .data.synth import synth_session_sets
     from .parallel import multihost
 
     distributed = multihost.initialize_from_env()
     items, truth = synth_session_sets(args.n, seed=args.seed)
-    params = ClusterParams(seed=args.seed)
+    params = ClusterParams(seed=args.seed, sig_store=sig_store)
     if distributed:
         import numpy as np
 
@@ -357,6 +378,10 @@ def _cmd_cluster(args) -> int:
                         "(give each process its own directory and the "
                         "resumable API if you need it); this run is NOT "
                         "checkpointed")
+        if sig_store:
+            log.warning("--sig-store is ignored under multi-host: the "
+                        "signature store is a single-host wire lever "
+                        "(mesh feeds ride local/ICI links)")
         mesh = multihost.global_mesh()
         # Feed only this process's contiguous LOGICAL slice; the padded-put
         # helper grows the tail block to the mesh multiple with zero rows
@@ -378,15 +403,26 @@ def _cmd_cluster(args) -> int:
     report = {"n_sessions": args.n,
               "n_clusters": int(len(set(labels.tolist()))),
               "ari_vs_planted": round(float(ari), 5)}
+    if sig_store:
+        from .cluster.pipeline import last_run_info
+
+        report["sig_store"] = sig_store
+        report.update({k_: v for k_, v in last_run_info.items()
+                       if k_.startswith("cache_") or k_ == "wire_mb"})
     if k > 0:
+        from dataclasses import replace
+
         host_k = host_cluster(items[:k], n_hashes=params.n_hashes,
                               n_bands=params.n_bands, seed=params.seed)
-        dev_k = labels if k == args.n else cluster_sessions(items[:k], params)
+        # The subsample re-cluster must NOT touch the store: committing
+        # state for a k-row prefix would clobber the full run's state.
+        dev_k = (labels if k == args.n else
+                 cluster_sessions(items[:k], replace(params,
+                                                     sig_store=None)))
         report["ari_vs_host_sample"] = round(
             float(adjusted_rand_index(dev_k, host_k)), 5)
         report["ari_sample_n"] = k
-    print(json.dumps(report))
-    return 0
+    return report
 
 
 def main(argv=None) -> int:
@@ -459,6 +495,13 @@ def main(argv=None) -> int:
                    help="persist per-chunk signature shards here; a killed "
                         "run re-invoked with the same dir resumes at the "
                         "first unfinished chunk (single-process path)")
+    p.add_argument("--sig-store", default=None,
+                   help="persistent content-addressed signature store "
+                        "directory (cluster/store.py): warm re-runs probe "
+                        "cached MinHash signatures and ship only novel "
+                        "rows; accreted re-runs merge labels on host. "
+                        "Also settable via TSE1M_SIG_STORE / the INI's "
+                        "sig_store; recorded in run_manifest.json")
     p.set_defaults(fn=_cmd_cluster)
 
     args = ap.parse_args(argv)

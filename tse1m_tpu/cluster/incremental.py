@@ -1,0 +1,226 @@
+"""Warm-path label merge for the signature store (host side).
+
+A continuous-fuzzing re-run is the previous run's rows plus a short
+appended tail.  The banded-LSH edge structure makes that tail cheap to
+absorb EXACTLY:
+
+- Bucket hubs are elected by *minimum original index*
+  (`lsh.bucket_representatives`), and appended rows only ever have
+  larger indices — so adding rows never changes the hub of any bucket
+  that already had members.  Every old row's verified edge set is
+  therefore untouched, and the old labels (each the min index of its
+  component) summarise them losslessly.
+- A new row's hub per band is either the stored bucket table's rep (the
+  band key already existed) or the minimum-index *new* row sharing the
+  key (the key is novel).  Verifying those candidate edges with the
+  exact signature-agreement rule the device uses, then running a host
+  union-find over {old component labels} ∪ {new row indices} with
+  union-by-min, reproduces the cold batch run's label vector
+  elementwise — including the case where one new row bridges two
+  previously separate old components.
+
+So a ≤1%-novel warm run never rebuilds full band tables: it probes the
+stored per-band (key -> rep) tables, unions, and appends only the novel
+keys.  All arrays here are host numpy; `cluster/pipeline.py` owns every
+device transfer.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass
+class LshState:
+    """The last completed run's LSH state, as persisted by
+    `store.SignatureStore.save_state`."""
+
+    n_rows: int
+    labels: np.ndarray              # [n_rows] int32 min-orig-index labels
+    locator: np.ndarray             # [n_rows, 2] int32 (shard, row) in store
+    band_keys_sorted: list          # per band: [Kb] uint32 distinct keys
+    band_reps: list                 # per band: [Kb] int32 min index per key
+    prefix_digest: str              # digests_fingerprint of the run's rows
+
+    def matches_prefix(self, digests: np.ndarray) -> bool:
+        """True when this state's rows are exactly the first n_rows of
+        the current input (the accretion pattern the merge requires)."""
+        from .store import digests_fingerprint
+
+        if digests.shape[0] < self.n_rows:
+            return False
+        return (digests_fingerprint(digests[:self.n_rows])
+                == self.prefix_digest)
+
+
+def build_band_tables(keys: np.ndarray) -> tuple[list, list]:
+    """[N, B] uint32 band keys (original row order) -> per-band sorted
+    distinct keys + the min row index holding each ([Kb] uint32,
+    [Kb] int32)."""
+    n, n_bands = keys.shape
+    ks_list, rep_list = [], []
+    for b in range(n_bands):
+        order = np.argsort(keys[:, b], kind="stable")
+        ks = keys[order, b]
+        first = np.empty(n, bool)
+        if n:
+            first[0] = True
+            np.not_equal(ks[1:], ks[:-1], out=first[1:])
+        ks_list.append(np.ascontiguousarray(ks[first]))
+        rep_list.append(order[first].astype(np.int32))
+    return ks_list, rep_list
+
+
+def extend_band_tables(band_keys_sorted: list, band_reps: list,
+                       new_keys: np.ndarray, base_index: int
+                       ) -> tuple[list, list]:
+    """Append the new rows' novel band keys (rep = min new row's global
+    index, ``base_index`` + row position).  Existing keys keep their
+    reps — new rows have larger indices by construction."""
+    ks_out, rep_out = [], []
+    k = new_keys.shape[0]
+    for b, (ks, reps) in enumerate(zip(band_keys_sorted, band_reps)):
+        kb = new_keys[:, b]
+        pos = np.searchsorted(ks, kb)
+        inb = pos < ks.shape[0]
+        hit = np.zeros(k, bool)
+        hit[inb] = ks[pos[inb]] == kb[inb]
+        rest = np.flatnonzero(~hit)
+        if rest.size == 0:
+            ks_out.append(ks)
+            rep_out.append(reps)
+            continue
+        order = rest[np.argsort(kb[rest], kind="stable")]
+        ks2 = kb[order]
+        first = np.empty(order.size, bool)
+        first[0] = True
+        np.not_equal(ks2[1:], ks2[:-1], out=first[1:])
+        merged_k = np.concatenate([ks, ks2[first]])
+        merged_r = np.concatenate(
+            [reps, (order[first] + base_index).astype(np.int32)])
+        resort = np.argsort(merged_k, kind="stable")
+        ks_out.append(merged_k[resort])
+        rep_out.append(merged_r[resort])
+    return ks_out, rep_out
+
+
+def candidate_edges(band_keys_sorted: list, band_reps: list,
+                    new_keys: np.ndarray, base_index: int
+                    ) -> tuple[np.ndarray, np.ndarray]:
+    """Unverified candidate edges (u, v) for the appended rows, in global
+    original indices — exactly the edges the cold run would add: per
+    band, each new row points at its bucket hub (stored rep for an
+    existing key, min-index new row for a novel key).  Self-edges are
+    dropped, like the device verifier's caller does."""
+    k, n_bands = new_keys.shape
+    idx = np.arange(k, dtype=np.int64) + base_index
+    us, vs = [], []
+    for b in range(n_bands):
+        kb = new_keys[:, b]
+        ks, reps = band_keys_sorted[b], band_reps[b]
+        pos = np.searchsorted(ks, kb)
+        inb = pos < ks.shape[0]
+        hit = np.zeros(k, bool)
+        hit[inb] = ks[pos[inb]] == kb[inb]
+        if hit.any():
+            us.append(idx[hit])
+            vs.append(reps[pos[hit]].astype(np.int64))
+        rest = np.flatnonzero(~hit)
+        if rest.size:
+            order = rest[np.argsort(kb[rest], kind="stable")]
+            ks2 = kb[order]
+            first = np.empty(order.size, bool)
+            first[0] = True
+            np.not_equal(ks2[1:], ks2[:-1], out=first[1:])
+            grp = np.cumsum(first) - 1
+            us.append(idx[order])
+            vs.append(idx[order[np.flatnonzero(first)][grp]])
+    if not us:
+        e = np.empty(0, np.int64)
+        return e, e.copy()
+    u = np.concatenate(us)
+    v = np.concatenate(vs)
+    keep = u != v
+    return u[keep], v[keep]
+
+
+def verify_edges(u: np.ndarray, v: np.ndarray, new_sigs: np.ndarray,
+                 base_index: int, gather_old_sigs, n_hashes: int,
+                 threshold: float) -> np.ndarray:
+    """The device verifier's exact rule on host: accept an edge iff the
+    fraction of agreeing MinHash rows (float32, like
+    `lsh.estimated_jaccard`) reaches ``threshold``.  ``gather_old_sigs``
+    maps unique old row indices to their stored [*, H] signatures."""
+    if u.size == 0:
+        return np.zeros(0, bool)
+    sig_u = new_sigs[u - base_index]
+    sig_v = np.empty_like(sig_u)
+    old = v < base_index
+    if old.any():
+        uniq, inv = np.unique(v[old], return_inverse=True)
+        sig_v[old] = gather_old_sigs(uniq)[inv]
+    new = ~old
+    if new.any():
+        sig_v[new] = new_sigs[v[new] - base_index]
+    agree = (sig_u == sig_v).sum(axis=1)
+    est = agree.astype(np.float32) / np.float32(n_hashes)
+    return est >= np.float32(threshold)
+
+
+def merge_labels(old_labels: np.ndarray, u: np.ndarray, v: np.ndarray,
+                 n_old: int, n_new: int) -> np.ndarray:
+    """Union the verified new edges into the old labeling; returns
+    [n_old + n_new] int32 labels equal elementwise to a cold batch run
+    over the union.
+
+    Nodes are old component labels (< n_old, each already the min index
+    of its component) and new row indices (>= n_old); union-by-min keeps
+    every root the minimum original index of its merged component, so a
+    new row that bridges two old components relabels both to the smaller
+    component's label — exactly what min-label propagation converges to.
+    """
+    parent: dict[int, int] = {}
+
+    def find(x: int) -> int:
+        root = x
+        while parent.get(root, root) != root:
+            root = parent[root]
+        while parent.get(x, x) != root:
+            parent[x], x = root, parent[x]
+        return root
+
+    for u_, v_ in zip(u.tolist(), v.tolist()):
+        cu = find(u_)
+        cv = find(int(old_labels[v_]) if v_ < n_old else v_)
+        if cu == cv:
+            continue
+        if cu > cv:
+            cu, cv = cv, cu
+        parent[cv] = cu
+        parent.setdefault(cu, cu)
+
+    new_lab = np.arange(n_old, n_old + n_new, dtype=np.int64)
+    for i in range(n_new):
+        j = n_old + i
+        if j in parent:
+            new_lab[i] = find(j)
+    out_old = old_labels.astype(np.int64, copy=True)
+    remap = {lab: r for lab in parent if lab < n_old
+             for r in (find(lab),) if r != lab}
+    if remap:
+        lk = np.fromiter(remap.keys(), np.int64, len(remap))
+        lv = np.fromiter(remap.values(), np.int64, len(remap))
+        order = np.argsort(lk)
+        lk, lv = lk[order], lv[order]
+        pos = np.searchsorted(lk, out_old)
+        inb = pos < lk.size
+        match = np.zeros(n_old, bool)
+        match[inb] = lk[pos[inb]] == out_old[inb]
+        out_old[match] = lv[pos[match]]
+    return np.concatenate([out_old, new_lab]).astype(np.int32)
+
+
+__all__ = ["LshState", "build_band_tables", "candidate_edges",
+           "extend_band_tables", "merge_labels", "verify_edges"]

@@ -1,0 +1,471 @@
+"""Persistent content-addressed MinHash signature store (warm-path cache).
+
+The paper's workload is *continuous* fuzzing: sessions accrete daily and
+the overwhelming majority of each run's coverage vectors were already
+seen the run before — yet the cluster pipeline re-encoded, re-shipped
+and re-hashed every row from scratch (BENCH_r05: 10.9 s of a 15.2 s wall
+was host->device wire at ~10 MB/s; compute was 1.9 s).  Signatures are
+tiny, stable summaries worth persisting (the online/batch split argued
+by b-bit minwise hashing, arXiv:1205.2958): a session's MinHash
+signature depends only on its raw coverage-id set and the hash policy,
+so it can be computed once and reused forever.
+
+This module is the host-side store; `cluster/incremental.py` plans the
+warm run and merges labels; `cluster/pipeline.py` owns every actual
+device transfer (the blessed wire layer).
+
+Layout (all writes tmp + ``os.replace`` — a SIGKILL mid-write leaves a
+torn temp file that the next open sweeps, never a half-shard):
+
+- ``store_manifest.json``: the policy key ``(n_hashes, seed,
+  quant_bits)`` plus the committed shard list.  A store opened under a
+  different policy REFUSES (mirrors ``cluster/checkpoint.py``'s
+  ``wire_quant_bits`` handling) — signatures of a different hash family
+  or quantized universe are wrong for this run, every one of them.
+- ``sig_NNNNN.npy`` / ``key_NNNNN.npy``: append-only shards —
+  ``[M, n_hashes] uint32`` signatures, mmap-loaded so a warm probe reads
+  only the rows it gathers, and ``[M, 2] uint64`` content digests
+  (`row_digests`) keying them.  A shard is visible only once the
+  manifest lists it; a torn/truncated shard on disk reads as absent and
+  its rows recompute (`_shard_ok`).
+- ``state.json`` + ``state_NNNNN.npz``: the last completed run's LSH
+  state (labels, per-band bucket tables, per-row shard locator, prefix
+  digest) — what lets a warm accreted run merge labels instead of
+  rebuilding band tables.  The json is the commit point.
+
+Eviction: FIFO whole shards via ``max_bytes`` (``TSE1M_SIG_STORE_MAX_MB``
+env).  Content addressing makes eviction safe — an evicted row simply
+probes as a miss and recomputes; an LSH state whose locator references
+an evicted shard reads as unusable and the next run rebuilds it.
+"""
+
+from __future__ import annotations
+
+import glob
+import hashlib
+import json
+import os
+
+import numpy as np
+
+from ..resilience import fault_point, io_retry_policy, retry_call
+from ..utils.atomic import atomic_write
+from ..utils.logging import get_logger
+
+log = get_logger("cluster.store")
+
+_MANIFEST = "store_manifest.json"
+_STATE = "state.json"
+
+# The policy tuple: any of these changing invalidates every stored
+# signature (different hash family / universe), so it is THE manifest key.
+POLICY_KEYS = ("n_hashes", "seed", "quant_bits")
+
+
+# -- content digests ---------------------------------------------------------
+#
+# 128-bit per-row content hash, fully vectorised: two independent
+# multilinear hashes over the row's uint32 ids (mod 2^64, random odd
+# per-column coefficients from a FIXED seed — digests must be stable
+# across processes and machines), finalised with a splitmix64 mix.
+# Pairwise collision probability is ~2^-66; a collision would silently
+# reuse another row's signature, so 64 bits alone would be too thin for
+# a store that lives for thousands of runs.
+
+_DIGEST_SEED = 0x74736531  # "tse1"
+_coef_cache: dict[int, np.ndarray] = {}
+
+
+def _digest_coeffs(set_size: int) -> np.ndarray:
+    c = _coef_cache.get(set_size)
+    if c is None:
+        rng = np.random.default_rng(_DIGEST_SEED)
+        c = (rng.integers(1, 1 << 63, size=(2, set_size), dtype=np.uint64)
+             * np.uint64(2) + np.uint64(1))
+        _coef_cache[set_size] = c
+    return c
+
+
+def _mix64(x: np.ndarray) -> np.ndarray:
+    x = x.copy()
+    x ^= x >> np.uint64(30)
+    x *= np.uint64(0xBF58476D1CE4E5B9)
+    x ^= x >> np.uint64(27)
+    x *= np.uint64(0x94D049BB133111EB)
+    x ^= x >> np.uint64(31)
+    return x
+
+
+def row_digests(items: np.ndarray) -> np.ndarray:
+    """[N, S] uint32 rows -> [N, 2] uint64 content digests.
+
+    Hashes the RAW (pre-quantization) ids: the store policy carries the
+    quantization width, so the same raw row under the same policy always
+    maps to the same cached signature.
+    """
+    items = np.ascontiguousarray(items, dtype=np.uint32)
+    if items.ndim != 2:
+        raise ValueError(f"expected [N, S] items, got shape {items.shape}")
+    n, s = items.shape
+    coef = _digest_coeffs(s)
+    out = np.empty((n, 2), np.uint64)
+    step = 1 << 17  # bound the [step, S] uint64 temporary to ~64 MB
+    for lo in range(0, n, step):
+        v = items[lo:lo + step].astype(np.uint64)
+        for lane in range(2):
+            acc = (v * coef[lane][None, :]).sum(axis=1, dtype=np.uint64)
+            acc ^= np.uint64(s)  # rows of different widths never collide
+            out[lo:lo + step, lane] = _mix64(acc)
+    return out
+
+
+_DIG_DT = np.dtype([("a", "<u8"), ("b", "<u8")])
+
+
+def _as_struct(digests: np.ndarray) -> np.ndarray:
+    """[N, 2] uint64 -> [N] structured view (lexicographically sortable
+    and searchsorted-able as one 128-bit key)."""
+    d = np.ascontiguousarray(digests, dtype="<u8")
+    return d.view(_DIG_DT).reshape(-1)
+
+
+def digests_fingerprint(digests: np.ndarray) -> str:
+    """Order-sensitive fingerprint of a digest sequence — the state's
+    accretion-prefix check (`LshState.prefix_digest`)."""
+    return hashlib.blake2b(
+        np.ascontiguousarray(digests, dtype="<u8").tobytes(),
+        digest_size=16).hexdigest()
+
+
+class SignatureStore:
+    """Content-addressed (digest -> MinHash signature) store + the last
+    run's LSH state, under one directory.  Single-writer; readers see
+    only manifest-committed shards."""
+
+    def __init__(self, directory: str, policy: dict,
+                 max_bytes: int | None = None) -> None:
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self.policy = {k: int(policy[k]) for k in POLICY_KEYS}
+        if max_bytes is None:
+            mb = os.environ.get("TSE1M_SIG_STORE_MAX_MB")
+            max_bytes = int(float(mb) * 2**20) if mb else None
+        self.max_bytes = max_bytes
+        self._manifest_path = os.path.join(directory, _MANIFEST)
+        self._state_path = os.path.join(directory, _STATE)
+        self._mmaps: dict[int, np.ndarray] = {}
+        prior = self._load_json(self._manifest_path)
+        if prior is not None:
+            prior_policy = prior.get("policy", {})
+            if prior_policy != self.policy:
+                diff = {k: (prior_policy.get(k), self.policy.get(k))
+                        for k in set(prior_policy) | set(self.policy)
+                        if prior_policy.get(k) != self.policy.get(k)}
+                raise ValueError(
+                    f"signature store at {directory} was built under a "
+                    "different policy — its cached signatures are wrong "
+                    "for this run, every one of them; use a fresh "
+                    "directory or delete it. mismatched (have, want): "
+                    f"{diff}")
+            self.shards = [dict(s) for s in prior.get("shards", [])]
+        else:
+            self.shards = []
+            self._write_manifest()
+        self._validate_shards()
+        self._sweep_orphans()
+        self._build_index()
+
+    # -- shard files --------------------------------------------------------
+
+    def _sig_path(self, sid: int) -> str:
+        return os.path.join(self.directory, f"sig_{sid:05d}.npy")
+
+    def _key_path(self, sid: int) -> str:
+        return os.path.join(self.directory, f"key_{sid:05d}.npy")
+
+    def _load_json(self, path: str) -> dict | None:
+        if not os.path.exists(path):
+            return None
+        try:
+            with open(path, encoding="utf-8") as f:
+                return json.load(f)
+        except (OSError, ValueError) as e:
+            log.warning("unreadable %s (%s); treating as absent", path, e)
+            return None
+
+    def _write_manifest(self) -> None:
+        with atomic_write(self._manifest_path) as f:
+            json.dump({"policy": self.policy, "shards": self.shards}, f)
+
+    def _shard_ok(self, entry: dict) -> bool:
+        """True when both shard files exist AND mmap-load with the shapes
+        the manifest promises — a torn/truncated file (SIGKILL between
+        rename and fsync, filesystem loss) must read as 'absent' so its
+        rows recompute, never crash a warm run or feed it garbage."""
+        sid, rows = int(entry["id"]), int(entry["rows"])
+        try:
+            keys = np.load(self._key_path(sid), mmap_mode="r")
+            sig = np.load(self._sig_path(sid), mmap_mode="r")
+        except Exception as e:  # graftlint: disable=broad-except -- a torn shard must read as absent whatever the failure mode
+            log.warning("store shard %d unreadable (%s); its rows will "
+                        "recompute", sid, e)
+            return False
+        return (keys.shape == (rows, 2) and keys.dtype == np.uint64
+                and sig.shape == (rows, self.policy["n_hashes"])
+                and sig.dtype == np.uint32)
+
+    def _validate_shards(self) -> None:
+        good = [s for s in self.shards if self._shard_ok(s)]
+        if len(good) != len(self.shards):
+            self.shards = good
+            self._write_manifest()
+
+    def _sweep_orphans(self) -> None:
+        """Remove shard/temp files the manifest does not own — leftovers
+        of a crash between file write and manifest commit."""
+        owned = {self._sig_path(int(s["id"])) for s in self.shards}
+        owned |= {self._key_path(int(s["id"])) for s in self.shards}
+        for pat in ("sig_*.npy", "key_*.npy", "*.tmp.npy", "*.tmp.npz",
+                    "state_*.npz"):
+            for p in glob.glob(os.path.join(self.directory, pat)):
+                if p in owned or p == self._current_state_file():
+                    continue
+                if ".tmp." in p or pat in ("sig_*.npy", "key_*.npy",
+                                           "state_*.npz"):
+                    with _suppress_oserror():
+                        os.remove(p)
+
+    def _current_state_file(self) -> str | None:
+        st = self._load_json(self._state_path)
+        if st and st.get("file"):
+            return os.path.join(self.directory, st["file"])
+        return None
+
+    # -- probe index --------------------------------------------------------
+
+    def _build_index(self) -> None:
+        if not self.shards:
+            self._idx_keys = np.empty(0, _DIG_DT)
+            self._idx_keys2d = np.empty((0, 2), np.uint64)
+            self._idx_shard = np.empty(0, np.int32)
+            self._idx_row = np.empty(0, np.int32)
+            return
+        keys, shard_of, row_of = [], [], []
+        for s in self.shards:
+            sid, rows = int(s["id"]), int(s["rows"])
+            keys.append(np.asarray(np.load(self._key_path(sid),
+                                           mmap_mode="r")))
+            shard_of.append(np.full(rows, sid, np.int32))
+            row_of.append(np.arange(rows, dtype=np.int32))
+        keys2d = np.concatenate(keys)
+        order = np.argsort(_as_struct(keys2d), kind="stable")
+        self._idx_keys2d = keys2d[order]
+        self._idx_keys = _as_struct(self._idx_keys2d)
+        self._idx_shard = np.concatenate(shard_of)[order]
+        self._idx_row = np.concatenate(row_of)[order]
+
+    @property
+    def n_rows(self) -> int:
+        return int(self._idx_keys.shape[0])
+
+    @property
+    def sig_bytes(self) -> int:
+        h = self.policy["n_hashes"]
+        return sum(int(s["rows"]) * h * 4 for s in self.shards)
+
+    def shard_ids(self) -> set:
+        return {int(s["id"]) for s in self.shards}
+
+    def bulk_probe(self, digests: np.ndarray
+                   ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """[N, 2] digests -> (hit [N] bool, shard [N] int32, row [N] int32).
+        shard/row are -1 for misses."""
+        n = digests.shape[0]
+        shard = np.full(n, -1, np.int32)
+        row = np.full(n, -1, np.int32)
+        if n == 0 or self.n_rows == 0:
+            return np.zeros(n, bool), shard, row
+        q = _as_struct(digests)
+        pos = np.searchsorted(self._idx_keys, q)
+        inb = pos < self._idx_keys.shape[0]
+        hit = np.zeros(n, bool)
+        hit[inb] = np.all(
+            self._idx_keys2d[pos[inb]] == np.ascontiguousarray(
+                digests, dtype="<u8")[inb], axis=1)
+        shard[hit] = self._idx_shard[pos[hit]]
+        row[hit] = self._idx_row[pos[hit]]
+        return hit, shard, row
+
+    def _sig_mmap(self, sid: int) -> np.ndarray:
+        mm = self._mmaps.get(sid)
+        if mm is None:
+            mm = np.load(self._sig_path(sid), mmap_mode="r")
+            self._mmaps[sid] = mm
+        return mm
+
+    def load_signatures(self, shard: np.ndarray,
+                        row: np.ndarray) -> np.ndarray:
+        """Gather [K, n_hashes] uint32 signatures by (shard, row) pairs.
+        Rows are gathered per shard in sorted order so the mmap reads
+        pages sequentially."""
+        k = int(shard.shape[0])
+        out = np.empty((k, self.policy["n_hashes"]), np.uint32)
+        for sid in np.unique(shard):
+            sel = np.flatnonzero(shard == sid)
+            rows = row[sel]
+            order = np.argsort(rows, kind="stable")
+            out[sel[order]] = self._sig_mmap(int(sid))[rows[order]]
+        return out
+
+    # -- append -------------------------------------------------------------
+
+    def append(self, digests: np.ndarray, sigs: np.ndarray) -> int:
+        """Append (digest, signature) rows not already stored; returns the
+        number of rows actually written.  Duplicate digests within the
+        batch keep their first occurrence.  The shard write is atomic and
+        runs under the shared retry engine (a torn write — or an injected
+        one — rewrites the temp files from scratch)."""
+        if digests.shape[0] == 0:
+            return 0
+        hit, _, _ = self.bulk_probe(digests)
+        fresh = np.flatnonzero(~hit)
+        if fresh.size == 0:
+            return 0
+        d = np.ascontiguousarray(digests[fresh], dtype=np.uint64)
+        s = np.ascontiguousarray(sigs[fresh], dtype=np.uint32)
+        _, first = np.unique(_as_struct(d), return_index=True)
+        first.sort()
+        d, s = d[first], s[first]
+        sid = 1 + max((int(e["id"]) for e in self.shards), default=-1)
+        sig_path, key_path = self._sig_path(sid), self._key_path(sid)
+        sig_tmp, key_tmp = sig_path + ".tmp.npy", key_path + ".tmp.npy"
+
+        def write_shard() -> None:
+            np.save(sig_tmp, s)
+            np.save(key_tmp, d)
+            fault_point("store.sig.save", path=sig_tmp)
+            os.replace(sig_tmp, sig_path)
+            os.replace(key_tmp, key_path)
+
+        retry_call(write_shard, policy=io_retry_policy(),
+                   site="store.sig.save")
+        self.shards.append({"id": sid, "rows": int(d.shape[0])})
+        self._write_manifest()
+        self._evict(keep_sid=sid)
+        self._build_index()
+        return int(d.shape[0])
+
+    def _evict(self, keep_sid: int) -> None:
+        """FIFO whole-shard eviction down to ``max_bytes`` (never the
+        shard just written).  Safe by construction: evicted rows probe as
+        misses and recompute; a stale LSH-state locator is detected at
+        load (`load_state`)."""
+        if not self.max_bytes:
+            return
+        while self.sig_bytes > self.max_bytes and len(self.shards) > 1:
+            victim = self.shards[0]
+            if int(victim["id"]) == keep_sid:
+                break
+            self.shards.pop(0)
+            self._write_manifest()
+            self._mmaps.pop(int(victim["id"]), None)
+            log.info("store eviction: dropped shard %d (%d rows)",
+                     victim["id"], victim["rows"])
+            for p in (self._sig_path(int(victim["id"])),
+                      self._key_path(int(victim["id"]))):
+                with _suppress_oserror():
+                    os.remove(p)
+
+    # -- LSH run state ------------------------------------------------------
+
+    def save_state(self, labels: np.ndarray, locator: np.ndarray,
+                   tables: tuple[list, list], digests: np.ndarray,
+                   n_bands: int, threshold: float) -> bool:
+        """Commit the completed run's LSH state (atomically: npz first,
+        then the json pointer).  Returns False — state intentionally not
+        saved — when any row's signature is not locatable in the store
+        (eviction raced the run); a warm merge must never gather from a
+        shard that is gone."""
+        if locator.size and int(locator.min()) < 0:
+            log.warning("not saving LSH state: %d row(s) have no stored "
+                        "signature (store eviction?)",
+                        int((locator[:, 0] < 0).sum()))
+            return False
+        prior = self._load_json(self._state_path) or {}
+        gen = int(prior.get("gen", 0)) + 1
+        fname = f"state_{gen:05d}.npz"
+        path = os.path.join(self.directory, fname)
+        tmp = path + ".tmp.npz"
+        band_keys, band_reps = tables
+        payload = {"labels": np.ascontiguousarray(labels, np.int32),
+                   "locator": np.ascontiguousarray(locator, np.int32)}
+        for b, (k, r) in enumerate(zip(band_keys, band_reps)):
+            payload[f"bk_{b:03d}"] = np.ascontiguousarray(k, np.uint32)
+            payload[f"br_{b:03d}"] = np.ascontiguousarray(r, np.int32)
+
+        def write_state() -> None:
+            np.savez(tmp, **payload)
+            fault_point("store.state.save", path=tmp)
+            os.replace(tmp, path)
+
+        retry_call(write_state, policy=io_retry_policy(),
+                   site="store.state.save")
+        with atomic_write(self._state_path) as f:
+            json.dump({"file": fname, "gen": gen,
+                       "n_rows": int(labels.shape[0]),
+                       "n_bands": int(n_bands),
+                       "threshold": float(threshold),
+                       "prefix_digest": digests_fingerprint(digests)}, f)
+        old = prior.get("file")
+        if old and old != fname:
+            with _suppress_oserror():
+                os.remove(os.path.join(self.directory, old))
+        return True
+
+    def load_state(self, n_bands: int, threshold: float):
+        """The last run's LSH state, or None when absent, torn, built
+        under different banding/threshold, or referencing evicted shards.
+        Unlike a sig-policy mismatch this does not refuse the run — the
+        signatures are still valid; only the label-merge shortcut is."""
+        from .incremental import LshState
+
+        meta = self._load_json(self._state_path)
+        if meta is None:
+            return None
+        if (int(meta.get("n_bands", -1)) != int(n_bands)
+                or float(meta.get("threshold", -1.0)) != float(threshold)):
+            log.warning("LSH state at %s was built under different "
+                        "banding/threshold; rebuilding", self.directory)
+            return None
+        path = os.path.join(self.directory, str(meta.get("file")))
+        try:
+            with np.load(path) as z:
+                labels = z["labels"]
+                locator = z["locator"]
+                band_keys = [z[f"bk_{b:03d}"] for b in range(n_bands)]
+                band_reps = [z[f"br_{b:03d}"] for b in range(n_bands)]
+        except Exception as e:  # graftlint: disable=broad-except -- a torn state file must read as absent whatever the failure mode
+            log.warning("LSH state unreadable (%s); rebuilding", e)
+            return None
+        if labels.shape[0] != int(meta["n_rows"]):
+            return None
+        if locator.size and not (set(np.unique(locator[:, 0]).tolist())
+                                 <= self.shard_ids()):
+            log.warning("LSH state references evicted shard(s); rebuilding")
+            return None
+        return LshState(n_rows=int(meta["n_rows"]),
+                        labels=labels, locator=locator,
+                        band_keys_sorted=band_keys, band_reps=band_reps,
+                        prefix_digest=str(meta["prefix_digest"]))
+
+
+class _suppress_oserror:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, et, ev, tb):
+        return et is not None and issubclass(et, OSError)
+
+
+__all__ = ["POLICY_KEYS", "SignatureStore", "digests_fingerprint",
+           "row_digests"]

@@ -85,6 +85,15 @@ class Config:
     # utils.compat.enable_persistent_compilation_cache; env override
     # TSE1M_XLA_CACHE_DIR.
     xla_cache_dir: str | None = None
+    # Persisted auto-router calibration (backend/auto.py): measured
+    # per-RQ walls saved as JSON and reloaded by the next run on this
+    # machine, so routing converges across processes instead of
+    # re-learning per run.  None = in-memory only; env TSE1M_ROUTER_CAL.
+    router_cal_path: str | None = None
+    # Persistent content-addressed signature store for the cluster warm
+    # path (cluster/store.py).  None = cold runs; env TSE1M_SIG_STORE;
+    # CLI `cluster --sig-store`.
+    sig_store: str | None = None
 
     @property
     def result_ok(self) -> tuple[str, ...]:
@@ -131,6 +140,9 @@ def load_config(ini_path: str | None = None) -> Config:
             cfg.db_statement_timeout_ms = fw.getint(
                 "db_statement_timeout_ms", cfg.db_statement_timeout_ms)
             cfg.xla_cache_dir = fw.get("xla_cache_dir", cfg.xla_cache_dir)
+            cfg.router_cal_path = fw.get("router_cal_path",
+                                         cfg.router_cal_path)
+            cfg.sig_store = fw.get("sig_store", cfg.sig_store)
 
     cfg.backend = os.environ.get("TSE1M_BACKEND", cfg.backend)
     cfg.engine = os.environ.get("TSE1M_ENGINE", cfg.engine)
@@ -142,6 +154,9 @@ def load_config(ini_path: str | None = None) -> Config:
     cfg.fault_plan = os.environ.get("TSE1M_FAULT_PLAN", cfg.fault_plan)
     cfg.xla_cache_dir = os.environ.get("TSE1M_XLA_CACHE_DIR",
                                        cfg.xla_cache_dir)
+    cfg.router_cal_path = os.environ.get("TSE1M_ROUTER_CAL",
+                                         cfg.router_cal_path)
+    cfg.sig_store = os.environ.get("TSE1M_SIG_STORE", cfg.sig_store)
     if "TSE1M_DB_RETRY_ATTEMPTS" in os.environ:
         cfg.db_retry_attempts = int(os.environ["TSE1M_DB_RETRY_ATTEMPTS"])
     if "TSE1M_DB_STATEMENT_TIMEOUT_MS" in os.environ:

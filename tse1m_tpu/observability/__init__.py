@@ -22,7 +22,10 @@ bench JSON.  This module is the one place those stages are measured:
 Stage names are part of the bench-JSON contract (``stage_<name>_s`` /
 ``stage_<name>_mb`` keys, PARITY.md "Wire format & streaming pipeline"):
 ``encode`` host-side packing, ``h2d`` host->device transfer, ``compute``
-device dispatch+wait, ``d2h`` device->host result fetch.
+device dispatch+wait, ``d2h`` device->host result fetch — plus the
+signature-store warm path's ``probe`` (content hashing + store
+bulk-probe) and ``load`` (cached-signature mmap reads, bytes = gathered
+signature bytes), recorded by `cluster/pipeline.py`'s store paths.
 """
 
 from __future__ import annotations
@@ -32,7 +35,7 @@ import threading
 import time
 from collections import defaultdict
 
-STAGES = ("encode", "h2d", "compute", "d2h")
+STAGES = ("encode", "h2d", "compute", "d2h", "probe", "load")
 
 
 class StageRecorder:
