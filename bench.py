@@ -570,6 +570,15 @@ def main() -> int:
     warm_stats = {}
     if args.sig_store:
         warm_stats = bench_warm_store()
+        # Store health after the warm rounds (`store_scrub_*` keys): the
+        # same walk `tse1m scrub` does — frames verified, corruption
+        # quarantined and counted.  A corrupt-shard fault-matrix round
+        # surfaces here as store_scrub_corrupt > 0 while the warm labels
+        # above still matched (the quarantined rows recomputed).
+        from tse1m_tpu.cluster.store import SignatureStore
+
+        warm_stats.update(SignatureStore.open_existing(args.sig_store)
+                          .scrub())
 
     ari = adjusted_rand_index(labels, truth)
     ari_host = None
@@ -620,12 +629,36 @@ def main() -> int:
         # would have raised) within the compile budget.
         result.update(sanitizer.as_dict())
     try:
-        result.update(bench_link())
+        link_stats = bench_link()
+        result.update(link_stats)
+        # Persist the measured link rate to the machine calibration file
+        # (utils/calibration.py): the NEXT run's StageWatchdog seeds its
+        # adaptive H2D stall budget from this measurement instead of the
+        # absolute floor — the bound tracks the link this machine has.
+        from tse1m_tpu.utils.calibration import (calibration_path,
+                                                 update_calibration)
+
+        update_calibration(calibration_path(), wire={
+            "h2d_MBps": link_stats["link_h2d_rand_MBps"]})
     except Exception as e:  # graftlint: disable=broad-except -- optional probe; bench JSON stays valid without it
         print(f"# link probe failed ({type(e).__name__}: {e})",
               file=sys.stderr)
     if args.extract_builds > 0:
         result.update(bench_extraction(args.extract_builds, seed=args.seed))
+    # Degradation-ladder telemetry — part of the bench contract (CI's
+    # fault-matrix smoke asserts these keys exist, and that they are
+    # nonzero under the matching injected fault): every stall retry,
+    # chunk halving, device failover and store quarantine this process
+    # survived, by kind.  Last, so the extraction/RQ stages' events (e.g.
+    # an auto-router device failover) count too.
+    from tse1m_tpu.observability import (degradation_counts,
+                                         pop_degradation_events)
+
+    events = pop_degradation_events()
+    counts = degradation_counts(events)
+    result["degradation_events"] = len(events)
+    result["degradation_counts"] = counts
+    result["chunk_halvings"] = int(counts.get("chunk_halving", 0))
     print(json.dumps(result))
     return 0
 

@@ -85,6 +85,19 @@ def run_store(args) -> int:
     return 0
 
 
+def run_compact(args) -> int:
+    """Fold a store's shards (SignatureStore.compact).  The chaos test
+    SIGKILLs this at ``store.compact.save`` — compacted temps written,
+    manifest not yet committed — and asserts the next open sweeps the
+    temps, keeps the old shards, and warm labels still match."""
+    from tse1m_tpu.cluster.store import SignatureStore
+
+    store = SignatureStore.open_existing(args.store_dir)
+    folded = store.compact()
+    print(f"compacted {folded} shards")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -112,6 +125,10 @@ def main(argv=None) -> int:
     p.add_argument("--seed", type=int, default=13)
     p.add_argument("--info", default=None)
     p.set_defaults(fn=run_store)
+
+    p = sub.add_parser("compact")
+    p.add_argument("--store-dir", required=True)
+    p.set_defaults(fn=run_compact)
 
     args = ap.parse_args(argv)
     return args.fn(args)

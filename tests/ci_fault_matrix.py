@@ -1,0 +1,146 @@
+"""CI fault-plan matrix driver: one injected failure class over the 2k
+bench smoke, asserting the degradation contract end to end.
+
+Usage: ``python tests/ci_fault_matrix.py {stall|oom|kill|corrupt-shard}``
+
+Each seat runs ``bench.py`` (2k sessions, CPU, runtime sanitizer ON,
+persistent signature store) with a fault plan injected at a production
+seat, then asserts:
+
+- the bench completes (the degradation ladder absorbed the failure),
+- label parity held (``ari_vs_planted`` >= 0.98 AND the bench's internal
+  warm-vs-cold elementwise assert — bench.py raises if warm labels
+  diverge),
+- the bench JSON carries the ``degradation_events`` /
+  ``degradation_counts`` / ``chunk_halvings`` / ``store_scrub_*`` keys,
+  with the seat's own counter nonzero.
+
+The ``kill`` seat SIGKILLs the first invocation mid store-shard write and
+asserts the rerun sweeps the torn temps and recovers parity — the
+degraded evidence there is the kill itself (rc -9) plus a clean resume.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH_KEYS = ("degradation_events", "degradation_counts", "chunk_halvings",
+              "store_scrub_shards", "store_scrub_corrupt",
+              "store_scrub_quarantined", "store_scrub_state_ok")
+
+
+def run_bench(store: str, plan: dict | None = None, env_extra: dict | None
+              = None, expect_kill: bool = False) -> dict | None:
+    env = dict(os.environ)
+    env.update({"BENCH_N": "2000", "BENCH_ITERS": "1",
+                "BENCH_EXTRACT_BUILDS": "0", "BENCH_SANITIZE": "1",
+                # headroom for shapes the degradation ladder introduces
+                # (a halved chunk is a new compile) — the guard still
+                # catches an unbounded recompile loop
+                "BENCH_COMPILE_BUDGET": "16",
+                "BENCH_SIG_STORE": store, "JAX_PLATFORMS": "cpu"})
+    env.pop("TSE1M_FAULT_PLAN", None)
+    if plan is not None:
+        plan_path = tempfile.mktemp(suffix=".json")
+        with open(plan_path, "w") as f:
+            json.dump(plan, f)
+        env["TSE1M_FAULT_PLAN"] = plan_path
+    env.update(env_extra or {})
+    proc = subprocess.run([sys.executable, "bench.py"], cwd=REPO, env=env,
+                          capture_output=True, text=True, timeout=1200)
+    if expect_kill:
+        assert proc.returncode == -signal.SIGKILL, (
+            f"expected SIGKILL, got rc={proc.returncode}\n{proc.stderr[-2000:]}")
+        return None
+    assert proc.returncode == 0, (
+        f"bench rc={proc.returncode}\n{proc.stderr[-4000:]}")
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    for key in BENCH_KEYS:
+        assert key in result, f"bench JSON lost key {key}"
+    assert result["ari_vs_planted"] >= 0.98, result["ari_vs_planted"]
+    assert result["sanitizer_transfer_guard"] is True
+    return result
+
+
+def seat_stall(store: str) -> dict:
+    plan = {"rules": [{"site": "pipeline.h2d", "kind": "stall",
+                       "stall_s": 3.0, "times": 1}]}
+    r = run_bench(store, plan,
+                  env_extra={"TSE1M_WATCHDOG_MIN_BUDGET_S": "0.5"})
+    assert r["degradation_counts"].get("stall_retry", 0) >= 1, r
+    assert r["degradation_events"] >= 1, r
+    return r
+
+
+def seat_oom(store: str) -> dict:
+    plan = {"rules": [{"site": "pipeline.h2d", "kind": "raise",
+                       "message": "RESOURCE_EXHAUSTED: injected 1GiB "
+                                  "allocation failure", "times": 1}]}
+    r = run_bench(store, plan)
+    assert r["chunk_halvings"] >= 1, r
+    assert r["degradation_counts"].get("chunk_halving", 0) >= 1, r
+    return r
+
+
+def seat_kill(store: str) -> dict:
+    plan = {"rules": [{"site": "store.sig.save", "kind": "kill"}]}
+    run_bench(store, plan, expect_kill=True)
+    # the kill stranded torn temp shards; the rerun must sweep them,
+    # recompute, and recover full parity (bench's internal warm assert)
+    assert glob.glob(os.path.join(store, "*.tmp.npy")), \
+        "kill left no torn temps — the seat did not fire mid-write"
+    r = run_bench(store)
+    assert not glob.glob(os.path.join(store, "*.tmp.npy")), \
+        "torn temps survived the on-open orphan sweep"
+    assert r["store_scrub_corrupt"] == 0, r
+    return r
+
+
+def seat_corrupt_shard(store: str) -> dict:
+    r = run_bench(store)  # populate a committed, CRC-framed store
+    shards = sorted(glob.glob(os.path.join(store, "sig_*.npy")))
+    assert shards, "populate run committed no shards"
+    with open(shards[0], "r+b") as f:  # flip one byte mid-shard
+        f.seek(os.path.getsize(shards[0]) // 2)
+        b = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([b[0] ^ 0x40]))
+    r = run_bench(store)
+    # detected on load, quarantined, recomputed — never wrong labels
+    # (run_bench already asserted ARI and bench asserted warm parity)
+    assert r["degradation_counts"].get("shard_quarantine", 0) >= 1, r
+    assert r["store_scrub_quarantined"] >= 1, r
+    return r
+
+
+SEATS = {"stall": seat_stall, "oom": seat_oom, "kill": seat_kill,
+         "corrupt-shard": seat_corrupt_shard}
+
+
+def main() -> int:
+    seat = sys.argv[1] if len(sys.argv) > 1 else ""
+    if seat not in SEATS:
+        print(f"usage: {sys.argv[0]} {{{'|'.join(SEATS)}}}",
+              file=sys.stderr)
+        return 2
+    with tempfile.TemporaryDirectory() as tmp:
+        store = os.path.join(tmp, "sig_store")
+        os.environ["TSE1M_ROUTER_CAL"] = os.path.join(tmp, "cal.json")
+        r = SEATS[seat](store)
+    print(f"fault-matrix[{seat}] OK:",
+          json.dumps({k: r[k] for k in
+                      ("ari_vs_planted", "degradation_events",
+                       "degradation_counts", "chunk_halvings",
+                       "store_scrub_corrupt", "store_scrub_quarantined")}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

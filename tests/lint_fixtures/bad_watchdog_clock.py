@@ -1,0 +1,14 @@
+"""Raw clock reads in watchdog-plane code (spoofed path)."""
+import time
+
+
+def arm_deadline(budget_s):
+    return time.monotonic() + budget_s
+
+
+def watchdog_tick():
+    return time.perf_counter()
+
+
+def stall_elapsed(t0):
+    return time.time() - t0

@@ -115,14 +115,29 @@ def test_calibration_persists_across_instances(tmp_path):
         f.write("{ not json")
     be3 = AutoBackend(rtt_s=0.11, cal_path=path)
     assert be3._cost == {}
-    # unknown rqs/engines in the file are ignored
+    # a v1 flat-format file (no schema_version) is ignored wholesale —
+    # its entries carry no timestamps, so their age is unknowable
+    # (utils/calibration.py schema gate)
     import json
 
     with open(path, "w") as f:
         json.dump({"cost_per_row": {"rq9:cuda": 1.0, "rq1:pandas": 2e-8}},
                   f)
     be4 = AutoBackend(rtt_s=0.11, cal_path=path)
-    assert be4._cost == {("rq1", "pandas"): 2e-8}
+    assert be4._cost == {}
+    # v2 schema: fresh entries load; unknown rqs/engines are ignored
+    import time as _time
+
+    from tse1m_tpu.utils.calibration import SCHEMA_VERSION
+
+    now = _time.time()
+    with open(path, "w") as f:
+        json.dump({"schema_version": SCHEMA_VERSION,
+                   "cost_per_row": {
+                       "rq9:cuda": {"value": 1.0, "ts": now},
+                       "rq1:pandas": {"value": 2e-8, "ts": now}}}, f)
+    be5 = AutoBackend(rtt_s=0.11, cal_path=path)
+    assert be5._cost == {("rq1", "pandas"): 2e-8}
 
 
 def test_get_backend_passes_cal_path_from_env(monkeypatch, tmp_path):
@@ -153,6 +168,45 @@ def test_first_device_call_excluded_from_calibration(study_cfg, study_db):
     assert ("rq1", "jax") not in be._cost  # compile call skipped
     be.rq1_detection(arrays, limit_ns, 1)
     assert ("rq1", "jax") in be._cost      # warm call recorded
+
+
+def test_device_call_failover_to_host_oracle(study_cfg, study_db):
+    """Device loss mid-run (injected at the production seat): the failed
+    call re-runs on the host oracle with identical results, and after the
+    failure limit the router stops picking the device at all — recorded
+    as degradation events for the run manifest."""
+    from tse1m_tpu.data.columnar import StudyArrays
+    from tse1m_tpu.observability import pop_degradation_events
+    from tse1m_tpu.resilience import FaultPlan, FaultRule
+
+    arrays = StudyArrays.from_db(study_db, study_cfg)
+    limit_ns = int(np.datetime64(study_cfg.limit_date, "ns")
+                   .astype(np.int64))
+    pop_degradation_events()
+    plan = FaultPlan([FaultRule(site="backend.device.call", kind="raise",
+                                message="injected: device lost", times=3)])
+    with plan.active():
+        be = AutoBackend(rtt_s=1e-9)  # device always predicted to win
+        r1 = be.rq1_detection(arrays, limit_ns, 1)      # failover #1
+        assert not be._device_lost
+        r2 = be.rq2_trends(arrays, limit_ns)            # failover #2
+        assert be._device_lost
+        # Declared lost: the router no longer picks the device, so the
+        # remaining rule budget never fires.
+        r3 = be.rq3_coverage_at_detection(arrays, limit_ns)
+    assert len(plan.fired) == 2
+    oracle = PandasBackend()
+    np.testing.assert_array_equal(
+        r1.detected_counts,
+        oracle.rq1_detection(arrays, limit_ns, 1).detected_counts)
+    np.testing.assert_array_equal(
+        r2.counts, oracle.rq2_trends(arrays, limit_ns).counts)
+    np.testing.assert_array_equal(
+        r3.det_issue_idx,
+        oracle.rq3_coverage_at_detection(arrays, limit_ns).det_issue_idx)
+    kinds = [e["kind"] for e in pop_degradation_events()]
+    assert kinds.count("device_call_failover") == 2
+    assert "device_failover" in kinds
 
 
 def test_calibration_surfaces_in_manifest():

@@ -243,3 +243,53 @@ def test_cluster_sigkill_twice_then_resume(tmp_path):
                    fault_plan_path=plan_path, expect_kill=True)
     run_driver(["cluster", "--dir", ck_dir, "--out", out])
     np.testing.assert_array_equal(np.load(out), np.load(clean_out))
+
+
+def test_store_compaction_sigkill_sweeps_temps_and_keeps_parity(tmp_path):
+    """SIGKILL mid-compaction (site ``store.compact.save``: the folded
+    temps are written, the manifest commit has not happened): the old
+    shards stay authoritative, the next OPEN sweeps the stranded temps
+    (the on-open orphan sweep — a crashed compaction must not leak disk
+    across runs), warm labels match the uninterrupted run, and a retried
+    compaction completes with the merge path intact."""
+    import json
+
+    store_dir = str(tmp_path / "store")
+    out = str(tmp_path / "labels.npy")
+    # two corpora -> two committed shards (the compaction work-list)
+    run_driver(["store", "--store-dir", store_dir, "--out", out,
+                "--seed", "13"])
+    run_driver(["store", "--store-dir", store_dir, "--out", out,
+                "--seed", "29"])
+    want = np.load(out)
+    with open(os.path.join(store_dir, "store_manifest.json")) as f:
+        shards_before = json.load(f)["shards"]
+    assert len(shards_before) >= 2
+
+    plan_path = str(tmp_path / "plan.json")
+    FaultPlan([FaultRule(site="store.compact.save",
+                         kind="kill")]).save(plan_path)
+    run_driver(["compact", "--store-dir", store_dir],
+               fault_plan_path=plan_path, expect_kill=True)
+    # the kill left compacted temps behind, manifest untouched
+    assert glob.glob(os.path.join(store_dir, "*.tmp.npy"))
+    with open(os.path.join(store_dir, "store_manifest.json")) as f:
+        assert json.load(f)["shards"] == shards_before
+
+    # resume: open sweeps the temps; the warm run merges with parity
+    info_path = str(tmp_path / "info.json")
+    run_driver(["store", "--store-dir", store_dir, "--out", out,
+                "--seed", "29", "--info", info_path])
+    np.testing.assert_array_equal(np.load(out), want)
+    assert not glob.glob(os.path.join(store_dir, "*.tmp.npy"))
+    info = json.load(open(info_path))
+    assert info["cache_mode"] == "merge" and info["cache_hit_rate"] > 0.99
+
+    # a retried compaction completes and the merge path survives it
+    run_driver(["compact", "--store-dir", store_dir])
+    with open(os.path.join(store_dir, "store_manifest.json")) as f:
+        assert len(json.load(f)["shards"]) == 1
+    run_driver(["store", "--store-dir", store_dir, "--out", out,
+                "--seed", "29", "--info", info_path])
+    np.testing.assert_array_equal(np.load(out), want)
+    assert json.load(open(info_path))["cache_mode"] == "merge"

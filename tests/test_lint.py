@@ -44,6 +44,8 @@ def _rule_findings(rule: str, filename: str, relpath: str | None = None):
     ("retry-bypass", "bad_retry_bypass.py", "good_retry_bypass.py", None),
     ("nondeterminism", "bad_nondeterminism.py", "good_nondeterminism.py",
      "tse1m_tpu/collect/fixture.py"),
+    ("watchdog-clock", "bad_watchdog_clock.py", "good_watchdog_clock.py",
+     "tse1m_tpu/cluster/pipeline.py"),
 ])
 def test_rule_bad_fires_good_silent(rule, bad, good, spoof):
     assert _rule_findings(rule, bad, spoof), f"{rule} missed {bad}"

@@ -30,6 +30,8 @@ from __future__ import annotations
 import time
 
 from .base import Backend
+from ..observability import record_degradation
+from ..resilience import fault_point, is_device_loss
 from ..utils.logging import get_logger
 
 log = get_logger("backend.auto")
@@ -92,48 +94,44 @@ class AutoBackend(Backend):
         self._cost: dict = {}
         self._dev_compiled: set = set()  # rqs whose device path is warm
         # Record-and-reuse (the BENCH_r05 mispick fix, second half): with
-        # ``cal_path`` set, measured per-row costs persist as JSON and
-        # seed the next process on the SAME machine — a fresh bench or
-        # CLI run routes on last round's measurements instead of
-        # re-paying the bootstrap priors' mistakes.  The file is
-        # machine-local state (device costs fold in this link's RTT).
+        # ``cal_path`` set, measured per-row costs persist through the
+        # shared machine-calibration file (utils/calibration.py — schema-
+        # versioned, per-entry TTL) and seed the next process on the SAME
+        # machine — a fresh bench or CLI run routes on last round's
+        # measurements instead of re-paying the bootstrap priors'
+        # mistakes.  The TTL is what fixes the time-of-day drift: a
+        # midnight link measurement cannot route the afternoon.
         self._cal_path = cal_path or None
+        # Device-loss failover ledger: after repeated device failures the
+        # router stops picking the jax engine mid-run and the host oracle
+        # carries the remaining RQs (both engines are parity-tested, so
+        # this degrades speed, never results).
+        self._device_failures = 0
+        self._device_lost = False
         self._load_calibration()
 
     def _load_calibration(self) -> None:
         if not self._cal_path:
             return
-        import json
-        import os
+        from ..utils.calibration import load_calibration
 
-        if not os.path.exists(self._cal_path):
-            return
-        try:
-            with open(self._cal_path, encoding="utf-8") as f:
-                saved = json.load(f).get("cost_per_row", {})
-            for key, cost in saved.items():
-                rq, _, eng = key.partition(":")
-                if rq in _PRIOR_HOST_COEF and eng in ("jax", "pandas"):
-                    self._cost[(rq, eng)] = float(cost)
+        saved = load_calibration(self._cal_path)["cost_per_row"]
+        for key, cost in saved.items():
+            rq, _, eng = key.partition(":")
+            if rq in _PRIOR_HOST_COEF and eng in ("jax", "pandas"):
+                self._cost[(rq, eng)] = float(cost)
+        if self._cost:
             log.info("router calibration reloaded from %s (%d entries)",
                      self._cal_path, len(self._cost))
-        except (OSError, ValueError, TypeError) as e:
-            log.warning("router calibration at %s unreadable (%s); "
-                        "starting from priors", self._cal_path, e)
 
     def _save_calibration(self) -> None:
         if not self._cal_path:
             return
-        import json
+        from ..utils.calibration import update_calibration
 
-        from ..utils.atomic import atomic_write
-
-        try:
-            with atomic_write(self._cal_path) as f:
-                json.dump(self.calibration(), f, indent=2)
-        except OSError as e:
-            log.warning("could not persist router calibration to %s (%s)",
-                        self._cal_path, e)
+        update_calibration(
+            self._cal_path,
+            cost_per_row=self.calibration()["cost_per_row"])
 
     def _jax_be(self) -> Backend:
         if self._jax is None:
@@ -158,6 +156,8 @@ class AutoBackend(Backend):
         return _RTT_MULTIPLE * self._rtt_s
 
     def _pick(self, rq: str, rows: int) -> tuple:
+        if self._device_lost:
+            return "pandas", self._pd_be()
         pj = self._predict(rq, "jax", rows)
         pp = self._predict(rq, "pandas", rows)
         mj = (rq, "jax") in self._cost
@@ -188,11 +188,42 @@ class AutoBackend(Backend):
                            else _EWMA_ALPHA * c + (1 - _EWMA_ALPHA) * prev)
         self._save_calibration()
 
+    # Device failures tolerated before the router declares the device
+    # lost and routes every remaining call to the host oracle.
+    _DEVICE_FAIL_LIMIT = 2
+
     def _run(self, rq: str, arrays, method: str, *args, **kw):
         rows = self._rows(arrays, *_RQ_TABLES[rq])
         engine, be = self._pick(rq, rows)
         t0 = time.perf_counter()
-        out = getattr(be, method)(arrays, *args, **kw)
+        try:
+            if engine == "jax":
+                fault_point("backend.device.call")
+            out = getattr(be, method)(arrays, *args, **kw)
+        except Exception as e:
+            if engine != "jax" or not is_device_loss(e):
+                raise
+            # TPU->CPU failover mid-run: the device (or its tunneled
+            # link) died.  Re-run THIS call on the host oracle — results
+            # are parity-tested identical — and after _DEVICE_FAIL_LIMIT
+            # failures stop routing to the device at all.
+            self._device_failures += 1
+            record_degradation(
+                "device_call_failover", site=f"backend.{rq}",
+                detail={"error": f"{type(e).__name__}: {e}"[:200],
+                        "failures": self._device_failures})
+            log.warning("%s: device call failed (%s); re-running on the "
+                        "host oracle", rq, e)
+            if (self._device_failures >= self._DEVICE_FAIL_LIMIT
+                    and not self._device_lost):
+                self._device_lost = True
+                record_degradation("device_failover", site="backend.auto",
+                                   detail={"to": "pandas",
+                                           "failures": self._device_failures})
+                log.warning("device declared lost after %d failure(s); "
+                            "routing all remaining RQs to the host oracle",
+                            self._device_failures)
+            return getattr(self._pd_be(), method)(arrays, *args, **kw)
         wall = time.perf_counter() - t0
         if engine == "jax" and rq not in self._dev_compiled:
             # First device call pays one-time jit compilation; recording

@@ -7,6 +7,7 @@ this adds the operational commands the rebuild needs:
   python -m tse1m_tpu.cli ingest  --db ... --csv-dir data/processed_data/csv
   python -m tse1m_tpu.cli rq1 [rq2a rq2b rq3 rq4a rq4b all]
   python -m tse1m_tpu.cli cluster --n 100000   (north-star session dedup)
+  python -m tse1m_tpu.cli scrub data/sig_store [--repair --compact --strict]
 """
 
 from __future__ import annotations
@@ -409,6 +410,14 @@ def _run_cluster_step(args, sig_store: str | None) -> dict:
         report["sig_store"] = sig_store
         report.update({k_: v for k_, v in last_run_info.items()
                        if k_.startswith("cache_") or k_ == "wire_mb"})
+    # Degradation-ladder telemetry (observability plane): how many times
+    # the run survived by degrading.  The events themselves attach to the
+    # step record (StepRunner pops them into run_manifest.json).
+    from .cluster.pipeline import last_run_info as _lri
+    from .observability import peek_degradation_events
+
+    report["chunk_halvings"] = int(_lri.get("chunk_halvings", 0))
+    report["degradation_events"] = len(peek_degradation_events())
     if k > 0:
         from dataclasses import replace
 
@@ -423,6 +432,50 @@ def _run_cluster_step(args, sig_store: str | None) -> dict:
             float(adjusted_rand_index(dev_k, host_k)), 5)
         report["ari_sample_n"] = k
     return report
+
+
+def _cmd_scrub(args) -> int:
+    """Walk a signature store and report frame health (``tse1m scrub``).
+
+    Opening the store already verifies every committed shard's CRC frame
+    and quarantines failures (their digests will probe as misses and
+    recompute); scrub makes that visible and countable — the
+    ``store_scrub_*`` key namespace, recorded in run_manifest.json like
+    any step.  ``--repair`` re-frames legacy (pre-CRC) shards and sweeps
+    orphans; ``--compact`` folds the append shards into one.  ``--strict``
+    exits nonzero when any corruption was found (CI gate)."""
+    import json
+
+    from .resilience import StepRunner
+
+    cfg = load_config()
+    directory = args.store or cfg.sig_store
+    if not directory:
+        log.error("no store directory: pass one, or set TSE1M_SIG_STORE / "
+                  "the INI's sig_store")
+        return 2
+    manifest_path = os.path.join(cfg.result_dir, "run_manifest.json")
+    runner = StepRunner(manifest_path)
+
+    def scrub_step() -> dict:
+        from .cluster.store import SignatureStore
+
+        store = SignatureStore.open_existing(directory)
+        report = store.scrub(repair=args.repair, compact=args.compact)
+        report["store_scrub_dir"] = directory
+        return report
+
+    rec = runner.run("scrub", scrub_step)
+    if rec.result is not None:
+        print(json.dumps(rec.result))
+    if rec.status != "ok":
+        return 1
+    if args.strict and rec.result.get("store_scrub_corrupt", 0):
+        log.error("scrub found %d corrupt shard(s) (quarantined; rows "
+                  "recompute on the next warm run)",
+                  rec.result["store_scrub_corrupt"])
+        return 1
+    return 0
 
 
 def main(argv=None) -> int:
@@ -485,6 +538,21 @@ def main(argv=None) -> int:
     p.add_argument("--no-baseline", action="store_true")
     p.add_argument("--rules", default=None)
     p.set_defaults(fn=_cmd_lint)
+
+    p = sub.add_parser("scrub",
+                       help="walk a signature store: verify CRC frames, "
+                            "quarantine corruption, report store_scrub_* "
+                            "health keys (see README 'Surviving failures')")
+    p.add_argument("store", nargs="?", default=None,
+                   help="store directory (default: TSE1M_SIG_STORE / the "
+                        "INI's sig_store)")
+    p.add_argument("--repair", action="store_true",
+                   help="re-frame legacy (pre-CRC) shards and sweep orphans")
+    p.add_argument("--compact", action="store_true",
+                   help="fold the append shards into one large shard")
+    p.add_argument("--strict", action="store_true",
+                   help="exit nonzero when any corruption was found")
+    p.set_defaults(fn=_cmd_scrub)
 
     p = sub.add_parser("cluster", help="MinHash+LSH session dedup demo")
     p.add_argument("--n", type=int, default=100_000)

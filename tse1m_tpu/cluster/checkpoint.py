@@ -15,7 +15,10 @@ Durability contract: a crash loses at most the chunk in flight (shards are
 written tmp-then-rename, so a torn write is invisible to resume).  The
 manifest fingerprints the inputs and every shape-affecting parameter; a
 resume against different items or params refuses instead of silently
-mixing shards.
+mixing shards.  Each shard is additionally CRC-framed (`store.file_crc`,
+frame recorded in the manifest): a flipped byte anywhere in a committed
+shard — bit rot, not just truncation — reads as 'not done' and the chunk
+recomputes, mirroring the signature store's self-healing layer.
 """
 
 from __future__ import annotations
@@ -79,7 +82,7 @@ class ClusterCheckpoint:
             # doesn't (e.g. a delta-encoded run resumed without encoding)
             # means the shards hold different rows — refuse, don't load.
             prior_meta = {k: v for k, v in prior.items()
-                          if k != "chunks_done"}
+                          if k not in ("chunks_done", "chunk_crcs")}
             if prior_meta != self.meta:
                 # The meta diff, not the raw dicts: a long chunks_done
                 # list would bury the one key that actually differs
@@ -94,10 +97,13 @@ class ClusterCheckpoint:
                     "run (items or params changed); use a fresh directory "
                     f"or delete it. mismatched (have, want): {diff}")
             self.done = set(prior["chunks_done"])
+            self.chunk_crcs = {str(k): int(v) for k, v in
+                               (prior.get("chunk_crcs") or {}).items()}
             log.info("resuming cluster run: %d/%d chunks already done",
                      len(self.done), self.n_chunks)
         else:
             self.done = set()
+            self.chunk_crcs = {}
             self._write_manifest()
 
     @property
@@ -115,7 +121,8 @@ class ClusterCheckpoint:
     def _write_manifest(self) -> None:
         tmp = self._manifest_path + ".tmp"
         with open(tmp, "w") as f:
-            json.dump({**self.meta, "chunks_done": sorted(self.done)}, f)
+            json.dump({**self.meta, "chunks_done": sorted(self.done),
+                       "chunk_crcs": self.chunk_crcs}, f)
         os.replace(tmp, self._manifest_path)
 
     def _shard_path(self, index: int) -> str:
@@ -125,13 +132,26 @@ class ClusterCheckpoint:
         return index in self.done and self._shard_ok(index)
 
     def _shard_ok(self, index: int) -> bool:
-        """True when the shard file exists AND loads — a torn/truncated
-        npz on disk (partial copy, filesystem loss after rename) must
-        read as 'not done' so resume recomputes it instead of crashing
-        or silently clustering garbage."""
+        """True when the shard file exists, passes its CRC frame (a
+        flipped byte anywhere fails here) AND loads — a torn/truncated/
+        bit-rotted npz on disk must read as 'not done' so resume
+        recomputes it instead of crashing or silently clustering
+        garbage."""
         path = self._shard_path(index)
         if not os.path.exists(path):
             return False
+        want = self.chunk_crcs.get(str(index))
+        if want is not None:
+            from .store import file_crc
+
+            try:
+                got = file_crc(path)
+            except OSError:
+                return False
+            if int(got) != int(want):
+                log.warning("shard %s failed its CRC frame (stored %d, "
+                            "computed %d); will recompute", path, want, got)
+                return False
         try:
             with np.load(path) as z:
                 return "sig" in z.files and "keys" in z.files
@@ -146,17 +166,22 @@ class ClusterCheckpoint:
         'not done' and it recomputes on resume.  The write itself runs
         under the shared retry engine: a transient I/O failure (or an
         injected torn write) rewrites the tmp file from scratch."""
+        from .store import file_crc
+
         path = self._shard_path(index)
         tmp = path + ".tmp.npz"
+        crc = {}
 
         def write_shard() -> None:
             np.savez(tmp, sig=sig, keys=keys)
+            crc["v"] = file_crc(tmp)  # frame the exact published bytes
             fault_point("checkpoint.cluster.save", path=tmp)
             os.replace(tmp, path)
 
         retry_call(write_shard, policy=io_retry_policy(),
                    site="checkpoint.cluster.save")
         self.done.add(index)
+        self.chunk_crcs[str(index)] = crc["v"]
         self._write_manifest()
 
     def load_chunk(self, index: int) -> tuple[np.ndarray, np.ndarray]:

@@ -26,15 +26,43 @@ torn temp file that the next open sweeps, never a half-shard):
   ``[M, n_hashes] uint32`` signatures, mmap-loaded so a warm probe reads
   only the rows it gathers, and ``[M, 2] uint64`` content digests
   (`row_digests`) keying them.  A shard is visible only once the
-  manifest lists it; a torn/truncated shard on disk reads as absent and
-  its rows recompute (`_shard_ok`).
+  manifest lists it.
 - ``state.json`` + ``state_NNNNN.npz``: the last completed run's LSH
   state (labels, per-band bucket tables, per-row shard locator, prefix
   digest) — what lets a warm accreted run merge labels instead of
   rebuilding band tables.  The json is the commit point.
+- ``index_<fp>.keys.npy`` / ``index_<fp>.loc.npy``: the sorted probe
+  index, materialized and mmap'd past ``TSE1M_SIG_STORE_IDX_ROWS`` rows
+  so a billion-row store probes in O(log n) page touches instead of an
+  in-RAM copy of every key (``<fp>`` fingerprints the shard list; stale
+  generations are swept).
 
-Eviction: FIFO whole shards via ``max_bytes`` (``TSE1M_SIG_STORE_MAX_MB``
-env).  Content addressing makes eviction safe — an evicted row simply
+Self-healing (this is a store that lives for thousands of runs, and a
+b-bit-packed signature byte carries maximal information — one flipped
+bit silently poisons every future warm merge):
+
+- Every committed shard is **CRC-framed**: the manifest entry carries a
+  checksum of each file's exact bytes (CRC32C/Castagnoli when the
+  ``crc32c`` wheel is present, else zlib's CRC-32 — same burst-error
+  detection, recorded per store so verification always uses the algo
+  that wrote it).  Frames are verified on open, before any mmap gather.
+- A shard that fails its frame (bit rot, torn write, filesystem loss) is
+  **quarantined** — moved to ``quarantine/``, dropped from the manifest,
+  its digests probe as misses and recompute: exactly the torn-write
+  semantics, extended to silent corruption.  Each quarantine fires a
+  degradation event (observability plane -> run manifest / bench keys).
+- The LSH state npz is framed the same way; a corrupt state degrades the
+  next run to the union path over cached signatures (labels unchanged).
+- ``scrub()`` (CLI: ``tse1m scrub``) walks a store, reports frame
+  health, and with ``repair`` re-frames legacy shards, sweeps orphans
+  and compacts.
+
+Hygiene: ``compact()`` folds many small append shards into one large
+shard (the state's locator is remapped exactly, so warm merges survive
+compaction); eviction under ``max_bytes`` (``TSE1M_SIG_STORE_MAX_MB``)
+is **LRU by probe recency** — every ``bulk_probe`` advances a
+generation counter and stamps the shards it hit, and the coldest shard
+goes first.  Content addressing keeps eviction safe: an evicted row
 probes as a miss and recomputes; an LSH state whose locator references
 an evicted shard reads as unusable and the next run rebuilds it.
 """
@@ -48,6 +76,7 @@ import os
 
 import numpy as np
 
+from ..observability import record_degradation
 from ..resilience import fault_point, io_retry_policy, retry_call
 from ..utils.atomic import atomic_write
 from ..utils.logging import get_logger
@@ -56,10 +85,49 @@ log = get_logger("cluster.store")
 
 _MANIFEST = "store_manifest.json"
 _STATE = "state.json"
+_QUARANTINE_DIR = "quarantine"
 
 # The policy tuple: any of these changing invalidates every stored
 # signature (different hash family / universe), so it is THE manifest key.
 POLICY_KEYS = ("n_hashes", "seed", "quant_bits")
+
+# Past this many index rows the probe index is materialized + mmap'd
+# instead of held in RAM (the bounded-memory story past ~10M rows).
+_IDX_MMAP_ROWS_DEFAULT = 4_000_000
+# Auto-compaction threshold: at open, this many committed shards fold
+# into one (continuous fuzzing appends a small shard per day; without
+# compaction a year is ~365 shards and every probe walks all of them).
+_COMPACT_SHARDS_DEFAULT = 64
+
+
+# -- CRC framing -------------------------------------------------------------
+#
+# CRC32C (Castagnoli) when the hardware-accelerated wheel is available;
+# zlib's CRC-32 otherwise (ubiquitous, C-speed, equal burst-detection
+# power — only the polynomial differs).  The algo that framed a store is
+# recorded in its manifest, so verification never mixes polynomials; a
+# store opened under the other algo is transparently re-framed.
+
+try:  # pragma: no cover - depends on the environment's wheels
+    from crc32c import crc32c as _crc_update
+
+    _CRC_ALGO = "crc32c"
+except ImportError:  # pragma: no cover
+    from zlib import crc32 as _crc_update
+
+    _CRC_ALGO = "crc32"
+
+
+def file_crc(path: str, chunk_bytes: int = 1 << 20) -> int:
+    """Frame checksum of a file's exact bytes, streamed (bounded RSS —
+    verification must not page a multi-GB shard into memory)."""
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk_bytes)
+            if not block:
+                return int(crc)
+            crc = _crc_update(block, crc)
 
 
 # -- content digests ---------------------------------------------------------
@@ -154,6 +222,9 @@ class SignatureStore:
         self._manifest_path = os.path.join(directory, _MANIFEST)
         self._state_path = os.path.join(directory, _STATE)
         self._mmaps: dict[int, np.ndarray] = {}
+        self._key_mmaps: dict[int, np.ndarray] = {}
+        # Shards quarantined while opening THIS instance (scrub reports).
+        self.quarantined_at_open: list[dict] = []
         prior = self._load_json(self._manifest_path)
         if prior is not None:
             prior_policy = prior.get("policy", {})
@@ -168,12 +239,44 @@ class SignatureStore:
                     "directory or delete it. mismatched (have, want): "
                     f"{diff}")
             self.shards = [dict(s) for s in prior.get("shards", [])]
+            self._probe_gen = int(prior.get("probe_gen", 0))
+            if prior.get("crc_algo", _CRC_ALGO) != _CRC_ALGO:
+                self._reframe_all()
         else:
             self.shards = []
+            self._probe_gen = 0
             self._write_manifest()
         self._validate_shards()
         self._sweep_orphans()
+        if len(self.shards) >= self._compact_threshold():
+            self.compact()
         self._build_index()
+
+    @classmethod
+    def open_existing(cls, directory: str,
+                      max_bytes: int | None = None) -> "SignatureStore":
+        """Open a store using the policy recorded in ITS OWN manifest —
+        the scrub/compaction entry point, which must not require the
+        caller to know the hash policy."""
+        path = os.path.join(directory, _MANIFEST)
+        try:
+            with open(path, encoding="utf-8") as f:
+                policy = json.load(f)["policy"]
+        except (OSError, ValueError, KeyError) as e:
+            raise FileNotFoundError(
+                f"{directory} has no readable signature-store manifest "
+                f"({e})") from e
+        return cls(directory, policy, max_bytes=max_bytes)
+
+    @staticmethod
+    def _compact_threshold() -> int:
+        return int(os.environ.get("TSE1M_SIG_STORE_COMPACT_SHARDS",
+                                  _COMPACT_SHARDS_DEFAULT))
+
+    @staticmethod
+    def _idx_mmap_rows() -> int:
+        return int(os.environ.get("TSE1M_SIG_STORE_IDX_ROWS",
+                                  _IDX_MMAP_ROWS_DEFAULT))
 
     # -- shard files --------------------------------------------------------
 
@@ -195,43 +298,111 @@ class SignatureStore:
 
     def _write_manifest(self) -> None:
         with atomic_write(self._manifest_path) as f:
-            json.dump({"policy": self.policy, "shards": self.shards}, f)
+            json.dump({"policy": self.policy, "crc_algo": _CRC_ALGO,
+                       "probe_gen": self._probe_gen,
+                       "shards": self.shards}, f)
 
-    def _shard_ok(self, entry: dict) -> bool:
-        """True when both shard files exist AND mmap-load with the shapes
-        the manifest promises — a torn/truncated file (SIGKILL between
-        rename and fsync, filesystem loss) must read as 'absent' so its
-        rows recompute, never crash a warm run or feed it garbage."""
+    def _reframe_all(self) -> None:
+        """Recompute every frame under the current CRC algo (a store
+        moved between machines with/without the crc32c wheel)."""
+        for entry in self.shards:
+            sid = int(entry["id"])
+            for key, path in (("sig_crc", self._sig_path(sid)),
+                              ("key_crc", self._key_path(sid))):
+                try:
+                    entry[key] = file_crc(path)
+                except OSError:
+                    entry.pop(key, None)
+        self._write_manifest()
+
+    def _shard_ok(self, entry: dict) -> tuple[bool, str]:
+        """(ok, reason).  A shard is good when both files exist, pass
+        their CRC frames (a flipped byte ANYWHERE fails here), and
+        mmap-load with the shapes the manifest promises.  Anything else
+        must read as 'absent' so its rows recompute — never crash a warm
+        run or feed it a silently-corrupt signature."""
         sid, rows = int(entry["id"]), int(entry["rows"])
+        for crc_key, path in (("sig_crc", self._sig_path(sid)),
+                              ("key_crc", self._key_path(sid))):
+            want = entry.get(crc_key)
+            if want is None:
+                continue  # legacy unframed entry; `scrub --repair` frames it
+            try:
+                got = file_crc(path)
+            except OSError as e:
+                return False, f"unreadable ({e})"
+            if int(got) != int(want):
+                return False, (f"CRC frame mismatch on {os.path.basename(path)} "
+                               f"(stored {want}, computed {got})")
         try:
             keys = np.load(self._key_path(sid), mmap_mode="r")
             sig = np.load(self._sig_path(sid), mmap_mode="r")
         except Exception as e:  # graftlint: disable=broad-except -- a torn shard must read as absent whatever the failure mode
-            log.warning("store shard %d unreadable (%s); its rows will "
-                        "recompute", sid, e)
-            return False
-        return (keys.shape == (rows, 2) and keys.dtype == np.uint64
+            return False, f"unloadable ({e})"
+        if not (keys.shape == (rows, 2) and keys.dtype == np.uint64
                 and sig.shape == (rows, self.policy["n_hashes"])
-                and sig.dtype == np.uint32)
+                and sig.dtype == np.uint32):
+            return False, "shape/dtype mismatch vs manifest"
+        return True, ""
+
+    def _quarantine_file(self, path: str) -> str | None:
+        """Move a corrupt artifact into quarantine/ (never delete — the
+        operator may want the evidence); returns the new path."""
+        if not os.path.exists(path):
+            return None
+        qdir = os.path.join(self.directory, _QUARANTINE_DIR)
+        os.makedirs(qdir, exist_ok=True)
+        base = os.path.basename(path)
+        dest = os.path.join(qdir, base)
+        k = 0
+        while os.path.exists(dest):
+            k += 1
+            dest = os.path.join(qdir, f"{base}.{k}")
+        os.replace(path, dest)
+        return dest
+
+    def _quarantine_shard(self, entry: dict, reason: str) -> None:
+        sid = int(entry["id"])
+        log.warning("store shard %d quarantined: %s — its %d row(s) will "
+                    "probe as misses and recompute", sid, reason,
+                    int(entry["rows"]))
+        self._quarantine_file(self._sig_path(sid))
+        self._quarantine_file(self._key_path(sid))
+        self._mmaps.pop(sid, None)
+        self._key_mmaps.pop(sid, None)
+        event = record_degradation(
+            "shard_quarantine", site="store",
+            detail={"shard": sid, "rows": int(entry["rows"]),
+                    "reason": reason[:200]})
+        self.quarantined_at_open.append(event["detail"])
 
     def _validate_shards(self) -> None:
-        good = [s for s in self.shards if self._shard_ok(s)]
+        good = []
+        for entry in self.shards:
+            ok, reason = self._shard_ok(entry)
+            if ok:
+                good.append(entry)
+            else:
+                self._quarantine_shard(entry, reason)
         if len(good) != len(self.shards):
             self.shards = good
             self._write_manifest()
 
     def _sweep_orphans(self) -> None:
-        """Remove shard/temp files the manifest does not own — leftovers
-        of a crash between file write and manifest commit."""
+        """Remove shard/temp/index files the manifest does not own —
+        leftovers of a crash between file write and manifest commit
+        (append OR compaction).  Runs at open, so a SIGKILL mid-
+        compaction can never strand temp shards across runs."""
         owned = {self._sig_path(int(s["id"])) for s in self.shards}
         owned |= {self._key_path(int(s["id"])) for s in self.shards}
+        owned |= set(self._index_paths())
         for pat in ("sig_*.npy", "key_*.npy", "*.tmp.npy", "*.tmp.npz",
-                    "state_*.npz"):
+                    "state_*.npz", "index_*.npy"):
             for p in glob.glob(os.path.join(self.directory, pat)):
                 if p in owned or p == self._current_state_file():
                     continue
                 if ".tmp." in p or pat in ("sig_*.npy", "key_*.npy",
-                                           "state_*.npz"):
+                                           "state_*.npz", "index_*.npy"):
                     with _suppress_oserror():
                         os.remove(p)
 
@@ -243,13 +414,17 @@ class SignatureStore:
 
     # -- probe index --------------------------------------------------------
 
-    def _build_index(self) -> None:
-        if not self.shards:
-            self._idx_keys = np.empty(0, _DIG_DT)
-            self._idx_keys2d = np.empty((0, 2), np.uint64)
-            self._idx_shard = np.empty(0, np.int32)
-            self._idx_row = np.empty(0, np.int32)
-            return
+    def _index_fingerprint(self) -> str:
+        layout = [(int(s["id"]), int(s["rows"])) for s in self.shards]
+        return hashlib.blake2b(json.dumps(layout).encode(),
+                               digest_size=6).hexdigest()
+
+    def _index_paths(self) -> tuple[str, str]:
+        fp = self._index_fingerprint()
+        return (os.path.join(self.directory, f"index_{fp}.keys.npy"),
+                os.path.join(self.directory, f"index_{fp}.loc.npy"))
+
+    def _gather_index_arrays(self):
         keys, shard_of, row_of = [], [], []
         for s in self.shards:
             sid, rows = int(s["id"]), int(s["rows"])
@@ -259,10 +434,46 @@ class SignatureStore:
             row_of.append(np.arange(rows, dtype=np.int32))
         keys2d = np.concatenate(keys)
         order = np.argsort(_as_struct(keys2d), kind="stable")
-        self._idx_keys2d = keys2d[order]
-        self._idx_keys = _as_struct(self._idx_keys2d)
-        self._idx_shard = np.concatenate(shard_of)[order]
-        self._idx_row = np.concatenate(row_of)[order]
+        loc = np.stack([np.concatenate(shard_of)[order],
+                        np.concatenate(row_of)[order]], axis=1)
+        return keys2d[order], loc
+
+    def _build_index(self) -> None:
+        total = sum(int(s["rows"]) for s in self.shards)
+        if total == 0:
+            self._idx_mode = "ram"
+            self._idx_keys = np.empty(0, _DIG_DT)
+            self._idx_keys2d = np.empty((0, 2), np.uint64)
+            self._idx_shard = np.empty(0, np.int32)
+            self._idx_row = np.empty(0, np.int32)
+            return
+        if total < self._idx_mmap_rows():
+            self._idx_mode = "ram"
+            keys2d, loc = self._gather_index_arrays()
+            self._idx_keys2d = keys2d
+            self._idx_keys = _as_struct(keys2d)
+            self._idx_shard = np.ascontiguousarray(loc[:, 0])
+            self._idx_row = np.ascontiguousarray(loc[:, 1])
+            return
+        # Bounded-memory mode: materialize the sorted index once per
+        # shard-list generation, then PROBE VIA MMAP — steady-state RSS
+        # is O(touched pages), not O(total keys).  Hits are re-verified
+        # against the CRC-framed key shards below (`_verify_hits`), so a
+        # rotted index byte downgrades to a miss, never a wrong gather.
+        self._idx_mode = "mmap"
+        keys_path, loc_path = self._index_paths()
+        if not (os.path.exists(keys_path) and os.path.exists(loc_path)):
+            keys2d, loc = self._gather_index_arrays()
+            for path, arr in ((keys_path, keys2d), (loc_path, loc)):
+                tmp = path + ".tmp.npy"
+                np.save(tmp, arr)
+                os.replace(tmp, path)
+            del keys2d, loc
+        self._idx_keys2d = np.load(keys_path, mmap_mode="r")
+        self._idx_keys = self._idx_keys2d.view(_DIG_DT).reshape(-1)
+        loc_mm = np.load(loc_path, mmap_mode="r")
+        self._idx_shard = loc_mm[:, 0]
+        self._idx_row = loc_mm[:, 1]
 
     @property
     def n_rows(self) -> int:
@@ -275,6 +486,45 @@ class SignatureStore:
 
     def shard_ids(self) -> set:
         return {int(s["id"]) for s in self.shards}
+
+    def _key_mmap(self, sid: int) -> np.ndarray:
+        mm = self._key_mmaps.get(sid)
+        if mm is None:
+            mm = np.load(self._key_path(sid), mmap_mode="r")
+            self._key_mmaps[sid] = mm
+        return mm
+
+    def _verify_hits(self, digests: np.ndarray, hit: np.ndarray,
+                     shard: np.ndarray, row: np.ndarray) -> None:
+        """Mmap-index hits re-checked against the authoritative (CRC-
+        framed) key shards: a corrupt index locator must downgrade to a
+        miss-and-recompute, never gather another row's signature."""
+        idx = np.flatnonzero(hit)
+        if idx.size == 0:
+            return
+        d = np.ascontiguousarray(digests, dtype="<u8")
+        for sid in np.unique(shard[idx]):
+            sel = idx[shard[idx] == sid]
+            actual = np.asarray(self._key_mmap(int(sid))[row[sel]])
+            bad = sel[~np.all(actual == d[sel], axis=1)]
+            if bad.size:
+                log.warning("store index: %d locator(s) failed key "
+                            "verification; treating as misses", bad.size)
+                hit[bad] = False
+                shard[bad] = -1
+                row[bad] = -1
+
+    def _touch_probed(self, shard: np.ndarray, hit: np.ndarray) -> None:
+        """Stamp the shards this probe actually hit with a fresh probe
+        generation (the LRU recency signal; persisted with the next
+        manifest write)."""
+        if not hit.any():
+            return
+        self._probe_gen += 1
+        hot = set(int(s) for s in np.unique(shard[hit]))
+        for entry in self.shards:
+            if int(entry["id"]) in hot:
+                entry["probe_gen"] = self._probe_gen
 
     def bulk_probe(self, digests: np.ndarray
                    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
@@ -290,10 +540,13 @@ class SignatureStore:
         inb = pos < self._idx_keys.shape[0]
         hit = np.zeros(n, bool)
         hit[inb] = np.all(
-            self._idx_keys2d[pos[inb]] == np.ascontiguousarray(
+            np.asarray(self._idx_keys2d[pos[inb]]) == np.ascontiguousarray(
                 digests, dtype="<u8")[inb], axis=1)
         shard[hit] = self._idx_shard[pos[hit]]
         row[hit] = self._idx_row[pos[hit]]
+        if self._idx_mode == "mmap":
+            self._verify_hits(digests, hit, shard, row)
+        self._touch_probed(shard, hit)
         return hit, shard, row
 
     def _sig_mmap(self, sid: int) -> np.ndarray:
@@ -322,9 +575,9 @@ class SignatureStore:
     def append(self, digests: np.ndarray, sigs: np.ndarray) -> int:
         """Append (digest, signature) rows not already stored; returns the
         number of rows actually written.  Duplicate digests within the
-        batch keep their first occurrence.  The shard write is atomic and
-        runs under the shared retry engine (a torn write — or an injected
-        one — rewrites the temp files from scratch)."""
+        batch keep their first occurrence.  The shard write is atomic,
+        CRC-framed, and runs under the shared retry engine (a torn write
+        — or an injected one — rewrites the temp files from scratch)."""
         if digests.shape[0] == 0:
             return 0
         hit, _, _ = self.bulk_probe(digests)
@@ -339,42 +592,232 @@ class SignatureStore:
         sid = 1 + max((int(e["id"]) for e in self.shards), default=-1)
         sig_path, key_path = self._sig_path(sid), self._key_path(sid)
         sig_tmp, key_tmp = sig_path + ".tmp.npy", key_path + ".tmp.npy"
+        crcs = {}
 
         def write_shard() -> None:
             np.save(sig_tmp, s)
             np.save(key_tmp, d)
+            # Frame BEFORE the rename: the checksum covers the bytes the
+            # commit publishes, and a torn/injected failure re-frames.
+            crcs["sig"] = file_crc(sig_tmp)
+            crcs["key"] = file_crc(key_tmp)
             fault_point("store.sig.save", path=sig_tmp)
             os.replace(sig_tmp, sig_path)
             os.replace(key_tmp, key_path)
 
         retry_call(write_shard, policy=io_retry_policy(),
                    site="store.sig.save")
-        self.shards.append({"id": sid, "rows": int(d.shape[0])})
+        self.shards.append({"id": sid, "rows": int(d.shape[0]),
+                            "sig_crc": crcs["sig"], "key_crc": crcs["key"],
+                            "probe_gen": self._probe_gen})
         self._write_manifest()
         self._evict(keep_sid=sid)
         self._build_index()
         return int(d.shape[0])
 
     def _evict(self, keep_sid: int) -> None:
-        """FIFO whole-shard eviction down to ``max_bytes`` (never the
-        shard just written).  Safe by construction: evicted rows probe as
-        misses and recompute; a stale LSH-state locator is detected at
-        load (`load_state`)."""
+        """LRU whole-shard eviction down to ``max_bytes`` (never the
+        shard just written): the shard with the OLDEST probe generation
+        goes first — a shard no warm run has gathered from in ages is
+        the cheapest recompute.  Safe by construction: evicted rows
+        probe as misses and recompute; a stale LSH-state locator is
+        detected at load (`load_state`)."""
         if not self.max_bytes:
             return
         while self.sig_bytes > self.max_bytes and len(self.shards) > 1:
-            victim = self.shards[0]
-            if int(victim["id"]) == keep_sid:
+            candidates = [e for e in self.shards
+                          if int(e["id"]) != keep_sid]
+            if not candidates:
                 break
-            self.shards.pop(0)
+            victim = min(candidates,
+                         key=lambda e: (int(e.get("probe_gen", 0)),
+                                        int(e["id"])))
+            self.shards.remove(victim)
             self._write_manifest()
             self._mmaps.pop(int(victim["id"]), None)
-            log.info("store eviction: dropped shard %d (%d rows)",
-                     victim["id"], victim["rows"])
+            self._key_mmaps.pop(int(victim["id"]), None)
+            log.info("store eviction (LRU): dropped shard %d (%d rows, "
+                     "probe_gen %d)", victim["id"], victim["rows"],
+                     victim.get("probe_gen", 0))
+            record_degradation("shard_evicted", site="store",
+                               detail={"shard": int(victim["id"]),
+                                       "rows": int(victim["rows"])})
             for p in (self._sig_path(int(victim["id"])),
                       self._key_path(int(victim["id"]))):
                 with _suppress_oserror():
                     os.remove(p)
+
+    # -- compaction ---------------------------------------------------------
+
+    def compact(self, min_shards: int = 2) -> int:
+        """Fold every committed shard into ONE large shard (many small
+        daily appends -> one sequential-gather file).  Exact: the LSH
+        state's per-row locator is remapped through the concatenation
+        offsets, so a warm merge right after compaction behaves exactly
+        as before it.  Returns the number of shards folded (0 = nothing
+        to do).  Crash-safe: the new shard commits via the manifest like
+        any append; a SIGKILL mid-write leaves temps the next open
+        sweeps and the old shards untouched."""
+        if len(self.shards) < max(2, min_shards):
+            return 0
+        old = list(self.shards)
+        keys = np.concatenate([np.load(self._key_path(int(e["id"])))
+                               for e in old])
+        sigs = np.concatenate([np.load(self._sig_path(int(e["id"])))
+                               for e in old])
+        offsets = {}
+        base = 0
+        for e in old:
+            offsets[int(e["id"])] = base
+            base += int(e["rows"])
+        sid = 1 + max(int(e["id"]) for e in old)
+        sig_path, key_path = self._sig_path(sid), self._key_path(sid)
+        sig_tmp, key_tmp = sig_path + ".tmp.npy", key_path + ".tmp.npy"
+        crcs = {}
+
+        def write_compacted() -> None:
+            np.save(sig_tmp, sigs)
+            np.save(key_tmp, keys)
+            crcs["sig"] = file_crc(sig_tmp)
+            crcs["key"] = file_crc(key_tmp)
+            fault_point("store.compact.save", path=sig_tmp)
+            os.replace(sig_tmp, sig_path)
+            os.replace(key_tmp, key_path)
+
+        retry_call(write_compacted, policy=io_retry_policy(),
+                   site="store.compact.save")
+        self.shards = [{"id": sid, "rows": int(keys.shape[0]),
+                        "sig_crc": crcs["sig"], "key_crc": crcs["key"],
+                        "probe_gen": max(int(e.get("probe_gen", 0))
+                                         for e in old)}]
+        self._write_manifest()  # the commit point: old shards now orphans
+        self._remap_state(offsets, sid)
+        self._mmaps.clear()
+        self._key_mmaps.clear()
+        for e in old:
+            for p in (self._sig_path(int(e["id"])),
+                      self._key_path(int(e["id"]))):
+                with _suppress_oserror():
+                    os.remove(p)
+        self._sweep_orphans()
+        self._build_index()
+        log.info("store compaction: %d shards -> 1 (%d rows)", len(old),
+                 int(keys.shape[0]))
+        return len(old)
+
+    def _remap_state(self, offsets: dict, new_sid: int) -> None:
+        """Rewrite the LSH state's (shard, row) locator through the
+        compaction offsets.  A state that cannot be remapped (torn,
+        references an already-evicted shard) is dropped — the next run
+        falls back to the union path, labels unchanged."""
+        meta = self._load_json(self._state_path)
+        if meta is None:
+            return
+        path = os.path.join(self.directory, str(meta.get("file")))
+        try:
+            with np.load(path) as z:
+                payload = {k: z[k].copy() for k in z.files}
+        except Exception as e:  # graftlint: disable=broad-except -- a torn state must drop to the union fallback whatever the failure mode
+            log.warning("LSH state unreadable during compaction (%s); "
+                        "dropping it", e)
+            with _suppress_oserror():
+                os.remove(self._state_path)
+            return
+        locator = payload.get("locator")
+        if locator is None or (locator.size and not all(
+                int(s) in offsets for s in np.unique(locator[:, 0]))):
+            log.warning("LSH state references shard(s) outside this "
+                        "compaction; dropping it")
+            with _suppress_oserror():
+                os.remove(self._state_path)
+            return
+        if locator.size:
+            off = np.array([offsets[int(s)] for s in locator[:, 0]],
+                           np.int64)
+            payload["locator"] = np.stack(
+                [np.full(locator.shape[0], new_sid, np.int32),
+                 (locator[:, 1].astype(np.int64) + off).astype(np.int32)],
+                axis=1)
+        gen = int(meta.get("gen", 0)) + 1
+        fname = f"state_{gen:05d}.npz"
+        new_path = os.path.join(self.directory, fname)
+        tmp = new_path + ".tmp.npz"
+
+        def write_state() -> None:
+            np.savez(tmp, **payload)
+            fault_point("store.state.save", path=tmp)
+            os.replace(tmp, new_path)
+
+        retry_call(write_state, policy=io_retry_policy(),
+                   site="store.state.save")
+        meta.update(file=fname, gen=gen, crc=file_crc(new_path))
+        with atomic_write(self._state_path) as f:
+            json.dump(meta, f)
+        old = path
+        if old != new_path:
+            with _suppress_oserror():
+                os.remove(old)
+
+    # -- scrub --------------------------------------------------------------
+
+    def scrub(self, repair: bool = False, compact: bool = False) -> dict:
+        """Walk the store and report frame health (``store_scrub_*`` —
+        the bench/CI key namespace).  ``repair`` re-frames legacy
+        (pre-CRC) shards and sweeps orphans; ``compact`` additionally
+        folds the shards.  Corruption found here (or at open) is already
+        quarantined — scrub makes it visible and countable."""
+        corrupt = list(self.quarantined_at_open)
+        missing_crc = 0
+        for entry in list(self.shards):
+            ok, reason = self._shard_ok(entry)
+            if not ok:
+                self._quarantine_shard(entry, reason)
+                self.shards.remove(entry)
+                corrupt.append({"shard": int(entry["id"]),
+                                "reason": reason})
+                self._write_manifest()
+                continue
+            if entry.get("sig_crc") is None or entry.get("key_crc") is None:
+                missing_crc += 1
+                if repair:
+                    sid = int(entry["id"])
+                    entry["sig_crc"] = file_crc(self._sig_path(sid))
+                    entry["key_crc"] = file_crc(self._key_path(sid))
+                    self._write_manifest()
+                    missing_crc -= 1
+        state_ok = self._state_frame_ok()
+        compacted = self.compact() if compact else 0
+        if repair or compacted:
+            self._sweep_orphans()
+            self._build_index()
+        qdir = os.path.join(self.directory, _QUARANTINE_DIR)
+        quarantined = (len(os.listdir(qdir)) if os.path.isdir(qdir) else 0)
+        return {
+            "store_scrub_shards": len(self.shards),
+            "store_scrub_rows": self.n_rows,
+            "store_scrub_mb": round(self.sig_bytes / 2**20, 3),
+            "store_scrub_corrupt": len(corrupt),
+            "store_scrub_quarantined": quarantined,
+            "store_scrub_missing_crc": missing_crc,
+            "store_scrub_state_ok": bool(state_ok),
+            "store_scrub_compacted": compacted,
+            "store_scrub_repaired": bool(repair),
+        }
+
+    def _state_frame_ok(self) -> bool:
+        meta = self._load_json(self._state_path)
+        if meta is None:
+            return True  # no state is a valid (cold) store
+        path = os.path.join(self.directory, str(meta.get("file")))
+        if not os.path.exists(path):
+            return False
+        want = meta.get("crc")
+        if want is None:
+            return True  # legacy unframed state
+        try:
+            return int(file_crc(path)) == int(want)
+        except OSError:
+            return False
 
     # -- LSH run state ------------------------------------------------------
 
@@ -382,10 +825,10 @@ class SignatureStore:
                    tables: tuple[list, list], digests: np.ndarray,
                    n_bands: int, threshold: float) -> bool:
         """Commit the completed run's LSH state (atomically: npz first,
-        then the json pointer).  Returns False — state intentionally not
-        saved — when any row's signature is not locatable in the store
-        (eviction raced the run); a warm merge must never gather from a
-        shard that is gone."""
+        then the json pointer carrying the npz's CRC frame).  Returns
+        False — state intentionally not saved — when any row's signature
+        is not locatable in the store (eviction raced the run); a warm
+        merge must never gather from a shard that is gone."""
         if locator.size and int(locator.min()) < 0:
             log.warning("not saving LSH state: %d row(s) have no stored "
                         "signature (store eviction?)",
@@ -412,10 +855,14 @@ class SignatureStore:
                    site="store.state.save")
         with atomic_write(self._state_path) as f:
             json.dump({"file": fname, "gen": gen,
+                       "crc": file_crc(path),
                        "n_rows": int(labels.shape[0]),
                        "n_bands": int(n_bands),
                        "threshold": float(threshold),
                        "prefix_digest": digests_fingerprint(digests)}, f)
+        # The probe generations stamped during this run ride along with
+        # the state commit (the manifest is the LRU ledger).
+        self._write_manifest()
         old = prior.get("file")
         if old and old != fname:
             with _suppress_oserror():
@@ -423,10 +870,12 @@ class SignatureStore:
         return True
 
     def load_state(self, n_bands: int, threshold: float):
-        """The last run's LSH state, or None when absent, torn, built
-        under different banding/threshold, or referencing evicted shards.
-        Unlike a sig-policy mismatch this does not refuse the run — the
-        signatures are still valid; only the label-merge shortcut is."""
+        """The last run's LSH state, or None when absent, torn, CRC-
+        corrupt, built under different banding/threshold, or referencing
+        evicted shards.  Unlike a sig-policy mismatch this does not
+        refuse the run — the signatures are still valid; only the
+        label-merge shortcut is.  A corrupt state npz is quarantined so
+        the union fallback recomputes from verified signatures."""
         from .incremental import LshState
 
         meta = self._load_json(self._state_path)
@@ -438,6 +887,21 @@ class SignatureStore:
                         "banding/threshold; rebuilding", self.directory)
             return None
         path = os.path.join(self.directory, str(meta.get("file")))
+        want_crc = meta.get("crc")
+        if want_crc is not None and os.path.exists(path):
+            try:
+                got = file_crc(path)
+            except OSError:
+                got = None
+            if got is None or int(got) != int(want_crc):
+                log.warning("LSH state CRC frame mismatch; quarantining "
+                            "and rebuilding via the union path")
+                self._quarantine_file(path)
+                with _suppress_oserror():
+                    os.remove(self._state_path)
+                record_degradation("state_quarantine", site="store",
+                                   detail={"file": os.path.basename(path)})
+                return None
         try:
             with np.load(path) as z:
                 labels = z["labels"]
@@ -468,4 +932,4 @@ class _suppress_oserror:
 
 
 __all__ = ["POLICY_KEYS", "SignatureStore", "digests_fingerprint",
-           "row_digests"]
+           "file_crc", "row_digests"]

@@ -20,7 +20,8 @@ import sqlite3
 from typing import Any, Callable, Iterable, Sequence
 
 from ..config import Config, load_config
-from ..resilience import fault_point, io_retry_policy, retry_call
+from ..resilience import (deadline_guard, fault_point, io_retry_policy,
+                          retry_call)
 from ..utils.logging import get_logger
 
 log = get_logger("db")
@@ -206,6 +207,26 @@ class DB:
             self.cursor = self.connection = None
         self._connect_once()
 
+    # A wedged sqlite statement (runaway cross join, scan over a corrupt
+    # page) is interrupted at this multiple of db_statement_timeout_ms —
+    # above the busy_timeout so lock waits get their full budget first.
+    # Postgres needs no guard: SET statement_timeout is server-side.
+    _STMT_DEADLINE_MULT = 4
+
+    def _with_statement_deadline(self, op: Callable, site: str):
+        """Run one statement under the watchdog's absolute deadline
+        (sqlite only, and only when a statement timeout is configured):
+        past the budget, ``Connection.interrupt`` cancels the statement
+        cooperatively and it fails in-thread as OperationalError —
+        classified transient, so the bounded retry path owns recovery.
+        A hung statement was previously the failure that never raises."""
+        timeout_ms = self.config.db_statement_timeout_ms
+        if self.dialect != "sqlite" or timeout_ms <= 0:
+            return op()
+        budget_s = timeout_ms * self._STMT_DEADLINE_MULT / 1000.0
+        with deadline_guard(budget_s, self.connection.interrupt, site=site):
+            return op()
+
     def _statement(self, op: Callable, site: str = "db.execute",
                    commits: bool = False, writes: bool = False):
         """Run ``op()`` (a closure over ``self.cursor``) under the shared
@@ -238,7 +259,7 @@ class DB:
             fault_point(site)
             if self.connection is None or self.cursor is None:
                 self._connect_once()
-            result = op()
+            result = self._with_statement_deadline(op, site)
             if commits:
                 self._dirty = False
             elif writes:

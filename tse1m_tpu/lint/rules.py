@@ -21,6 +21,9 @@ invariant some PR actually shipped:
 - ``retry-bypass``        all HTTP/DB I/O through the retry engine (PR 1)
 - ``nondeterminism``      no wall-clock/global-RNG in chaos-replayed
                           planes (seeded fault plans must replay)
+- ``watchdog-clock``      the supervision plane reads time only through
+                          resilience.watchdog.deadline_clock (one
+                          monotonic time base for every deadline)
 """
 
 from __future__ import annotations
@@ -612,6 +615,47 @@ def nondeterminism(src: FileSource) -> list[Finding]:
     return out
 
 
+# -- 9. watchdog-clock -------------------------------------------------------
+#
+# The supervision plane's invariant (watchdog PR): every deadline, budget
+# and stall decision reads time through resilience.watchdog.deadline_clock
+# — one monotonic clock for the whole plane.  A raw clock call in deadline
+# logic forks the time base: a wall-clock seat can jump with NTP/DST and
+# fire (or starve) a watchdog, and even a second monotonic seat makes the
+# plane's arithmetic unauditable.  Scope: the watchdog module itself plus
+# any function whose name claims deadline/watchdog/stall semantics.
+
+_WATCHDOG_PLANE = ("tse1m_tpu/resilience/watchdog.py",)
+_CLOCK_CALLS = {"time.time", "time.time_ns", "time.monotonic",
+                "time.monotonic_ns", "time.perf_counter",
+                "time.perf_counter_ns", "time.clock_gettime"}
+_WATCHDOG_NAME_MARKERS = ("deadline", "watchdog", "stall")
+
+
+def watchdog_clock(src: FileSource) -> list[Finding]:
+    out = []
+    parents = None
+    in_plane = src.path in _WATCHDOG_PLANE
+    for node in ast.walk(src.tree):
+        if not (isinstance(node, ast.Call)
+                and _dotted(node.func) in _CLOCK_CALLS):
+            continue
+        if parents is None:
+            parents = _parents(src.tree)
+        fn = _enclosing_function(node, parents)
+        fname = fn.name if fn is not None else ""
+        if fname == "deadline_clock":
+            continue  # THE helper — the plane's one blessed raw-clock seat
+        if in_plane or any(m in fname.lower()
+                           for m in _WATCHDOG_NAME_MARKERS):
+            out.append(_f(src, node,
+                          f"raw clock `{_dotted(node.func)}()` in the "
+                          "watchdog plane — read time through "
+                          "resilience.watchdog.deadline_clock so every "
+                          "deadline shares one monotonic time base"))
+    return out
+
+
 RULES = {
     "broad-except": broad_except,
     "nonatomic-write": nonatomic_write,
@@ -621,6 +665,7 @@ RULES = {
     "unlocked-shared-state": unlocked_shared_state,
     "retry-bypass": retry_bypass,
     "nondeterminism": nondeterminism,
+    "watchdog-clock": watchdog_clock,
 }
 
 __all__ = ["RULES"]

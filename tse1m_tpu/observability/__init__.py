@@ -130,5 +130,56 @@ def pop_last_stages() -> dict | None:
         return out
 
 
-__all__ = ["STAGES", "StageRecorder", "record_last_stages",
-           "peek_last_stages", "pop_last_stages"]
+# -- degradation events -----------------------------------------------------
+# The supervision/degradation plane's observable log: every time the
+# system survives a failure by degrading — a stalled transfer cancelled
+# and retried, a chunk halved under RESOURCE_EXHAUSTED, a device failover,
+# a quarantined store shard, an interrupted DB statement — one event lands
+# here.  Consumed destructively by resilience.StepRunner (per-step
+# ``degradations`` list in run_manifest.json) and by bench.py
+# (``degradation_events`` / ``chunk_halvings`` keys), same handoff
+# contract as the stage record above.  Events are deterministic (no
+# wall-clock): ``seq`` orders them within a process.
+
+_degradations: list = []
+_degradation_lock = threading.Lock()
+_degradation_seq = 0
+
+
+def record_degradation(kind: str, site: str = "",
+                       detail: dict | None = None) -> dict:
+    """Append one degradation event; returns the event dict."""
+    global _degradation_seq
+    with _degradation_lock:
+        _degradation_seq += 1
+        event = {"seq": _degradation_seq, "kind": kind, "site": site,
+                 "detail": dict(detail or {})}
+        _degradations.append(event)
+    return event
+
+
+def peek_degradation_events() -> list:
+    with _degradation_lock:
+        return [dict(e) for e in _degradations]
+
+
+def pop_degradation_events() -> list:
+    """Take (and clear) the accumulated degradation events."""
+    with _degradation_lock:
+        out = list(_degradations)
+        _degradations.clear()
+        return out
+
+
+def degradation_counts(events: list) -> dict:
+    """kind -> count summary for manifests/bench JSON."""
+    by: dict[str, int] = {}
+    for e in events:
+        by[e["kind"]] = by.get(e["kind"], 0) + 1
+    return by
+
+
+__all__ = ["STAGES", "StageRecorder", "degradation_counts",
+           "peek_degradation_events", "pop_degradation_events",
+           "record_degradation", "record_last_stages", "peek_last_stages",
+           "pop_last_stages"]

@@ -22,6 +22,10 @@ property:
   — each step isolated, per-step status/attempts/traceback recorded in
   ``run_manifest.json``, survivors complete, exit code reflects partial
   failure.
+- ``watchdog``: heartbeat deadlines for failures that never raise —
+  stalled transfers cancelled + retried under adaptive budgets, hung
+  device compute / DB statements bounded by absolute deadlines, every
+  recovery recorded as a degradation event (observability plane).
 """
 
 from __future__ import annotations
@@ -33,12 +37,18 @@ from .faults import (FaultPlan, FaultRule, InjectedConnectionDrop,
                      install_plan, reraise_if_fault)
 from .retry import RetryError, RetryPolicy, retry_call
 from .runner import StepRunner
+from .watchdog import (Deadline, StageWatchdog, StallError, deadline_clock,
+                       deadline_guard, is_device_loss, is_resource_exhausted,
+                       run_with_deadline, watchdog_enabled)
 
 __all__ = [
-    "FaultPlan", "FaultRule", "InjectedConnectionDrop", "InjectedFault",
-    "RetryError", "RetryPolicy", "StepRunner", "active_plan", "clear_plan",
-    "fault_point", "install_plan", "io_retry_policy", "reraise_if_fault",
-    "retry_call",
+    "Deadline", "FaultPlan", "FaultRule", "InjectedConnectionDrop",
+    "InjectedFault", "RetryError", "RetryPolicy", "StageWatchdog",
+    "StallError", "StepRunner", "active_plan", "clear_plan",
+    "deadline_clock", "deadline_guard", "fault_point", "install_plan",
+    "io_retry_policy", "is_device_loss", "is_resource_exhausted",
+    "reraise_if_fault", "retry_call", "run_with_deadline",
+    "watchdog_enabled",
 ]
 
 

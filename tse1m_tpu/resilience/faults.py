@@ -25,6 +25,11 @@ Fault kinds:
 - ``torn_write``: truncate the file at the seat's ``path`` to
   ``truncate_fraction`` of its bytes, then raise — a crash mid-write
 - ``kill``:   ``SIGKILL`` the current process — the chaos-test hammer
+- ``stall``:  sleep ``stall_s`` seconds, then pass through — a hung
+  link/device/statement, the failure that never raises.  The watchdog
+  plane (resilience/watchdog.py) is what turns this into a recoverable
+  cancellation; without a watchdog the seat genuinely hangs, which is
+  the point.
 """
 
 from __future__ import annotations
@@ -50,7 +55,8 @@ class InjectedConnectionDrop(ConnectionError, InjectedFault):
     """An injected dropped connection (classified like a real one)."""
 
 
-_KINDS = ("raise", "connection_drop", "delay", "torn_write", "kill")
+_KINDS = ("raise", "connection_drop", "delay", "torn_write", "kill",
+          "stall")
 
 
 @dataclass
@@ -67,6 +73,7 @@ class FaultRule:
     probability: float = 1.0       # per-eligible-call chance (seeded RNG)
     message: str = "injected fault"
     delay_s: float = 0.05          # kind=delay
+    stall_s: float = 30.0          # kind=stall (a hang, not a hiccup)
     truncate_fraction: float = 0.5  # kind=torn_write
     _seen: int = field(default=0, repr=False, compare=False)
     _fired: int = field(default=0, repr=False, compare=False)
@@ -139,6 +146,9 @@ class FaultPlan:
     def _apply(self, rule: FaultRule, site: str, path: str | None) -> None:
         if rule.kind == "delay":
             time.sleep(rule.delay_s)
+            return
+        if rule.kind == "stall":
+            time.sleep(rule.stall_s)
             return
         if rule.kind == "kill":
             os.kill(os.getpid(), signal.SIGKILL)

@@ -18,6 +18,7 @@ from dataclasses import dataclass, field
 from typing import Callable
 
 from ..utils.logging import get_logger
+from .watchdog import deadline_clock
 
 log = get_logger("resilience.retry")
 
@@ -65,7 +66,7 @@ def retry_call(
     on_retry: Callable[[BaseException, int], None] | None = None,
     sleep: Callable[[float], None] = time.sleep,
     rng: random.Random | None = None,
-    clock: Callable[[], float] = time.monotonic,
+    clock: Callable[[], float] = deadline_clock,
     stats: RetryStats | None = None,
     **kwargs,
 ):

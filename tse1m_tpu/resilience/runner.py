@@ -41,6 +41,11 @@ class StepRecord:
     # sanitizer self-check); a failing step may attach one via a
     # ``step_result`` attribute on the raised exception.
     result: dict | None = None
+    # Degradation events the step survived (observability plane: stall
+    # retries, chunk halvings, device failovers, quarantined store
+    # shards) — the run manifest is the long-run operator's ledger of
+    # what the supervision plane absorbed.  None when the step ran clean.
+    degradations: list | None = None
 
 
 class StepRunner:
@@ -66,7 +71,7 @@ class StepRunner:
     def run(self, name: str, fn, *args, **kwargs) -> StepRecord:
         """Run one step isolated; never raises (the record carries the
         failure)."""
-        from ..observability import pop_last_stages
+        from ..observability import pop_degradation_events, pop_last_stages
 
         rec = StepRecord(name=name, status="running")
         self.steps.append(rec)
@@ -74,6 +79,7 @@ class StepRunner:
         attempts = [0]
         pop_last_stages()  # drop a predecessor's stages; only telemetry
         #                    recorded BY this step may attach to it
+        pop_degradation_events()  # same isolation for degradation events
 
         def attempt():
             attempts[0] += 1
@@ -102,6 +108,7 @@ class StepRunner:
         rec.attempts = attempts[0]
         rec.wall_s = round(time.time() - t0, 3)
         rec.stages = pop_last_stages()
+        rec.degradations = pop_degradation_events() or None
         self._write()
         return rec
 
@@ -133,11 +140,17 @@ class StepRunner:
     def _write(self) -> None:
         if not self.manifest_path:
             return
+        from ..observability import degradation_counts
+
+        events = [e for s in self.steps for e in (s.degradations or [])]
         payload = {
             "started_at": self.started_at,
             "wall_seconds": round(time.time() - self.started_at, 3),
             "ok": not self.failed,
             "summary": self.summary(),
+            # kind -> count over every step: the one-glance answer to
+            # "what did the supervision plane absorb this run".
+            "degradation_counts": degradation_counts(events),
             "steps": [asdict(s) for s in self.steps],
         }
         os.makedirs(os.path.dirname(self.manifest_path) or ".",

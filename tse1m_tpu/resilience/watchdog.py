@@ -1,0 +1,283 @@
+"""Watchdog supervision: per-stage heartbeat deadlines for the long run.
+
+Continuous fuzzing means the pipeline runs unattended for days, and the
+failure the retry engine cannot see is the one that never raises: a
+tunneled H2D link that silently stalls (BENCH_r05 measured the same
+transfer at 9.7-16.7 s run to run — a hung socket looks identical until
+you bound it), a device compute that never completes, a DB statement
+wedged behind a lock.  This module turns "hung" into a first-class,
+recoverable failure:
+
+- :func:`deadline_clock` — THE clock for every deadline in this plane
+  (monotonic; immune to NTP steps).  graftlint's ``watchdog-clock`` rule
+  forbids raw wall-clock calls here, so a deadline can never jump
+  backwards or forwards with the system clock.
+- :func:`run_with_deadline` — run a callable on a reaper-able worker
+  thread; past the budget the attempt is *cancelled* (abandoned — the
+  caller retries with a fresh attempt) and :class:`StallError` raised.
+- :func:`deadline_guard` — absolute deadline for in-thread work that owns
+  a cooperative cancel hook (e.g. ``sqlite3.Connection.interrupt`` for a
+  hung DB statement).
+- :class:`StageWatchdog` — adaptive per-stage budgets: the H2D bound
+  derives from the link's *measured* rate (seeded from the persisted
+  link probe, then EWMA-updated from every completed chunk), device
+  compute and DB statements get absolute deadlines.  ``guarded_call``
+  combines the deadline with bounded stall-retries and records every
+  cancellation as a degradation event (observability plane ->
+  ``run_manifest.json`` / bench ``degradation_*`` keys).
+
+Chaos seats: the fault plane's ``stall`` kind (resilience/faults.py)
+sleeps at a production seat — ``pipeline.h2d``, ``pipeline.compute`` —
+so tests force a hang through the real code path and assert the
+watchdog's recovery reproduces the uninterrupted run's labels.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Callable
+
+from ..utils.logging import get_logger
+
+log = get_logger("resilience.watchdog")
+
+
+def deadline_clock() -> float:
+    """The watchdog plane's one clock (seconds, monotonic).  Every budget,
+    deadline and stall decision in this plane must read time through this
+    helper — enforced by graftlint's ``watchdog-clock`` rule — so a
+    wall-clock step (NTP, DST, operator `date`) can never fire or starve
+    a watchdog."""
+    return time.monotonic()
+
+
+class StallError(RuntimeError):
+    """An attempt exceeded its watchdog deadline and was cancelled."""
+
+    def __init__(self, site: str, budget_s: float):
+        super().__init__(f"{site}: no heartbeat within {budget_s:.2f}s "
+                         "budget; attempt cancelled")
+        self.site = site
+        self.budget_s = budget_s
+
+
+class Deadline:
+    """An absolute deadline anchored at construction time."""
+
+    def __init__(self, budget_s: float):
+        self.budget_s = float(budget_s)
+        self._t0 = deadline_clock()
+
+    def elapsed(self) -> float:
+        return deadline_clock() - self._t0
+
+    def remaining(self) -> float:
+        return self.budget_s - self.elapsed()
+
+    def expired(self) -> bool:
+        return self.remaining() <= 0.0
+
+
+def run_with_deadline(fn: Callable, budget_s: float, site: str):
+    """Run ``fn()`` on a daemon worker thread; raise :class:`StallError`
+    when it does not complete within ``budget_s``.
+
+    A thread cannot be killed, so "cancel" means *abandon*: the stalled
+    attempt keeps running detached (daemon — it cannot block process
+    exit) and its eventual result is discarded; the caller retries with a
+    fresh attempt.  Side-effect discipline is therefore on the caller:
+    only guard operations whose duplicate completion is harmless (an
+    idempotent device_put, a read).  Exceptions from ``fn`` re-raise
+    here unchanged."""
+    if budget_s is None or budget_s <= 0:
+        return fn()
+    box: dict = {}
+
+    def worker() -> None:
+        try:
+            box["result"] = fn()
+        except BaseException as e:  # graftlint: disable=broad-except -- relayed verbatim (incl. InjectedFault) via `raise box["error"]` below
+            box["error"] = e
+
+    t = threading.Thread(target=worker, daemon=True,
+                         name=f"tse1m-watchdog:{site}")
+    t.start()
+    t.join(budget_s)
+    if t.is_alive():
+        raise StallError(site, budget_s)
+    if "error" in box:
+        raise box["error"]
+    return box.get("result")
+
+
+@contextmanager
+def deadline_guard(budget_s: float, on_timeout: Callable[[], None],
+                   site: str = ""):
+    """Absolute deadline for in-thread work with a cooperative cancel.
+
+    Arms a timer that calls ``on_timeout()`` (e.g.
+    ``sqlite3.Connection.interrupt``) once ``budget_s`` elapses while the
+    body is still running; the interrupted operation then fails in-thread
+    with its own exception.  The timeout hook never fires after the body
+    has completed (completion flag checked under a lock before firing),
+    so a near-miss cannot interrupt a *later* statement."""
+    if budget_s is None or budget_s <= 0:
+        yield
+        return
+    state = {"done": False, "fired": False}
+    lock = threading.Lock()
+
+    def fire() -> None:
+        with lock:
+            if state["done"]:
+                return
+            state["fired"] = True
+        from ..observability import record_degradation
+
+        record_degradation("deadline_interrupt", site=site,
+                           detail={"budget_s": budget_s})
+        log.warning("%s: deadline %.2fs exceeded; interrupting", site,
+                    budget_s)
+        on_timeout()
+
+    timer = threading.Timer(budget_s, fire)
+    timer.daemon = True
+    timer.start()
+    try:
+        yield
+    finally:
+        with lock:
+            state["done"] = True
+        timer.cancel()
+
+
+# -- device-failure classification -------------------------------------------
+
+# Message markers meaning "the device/link itself is gone" across PJRT
+# backends and the tunneled-link transport (mirrors db.connection's
+# _DISCONNECT_MARKERS for the DB plane).
+_DEVICE_LOSS_MARKERS = (
+    "device_lost", "device lost", "failed to connect", "socket closed",
+    "connection reset", "connection refused", "broken pipe",
+    "deadline exceeded", "unavailable", "rpc failed", "internal: stream",
+)
+
+
+def is_device_loss(e: BaseException) -> bool:
+    """True when the failure means the accelerator (or its link) died —
+    retrying on the same device is pointless; fail over instead."""
+    if isinstance(e, (ConnectionError, StallError)):
+        return True
+    msg = str(e).lower()
+    return any(m in msg for m in _DEVICE_LOSS_MARKERS)
+
+
+def is_resource_exhausted(e: BaseException) -> bool:
+    """True for XLA/PJRT out-of-memory failures (and injected ones — the
+    fault plane raises InjectedFault carrying the same marker, so the
+    production classifier needs no test-only branch)."""
+    return "RESOURCE_EXHAUSTED" in str(e)
+
+
+# -- adaptive per-stage budgets ----------------------------------------------
+
+def watchdog_enabled() -> bool:
+    return os.environ.get("TSE1M_WATCHDOG", "1") not in ("0", "false", "")
+
+
+class StageWatchdog:
+    """Adaptive heartbeat budgets per pipeline stage.
+
+    The budget for a payload of ``nbytes`` is
+    ``max(min_budget, factor * nbytes / rate)`` where ``rate`` is an EWMA
+    of the stage's measured bytes/s — seeded from the persisted link
+    probe when available (utils/calibration.py ``wire.h2d_MBps``), then
+    updated by every completed call, so the bound tracks the link this
+    process actually has.  Stages without a byte dimension (compute, DB)
+    use the absolute ``min_budget`` alone.
+
+    Env knobs: ``TSE1M_WATCHDOG`` (0 disables the plane),
+    ``TSE1M_WATCHDOG_MIN_BUDGET_S`` (floor, default 30),
+    ``TSE1M_WATCHDOG_FACTOR`` (slack over the expected wall, default 8),
+    ``TSE1M_WATCHDOG_MAX_STALLS`` (cancelled attempts per call before
+    the StallError surfaces, default 2)."""
+
+    _EWMA_ALPHA = 0.5
+
+    def __init__(self, min_budget_s: float | None = None,
+                 factor: float | None = None,
+                 max_stalls: int | None = None,
+                 seed_rates: dict | None = None) -> None:
+        env = os.environ.get
+        self.enabled = watchdog_enabled()
+        self.min_budget_s = float(
+            env("TSE1M_WATCHDOG_MIN_BUDGET_S", 30.0)
+            if min_budget_s is None else min_budget_s)
+        self.factor = float(env("TSE1M_WATCHDOG_FACTOR", 8.0)
+                            if factor is None else factor)
+        self.max_stalls = int(env("TSE1M_WATCHDOG_MAX_STALLS", 2)
+                              if max_stalls is None else max_stalls)
+        self._lock = threading.Lock()
+        self._rate: dict[str, float] = dict(seed_rates or {})  # bytes/s
+
+    def observe(self, stage: str, seconds: float, nbytes: int) -> None:
+        """Fold one completed call's measured rate into the stage EWMA."""
+        if seconds <= 0 or nbytes <= 0:
+            return
+        rate = nbytes / seconds
+        with self._lock:
+            prev = self._rate.get(stage)
+            self._rate[stage] = (rate if prev is None else
+                                 self._EWMA_ALPHA * rate
+                                 + (1 - self._EWMA_ALPHA) * prev)
+
+    def budget_for(self, stage: str, nbytes: int = 0) -> float:
+        """Seconds of heartbeat budget for one call; 0 = unguarded."""
+        if not self.enabled:
+            return 0.0
+        with self._lock:
+            rate = self._rate.get(stage)
+        if nbytes > 0 and rate:
+            return max(self.min_budget_s, self.factor * nbytes / rate)
+        return self.min_budget_s
+
+    def guarded_call(self, stage: str, fn: Callable, nbytes: int = 0,
+                     site: str = ""):
+        """``fn()`` under the stage deadline, with bounded stall-retries.
+
+        Each cancelled attempt is recorded as a ``stall_retry``
+        degradation event; past ``max_stalls`` cancellations the
+        StallError surfaces to the caller's ladder (device failover /
+        abort).  Completed calls feed the rate EWMA."""
+        site = site or stage
+        if not self.enabled:
+            return fn()
+        from ..observability import record_degradation
+
+        stalls = 0
+        while True:
+            budget = self.budget_for(stage, nbytes)
+            t0 = deadline_clock()
+            try:
+                result = run_with_deadline(fn, budget, site)
+            except StallError as e:
+                stalls += 1
+                record_degradation(
+                    "stall_retry", site=site,
+                    detail={"budget_s": round(e.budget_s, 3),
+                            "attempt": stalls, "nbytes": int(nbytes)})
+                if stalls > self.max_stalls:
+                    raise
+                log.warning("%s: stalled attempt %d cancelled (budget "
+                            "%.2fs); retrying", site, stalls, e.budget_s)
+                continue
+            self.observe(stage, deadline_clock() - t0, nbytes)
+            return result
+
+
+__all__ = ["Deadline", "StageWatchdog", "StallError", "deadline_clock",
+           "deadline_guard", "is_device_loss", "is_resource_exhausted",
+           "run_with_deadline", "watchdog_enabled"]

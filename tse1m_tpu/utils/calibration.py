@@ -1,0 +1,143 @@
+"""Machine-local measurement calibration file (schema-versioned, TTL'd).
+
+One JSON file shared by every layer that records a measurement on this
+machine and wants the *next* process to start from it instead of a
+bootstrap prior:
+
+- the auto-router's per-(rq, engine) cost-per-row EWMAs
+  (backend/auto.py — the BENCH_r05 record-and-reuse fix),
+- the cluster pipeline's degradation ladder: the chunk byte size that
+  survived RESOURCE_EXHAUSTED halving, so the next run starts at a size
+  the device can hold (cluster/pipeline.py), and the link probe's
+  measured H2D rate seeding the watchdog's adaptive stall budgets
+  (bench.py -> resilience/watchdog.py).
+
+Two properties ROADMAP called out as missing from the v1 flat file:
+
+- **Schema version**: a file written by a different layout is ignored
+  wholesale (re-measure), never half-parsed.  v1 files (no
+  ``schema_version`` key) are treated as stale for the same reason —
+  their entries carry no timestamps, so their age is unknowable.
+- **Staleness bound**: every entry carries a wall-clock ``ts``; entries
+  older than the TTL (``TSE1M_ROUTER_CAL_TTL_S``, default 6 h) are
+  dropped at load.  Link RTT drifts by time of day on the tunneled
+  setup, so a midnight measurement must not route the afternoon.
+
+Writes are read-merge-write under :func:`tse1m_tpu.utils.atomic.
+atomic_write`; concurrent writers last-write-win per section, which is
+fine for measurements (both values were true recently).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from .atomic import atomic_write
+from .logging import get_logger
+
+log = get_logger("utils.calibration")
+
+SCHEMA_VERSION = 2
+_DEFAULT_TTL_S = 6 * 3600.0
+
+
+def ttl_s() -> float:
+    return float(os.environ.get("TSE1M_ROUTER_CAL_TTL_S", _DEFAULT_TTL_S))
+
+
+def _now() -> float:
+    return time.time()
+
+
+def load_calibration(path: str | None) -> dict:
+    """Fresh (schema-matching, within-TTL) calibration state.
+
+    Returns ``{"cost_per_row": {key: float}, "wire": {key: value}}`` with
+    stale entries already dropped; empty sections when the file is
+    absent, unreadable, a different schema, or entirely stale."""
+    out: dict = {"cost_per_row": {}, "wire": {}}
+    if not path or not os.path.exists(path):
+        return out
+    try:
+        with open(path, encoding="utf-8") as f:
+            saved = json.load(f)
+    except (OSError, ValueError) as e:
+        log.warning("calibration at %s unreadable (%s); re-measuring",
+                    path, e)
+        return out
+    version = saved.get("schema_version")
+    if version != SCHEMA_VERSION:
+        log.warning("calibration at %s has schema %r (want %d); ignoring "
+                    "and re-measuring", path, version, SCHEMA_VERSION)
+        return out
+    horizon = _now() - ttl_s()
+    dropped = 0
+    for section in ("cost_per_row", "wire"):
+        for key, entry in (saved.get(section) or {}).items():
+            if not isinstance(entry, dict) or "value" not in entry:
+                continue
+            if float(entry.get("ts", 0.0)) < horizon:
+                dropped += 1
+                continue
+            out[section][key] = entry["value"]
+    if dropped:
+        log.info("calibration at %s: dropped %d stale entr%s (TTL %.0fs)",
+                 path, dropped, "y" if dropped == 1 else "ies", ttl_s())
+    return out
+
+
+def update_calibration(path: str | None, cost_per_row: dict | None = None,
+                       wire: dict | None = None) -> None:
+    """Merge new measurements into the file (stamping each with now),
+    preserving other still-fresh entries.  No-op without a path."""
+    if not path:
+        return
+    current = load_calibration(path)
+    now = _now()
+    payload = {"schema_version": SCHEMA_VERSION,
+               "cost_per_row": {k: {"value": v, "ts": now}
+                                for k, v in current["cost_per_row"].items()},
+               "wire": {k: {"value": v, "ts": now}
+                        for k, v in current["wire"].items()}}
+    # Re-stamping preserved entries would defeat the TTL; keep their
+    # original timestamps.
+    try:
+        with open(path, encoding="utf-8") as f:
+            prior = json.load(f)
+        if prior.get("schema_version") == SCHEMA_VERSION:
+            for section in ("cost_per_row", "wire"):
+                for k, entry in (prior.get(section) or {}).items():
+                    if k in payload[section] and isinstance(entry, dict) \
+                            and "ts" in entry:
+                        payload[section][k]["ts"] = entry["ts"]
+    except (OSError, ValueError):
+        pass
+    for k, v in (cost_per_row or {}).items():
+        payload["cost_per_row"][k] = {"value": float(v), "ts": now}
+    for k, v in (wire or {}).items():
+        payload["wire"][k] = {"value": v, "ts": now}
+    try:
+        with atomic_write(path) as f:
+            json.dump(payload, f, indent=2)
+    except OSError as e:
+        log.warning("could not persist calibration to %s (%s)", path, e)
+
+
+def calibration_path() -> str | None:
+    """The configured calibration file (TSE1M_ROUTER_CAL env or INI
+    ``router_cal_path``); None = in-memory only."""
+    env = os.environ.get("TSE1M_ROUTER_CAL")
+    if env is not None:
+        return env or None
+    try:
+        from ..config import load_config
+
+        return load_config().router_cal_path
+    except Exception:  # graftlint: disable=broad-except -- calibration is an optimization; a broken INI must not take down the pipeline
+        return None
+
+
+__all__ = ["SCHEMA_VERSION", "calibration_path", "load_calibration",
+           "ttl_s", "update_calibration"]
