@@ -577,8 +577,14 @@ def main() -> int:
         # above still matched (the quarantined rows recomputed).
         from tse1m_tpu.cluster.store import SignatureStore
 
-        warm_stats.update(SignatureStore.open_existing(args.sig_store)
-                          .scrub())
+        store = SignatureStore.open_existing(args.sig_store)
+        warm_stats.update(store.scrub())
+        # Past-the-frame check (`store_scrub_verify_*`): sampled raw-row
+        # recompute of stored signatures — the CRC frame only proves the
+        # bytes have not rotted SINCE framing; corruption that predates
+        # the frame is inherited as "correct" and only this catches it.
+        warm_stats.update(store.verify_signatures(items, sample=256,
+                                                  seed=args.seed))
 
     ari = adjusted_rand_index(labels, truth)
     ari_host = None

@@ -85,6 +85,78 @@ def run_store(args) -> int:
     return 0
 
 
+def run_pod(args) -> int:
+    """Pod-supervised store-enabled clustering (cli.run_pod_cluster):
+    under TSE1M_COORDINATOR/…_NUM_PROCESSES each spawned process brings
+    up jax.distributed, shards the signature store by digest range,
+    beats heartbeats and supervises its peers — the production pod path,
+    end to end.  The chaos/CI drivers SIGKILL or wedge (``hostloss``
+    fault kind) one worker mid-run and assert the survivor fails over:
+    labels land in ``--out`` (.npy), run info in ``--info``, and manifest
+    fragments + the merged manifest under ``--result-dir``."""
+    import json
+    import os
+
+    # Platform pin must precede the first backend touch (the image's
+    # sitecustomize may pin a TPU plugin — same dance as
+    # tests/test_multihost_multiprocess.py's worker).
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from tse1m_tpu.parallel import multihost
+
+    distributed = multihost.initialize_from_env()
+    from tse1m_tpu.cli import run_pod_cluster
+    from tse1m_tpu.cluster import ClusterParams
+    from tse1m_tpu.cluster.pipeline import last_run_info
+    from tse1m_tpu.data.synth import synth_session_sets
+    from tse1m_tpu.observability.merge import (fragment_manifest_path,
+                                               merge_run_manifests)
+    from tse1m_tpu.resilience import StepRunner
+
+    items = synth_session_sets(args.n, set_size=16, seed=args.seed)[0]
+    params = ClusterParams(n_hashes=32, n_bands=4, use_pallas="never",
+                           sig_store=args.store_dir)
+    nproc = jax.process_count() if distributed else 1
+    pid = jax.process_index() if distributed else 0
+    if args.result_dir and nproc > 1:
+        manifest_path = fragment_manifest_path(args.result_dir, pid)
+    elif args.result_dir:
+        manifest_path = os.path.join(args.result_dir, "run_manifest.json")
+    else:
+        manifest_path = None
+    runner = StepRunner(manifest_path)
+    box = {}
+
+    def step() -> dict:
+        labels, pod = run_pod_cluster(items, args.n, params)
+        box["labels"] = labels
+        return {**pod, **{k: v for k, v in last_run_info.items()
+                          if k != "stages"}}
+
+    rec = runner.run("pod-cluster", step)
+    if args.result_dir and nproc > 1:
+        survivor = (rec.result or {}).get("pod_survivor")
+        if pid == 0 or survivor == pid:
+            from tse1m_tpu.cli import _await_fragments
+
+            _await_fragments(args.result_dir, nproc)
+            merge_run_manifests(args.result_dir, nproc)
+    from tse1m_tpu.resilience.coordinator import hard_exit_if_host_lost
+
+    if rec.status != "ok":
+        return hard_exit_if_host_lost(1)
+    np.save(args.out, box["labels"])
+    if args.info:
+        with open(args.info, "w") as f:
+            json.dump(rec.result, f)
+    print("POD_OK", pid, flush=True)
+    return hard_exit_if_host_lost(0)
+
+
 def run_compact(args) -> int:
     """Fold a store's shards (SignatureStore.compact).  The chaos test
     SIGKILLs this at ``store.compact.save`` — compacted temps written,
@@ -125,6 +197,15 @@ def main(argv=None) -> int:
     p.add_argument("--seed", type=int, default=13)
     p.add_argument("--info", default=None)
     p.set_defaults(fn=run_store)
+
+    p = sub.add_parser("pod")
+    p.add_argument("--store-dir", required=True)
+    p.add_argument("--out", required=True)
+    p.add_argument("--n", type=int, default=800)
+    p.add_argument("--seed", type=int, default=13)
+    p.add_argument("--info", default=None)
+    p.add_argument("--result-dir", default=None)
+    p.set_defaults(fn=run_pod)
 
     p = sub.add_parser("compact")
     p.add_argument("--store-dir", required=True)

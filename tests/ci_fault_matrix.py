@@ -1,7 +1,8 @@
 """CI fault-plan matrix driver: one injected failure class over the 2k
 bench smoke, asserting the degradation contract end to end.
 
-Usage: ``python tests/ci_fault_matrix.py {stall|oom|kill|corrupt-shard}``
+Usage: ``python tests/ci_fault_matrix.py
+{stall|oom|kill|corrupt-shard|hostloss|heartbeat-timeout}``
 
 Each seat runs ``bench.py`` (2k sessions, CPU, runtime sanitizer ON,
 persistent signature store) with a fault plan injected at a production
@@ -18,6 +19,13 @@ seat, then asserts:
 The ``kill`` seat SIGKILLs the first invocation mid store-shard write and
 asserts the rerun sweeps the torn temps and recovers parity — the
 degraded evidence there is the kill itself (rc -9) plus a clean resume.
+
+The pod seats run a REAL 2-process mesh (tests/pod_harness.py):
+``hostloss`` wedges worker 1 (alive but silent — heartbeats suspended),
+``heartbeat-timeout`` SIGKILLs it; both assert the survivor fails over
+with the lost host's digest range reassigned, labels elementwise-equal
+to an uninterrupted run, and the loss counted in the merged
+run_manifest.json.
 """
 
 from __future__ import annotations
@@ -120,8 +128,70 @@ def seat_corrupt_shard(store: str) -> dict:
     return r
 
 
+def _pod_loss_seat(plan: dict, expect_rc1: tuple) -> dict:
+    """Shared body of the two pod-scale seats: a REAL 2-process mesh run
+    (tests/pod_harness.py -> chaos_drivers ``pod``) with the given fault
+    plan installed in worker 1, asserting the MapReduce failover
+    contract — the survivor's labels equal an uninterrupted run
+    ELEMENTWISE, the lost host's digest range was reassigned, and the
+    merged run_manifest.json counts the loss."""
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    import numpy as np
+    from pod_harness import cold_labels, spawn_pod
+
+    with tempfile.TemporaryDirectory() as tmp:
+        cold = cold_labels(tmp, n=800, seed=13)
+        store = os.path.join(tmp, "store")
+        rdir = os.path.join(tmp, "results")
+        res = spawn_pod(tmp, store, rdir, n=800, seed=13, plans={1: plan})
+        assert res[1]["rc"] in expect_rc1, (
+            f"worker 1 rc={res[1]['rc']}, wanted one of {expect_rc1}\n"
+            + res[1]["err"][-2000:])
+        assert res[0]["rc"] == 0, res[0]["err"][-4000:]
+        assert np.array_equal(res[0]["labels"], cold), \
+            "failover labels diverged from the uninterrupted run"
+        info = res[0]["info"]
+        assert info["pod_survivor"] == 0 and info["pod_lost"] == [1], info
+        assert 1 in info["pod_reassigned_ranges"], info
+        merged = json.load(open(os.path.join(rdir, "run_manifest.json")))
+        counts = merged["degradation_counts"]
+        for kind in ("host_lost", "pod_failover",
+                     "shard_range_reassigned"):
+            assert counts.get(kind, 0) >= 1, (kind, counts)
+        assert merged["pod"]["missing"] == [1], merged["pod"]
+        return {"ari_vs_planted": 1.0,
+                "degradation_events": sum(counts.values()),
+                "degradation_counts": counts, "chunk_halvings": 0,
+                "store_scrub_corrupt": 0, "store_scrub_quarantined": 0}
+
+
+def seat_hostloss(store: str) -> dict:
+    """A WEDGED host: alive but silent (the ``hostloss`` fault kind
+    suspends its pod heartbeats then sleeps at pipeline.h2d).  Peers
+    declare it lost through the production heartbeat monitor; the
+    harness SIGKILLs the zombie afterwards — the fencing a real
+    scheduler provides."""
+    from pod_harness import SIGKILL, WEDGE_WORKER_PLAN
+
+    # The zombie dies one of two ways, both fencing: the harness's
+    # SIGKILL, or SIGABRT from its own XLA client once the exited
+    # leader's coordination service socket closes.
+    return _pod_loss_seat(WEDGE_WORKER_PLAN,
+                          expect_rc1=(SIGKILL, -signal.SIGABRT))
+
+
+def seat_heartbeat_timeout(store: str) -> dict:
+    """A DEAD host: SIGKILL mid-MinHash; its heartbeat file stops
+    advancing and the peer monitor times it out — the same detection
+    path as hostloss, reached through actual process death."""
+    from pod_harness import KILL_WORKER_PLAN, SIGKILL
+
+    return _pod_loss_seat(KILL_WORKER_PLAN, expect_rc1=(SIGKILL,))
+
+
 SEATS = {"stall": seat_stall, "oom": seat_oom, "kill": seat_kill,
-         "corrupt-shard": seat_corrupt_shard}
+         "corrupt-shard": seat_corrupt_shard, "hostloss": seat_hostloss,
+         "heartbeat-timeout": seat_heartbeat_timeout}
 
 
 def main() -> int:

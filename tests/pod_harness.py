@@ -1,0 +1,164 @@
+"""Shared harness for the 2-process pod runs: the slow chaos test
+(tests/test_pod_chaos.py) and the CI fault-matrix ``hostloss`` /
+``heartbeat-timeout`` seats (tests/ci_fault_matrix.py) spawn the same
+production driver (tests/chaos_drivers.py ``pod``) through here.
+
+Every run is real: two worker processes bring up `jax.distributed` over
+a local coordinator, shard the signature store by digest range, beat
+heartbeats, exchange novel tails over the shared store root (the pod
+data plane — no cross-process XLA executable, which the CPU backend
+cannot run at all), and either finish together or lose a worker to an
+injected fault and fail over."""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DRIVER = os.path.join(REPO, "tests", "chaos_drivers.py")
+
+# Pod chaos timing: fast beats so a loss is declared in seconds, with
+# enough slack that single-core CI noise (compiles hold the box busy)
+# cannot fake one.
+POD_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "TSE1M_HEARTBEAT_INTERVAL_S": "0.2",
+    "TSE1M_HEARTBEAT_TIMEOUT_S": "5",
+    "TSE1M_WATCHDOG": "0",  # the pod plane under test, not the stage one
+}
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _worker_env(base: dict, port: int, pid: int, nproc: int,
+                plan: dict | None, tmp: str) -> dict:
+    env = dict(base)
+    env.update(POD_ENV)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["PYTHONPATH"] = REPO + (os.pathsep + env["PYTHONPATH"]
+                                if env.get("PYTHONPATH") else "")
+    env.update({"TSE1M_COORDINATOR": f"127.0.0.1:{port}",
+                "TSE1M_NUM_PROCESSES": str(nproc),
+                "TSE1M_PROCESS_ID": str(pid)})
+    env.pop("TSE1M_FAULT_PLAN", None)
+    if plan is not None:
+        plan_path = os.path.join(tmp, f"plan_p{pid}.json")
+        with open(plan_path, "w") as f:
+            json.dump(plan, f)
+        env["TSE1M_FAULT_PLAN"] = plan_path
+    return env
+
+
+def spawn_pod(tmp: str, store: str, result_dir: str, n: int = 800,
+              seed: int = 13, plans: dict | None = None,
+              timeout: int = 480) -> dict:
+    """Run one 2-process pod clustering; returns per-pid
+    {rc, out, err, labels, info}.  ``plans`` maps pid -> fault plan dict
+    (installed only in that worker).  A worker still alive after the
+    others exit (a wedged ``hostloss`` host) is SIGKILLed — the fencing
+    a real scheduler provides."""
+    port = free_port()
+    plans = plans or {}
+    procs, outs, infos = [], [], []
+    for pid in range(2):
+        out = os.path.join(tmp, f"labels_p{pid}.npy")
+        info = os.path.join(tmp, f"info_p{pid}.json")
+        outs.append(out)
+        infos.append(info)
+        env = _worker_env(dict(os.environ), port, pid, 2,
+                          plans.get(pid), tmp)
+        procs.append(subprocess.Popen(
+            [sys.executable, DRIVER, "pod", "--store-dir", store,
+             "--out", out, "--info", info, "--n", str(n),
+             "--seed", str(seed), "--result-dir", result_dir],
+            cwd=REPO, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True))
+    results: dict[int, dict] = {}
+    # Reap process 0 first: in the loss scenarios it is the survivor and
+    # the wedged peer never exits on its own.
+    for pid in (0, 1):
+        p = procs[pid]
+        try:
+            out_s, err_s = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out_s, err_s = p.communicate()
+        results[pid] = {"rc": p.returncode, "out": out_s, "err": err_s}
+        timeout = 30  # the rest either already exited or are wedged
+    import numpy as np
+
+    for pid in (0, 1):
+        r = results[pid]
+        r["labels"] = (np.load(outs[pid])
+                       if os.path.exists(outs[pid]) else None)
+        r["info"] = (json.load(open(infos[pid]))
+                     if os.path.exists(infos[pid]) else None)
+    return results
+
+
+def run_single_pod(tmp: str, store: str, n: int = 800, seed: int = 13,
+                   result_dir: str | None = None,
+                   timeout: int = 300) -> dict:
+    """One single-process pod run (the resumed-after-host-loss shape)."""
+    out = os.path.join(tmp, "labels_single.npy")
+    info = os.path.join(tmp, "info_single.json")
+    env = dict(os.environ)
+    env.update(POD_ENV)
+    env["PYTHONPATH"] = REPO + (os.pathsep + env["PYTHONPATH"]
+                                if env.get("PYTHONPATH") else "")
+    for k in ("TSE1M_COORDINATOR", "TSE1M_NUM_PROCESSES",
+              "TSE1M_PROCESS_ID", "TSE1M_FAULT_PLAN", "XLA_FLAGS"):
+        env.pop(k, None)
+    cmd = [sys.executable, DRIVER, "pod", "--store-dir", store,
+           "--out", out, "--info", info, "--n", str(n),
+           "--seed", str(seed)]
+    if result_dir:
+        cmd += ["--result-dir", result_dir]
+    proc = subprocess.run(cmd, cwd=REPO, env=env, capture_output=True,
+                          text=True, timeout=timeout)
+    import numpy as np
+
+    return {"rc": proc.returncode, "out": proc.stdout, "err": proc.stderr,
+            "labels": np.load(out) if os.path.exists(out) else None,
+            "info": json.load(open(info)) if os.path.exists(info)
+            else None}
+
+
+def cold_labels(tmp: str, n: int = 800, seed: int = 13,
+                timeout: int = 300):
+    """The uninterrupted-run oracle: a plain storeless single-process
+    run of the same deterministic corpus under the same ClusterParams
+    the pod driver uses."""
+    out = os.path.join(tmp, "labels_cold.npy")
+    env = dict(os.environ)
+    env.update(POD_ENV)
+    env["PYTHONPATH"] = REPO + (os.pathsep + env["PYTHONPATH"]
+                                if env.get("PYTHONPATH") else "")
+    for k in ("TSE1M_COORDINATOR", "TSE1M_NUM_PROCESSES",
+              "TSE1M_PROCESS_ID", "TSE1M_FAULT_PLAN", "XLA_FLAGS"):
+        env.pop(k, None)
+    ckpt = tempfile.mkdtemp(dir=tmp, prefix="cold_ckpt_")
+    proc = subprocess.run(
+        [sys.executable, DRIVER, "cluster", "--dir", ckpt, "--out", out,
+         "--n", str(n), "--seed", str(seed)],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=timeout)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    import numpy as np
+
+    return np.load(out)
+
+
+KILL_WORKER_PLAN = {"rules": [{"site": "pipeline.h2d", "kind": "kill"}]}
+WEDGE_WORKER_PLAN = {"rules": [{"site": "pipeline.h2d",
+                                "kind": "hostloss", "stall_s": 300}]}
+SIGKILL = -signal.SIGKILL

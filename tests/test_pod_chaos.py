@@ -1,0 +1,128 @@
+"""Pod-scale chaos: REAL 2-process runs through the production pod path
+(tests/chaos_drivers.py ``pod`` via tests/pod_harness.py) — two workers
+bring up `jax.distributed`, shard the signature store by digest range,
+beat heartbeats, and exchange novel tails over the shared store root.
+
+The headline assertion is the MapReduce-style failover contract: SIGKILL
+one worker mid-run and the surviving coordinator must re-execute the lost
+host's partition with its digest range reassigned, producing labels
+ELEMENTWISE-EQUAL to an uninterrupted run — and the merged
+run_manifest.json must say exactly what happened."""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+
+import numpy as np
+import pytest
+
+from pod_harness import (KILL_WORKER_PLAN, cold_labels, run_single_pod,
+                         spawn_pod)
+
+N, SEED = 800, 13
+
+
+@pytest.fixture(scope="module")
+def cold(tmp_path_factory):
+    tmp = str(tmp_path_factory.mktemp("pod_cold"))
+    return cold_labels(tmp, n=N, seed=SEED)
+
+
+@pytest.mark.slow
+def test_two_process_pod_clean_then_warm(tmp_path, cold):
+    """Clean pod run == cold labels on both processes; a second run
+    against the same sharded store is warm (hit rate >= the
+    single-process warm value on the same corpus — the acceptance bar
+    for '--sig-store is no longer dropped under a mesh')."""
+    tmp = str(tmp_path)
+    store = os.path.join(tmp, "store")
+    r1 = spawn_pod(tmp, store, os.path.join(tmp, "r1"), n=N, seed=SEED)
+    for pid in (0, 1):
+        assert r1[pid]["rc"] == 0, r1[pid]["err"][-3000:]
+        np.testing.assert_array_equal(r1[pid]["labels"], cold)
+    assert r1[0]["info"]["cache_hit_rate"] == 0.0
+    assert r1[0]["info"]["pod_processes"] == 2
+    assert sorted(r1[0]["info"]["pod_owned_ranges"]
+                  + r1[1]["info"]["pod_owned_ranges"]) == [0, 1]
+
+    # warm re-run over the same corpus: every row is cached pod-wide
+    r2 = spawn_pod(tmp, store, os.path.join(tmp, "r2"), n=N, seed=SEED)
+    for pid in (0, 1):
+        assert r2[pid]["rc"] == 0, r2[pid]["err"][-3000:]
+        np.testing.assert_array_equal(r2[pid]["labels"], cold)
+    pod_hit = r2[0]["info"]["cache_hit_rate"]
+
+    # single-process warm baseline on an isolated store, same corpus
+    tmp_s = os.path.join(tmp, "single")
+    os.makedirs(tmp_s)
+    store_s = os.path.join(tmp_s, "store")
+    run_single_pod(tmp_s, store_s, n=N, seed=SEED)
+    s2 = run_single_pod(tmp_s, store_s, n=N, seed=SEED)
+    assert s2["rc"] == 0, s2["err"][-3000:]
+    assert pod_hit >= s2["info"]["cache_hit_rate"], (
+        f"pod warm hit rate {pod_hit} fell below single-process "
+        f"{s2['info']['cache_hit_rate']}")
+
+    # merged manifest: both fragments folded, pod-wide ok
+    m = json.load(open(os.path.join(tmp, "r2", "run_manifest.json")))
+    assert m["ok"] is True
+    assert m["pod"] == {"n_processes": 2, "merged_from": [0, 1],
+                        "missing": []}
+    assert {s["process"] for s in m["steps"]} == {0, 1}
+
+
+@pytest.mark.slow
+def test_sigkill_worker_failover_labels_match_uninterrupted(tmp_path,
+                                                            cold):
+    """SIGKILL worker 1 mid-MinHash: its heartbeats stop, process 0
+    declares it lost, reassigns its digest range, re-executes solo, and
+    the labels equal the uninterrupted run elementwise."""
+    tmp = str(tmp_path)
+    store = os.path.join(tmp, "store")
+    rdir = os.path.join(tmp, "r")
+    res = spawn_pod(tmp, store, rdir, n=N, seed=SEED,
+                    plans={1: KILL_WORKER_PLAN})
+    assert res[1]["rc"] == -signal.SIGKILL, res[1]["rc"]
+    assert res[0]["rc"] == 0, res[0]["err"][-4000:]
+    np.testing.assert_array_equal(res[0]["labels"], cold)
+    info = res[0]["info"]
+    assert info["pod_survivor"] == 0 and info["pod_lost"] == [1]
+    assert 1 in info["pod_reassigned_ranges"]
+    # merged manifest: the loss, the reassignment and the failover are
+    # all countable, and the dead host's fragment is recorded missing
+    m = json.load(open(os.path.join(rdir, "run_manifest.json")))
+    assert m["pod"]["missing"] == [1]
+    for kind in ("host_lost", "pod_failover", "shard_range_reassigned"):
+        assert m["degradation_counts"].get(kind, 0) >= 1, (kind, m)
+
+    # the survivor's store is whole: a fresh single-process run against
+    # it inherits both ranges and stays label-identical, fully warm
+    r2 = run_single_pod(tmp, store, n=N, seed=SEED)
+    assert r2["rc"] == 0, r2["err"][-3000:]
+    np.testing.assert_array_equal(r2["labels"], cold)
+    assert r2["info"]["cache_hit_rate"] == 1.0
+
+
+@pytest.mark.slow
+def test_leader_death_fences_pod_and_respawn_recovers(tmp_path, cold):
+    """Process 0 hosts the XLA coordination service: its death fences
+    EVERY worker within seconds (the client's error-poll fatal — no
+    heartbeat can outrun a closed socket), so in-process failover is a
+    worker-loss tool only.  The recovery contract is the scheduler's
+    respawn: a fresh run against the same sharded root inherits every
+    digest range and produces labels elementwise-equal to an
+    uninterrupted run."""
+    tmp = str(tmp_path)
+    store = os.path.join(tmp, "store")
+    res = spawn_pod(tmp, store, os.path.join(tmp, "r"), n=N, seed=SEED,
+                    plans={0: KILL_WORKER_PLAN})
+    assert res[0]["rc"] == -signal.SIGKILL
+    assert res[1]["rc"] != 0, "worker 1 must not report success after " \
+                              "losing the coordination service"
+    # scheduler respawn: single process, same (now partial) store root
+    r = run_single_pod(tmp, store, n=N, seed=SEED)
+    assert r["rc"] == 0, r["err"][-3000:]
+    np.testing.assert_array_equal(r["labels"], cold)
+    assert r["info"]["pod_n_ranges"] == 2  # sharded topology inherited

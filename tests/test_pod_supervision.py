@@ -1,0 +1,404 @@
+"""Pod-scale supervision units (resilience/coordinator.py,
+observability/merge.py, cluster/store.ShardedSignatureStore and the pod
+routing seams) — everything here is in-process and fast; the real
+2-process runs live in tests/test_pod_chaos.py (slow) and the CI
+fault-matrix ``hostloss`` / ``heartbeat-timeout`` seats."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tse1m_tpu.cluster.store import (ShardedSignatureStore, SignatureStore,
+                                     digest_range_ids, file_crc,
+                                     row_digests)
+from tse1m_tpu.observability.merge import (fragment_manifest_path,
+                                           merge_run_manifests,
+                                           sweep_stale_fragments)
+from tse1m_tpu.resilience.coordinator import (HeartbeatWriter,
+                                              HostLostError, PeerMonitor,
+                                              PodSupervisor, exchange_dir,
+                                              heartbeat_path,
+                                              negotiate_run_nonce,
+                                              resume_heartbeats,
+                                              suspend_heartbeats)
+
+POLICY = {"n_hashes": 32, "seed": 13, "quant_bits": 0}
+
+
+# -- heartbeats / peer monitor ----------------------------------------------
+
+
+def test_heartbeat_writer_beats_monotonic_seq(tmp_path):
+    w = HeartbeatWriter(str(tmp_path), 3, interval_s=0.05)
+    assert w.beat_once() == 1
+    assert w.beat_once() == 2
+    with open(heartbeat_path(str(tmp_path), 3)) as f:
+        d = json.load(f)
+    assert d["seq"] == 2 and d["process_id"] == 3 and d["run"]
+
+
+def test_monitor_declares_silent_peer_lost_and_latches(tmp_path):
+    w = HeartbeatWriter(str(tmp_path), 1, interval_s=0.05)
+    w.beat_once()
+    mon = PeerMonitor(str(tmp_path), n_processes=2, process_id=0,
+                      timeout_s=0.3)
+    assert mon.poll() == []  # beat observed, grace running
+    time.sleep(0.45)
+    assert mon.poll() == [1]
+    with pytest.raises(HostLostError) as ei:
+        mon.check(site="unit")
+    assert "declared lost" in str(ei.value) and ei.value.lost == [1]
+    # Latched: a resumed beat cannot readmit the host this run — its
+    # digest range was already reassigned.
+    w.beat_once()
+    w.beat_once()
+    assert mon.poll() == [1]
+
+
+def test_monitor_live_peer_never_declared(tmp_path):
+    w = HeartbeatWriter(str(tmp_path), 1, interval_s=0.05).start()
+    try:
+        mon = PeerMonitor(str(tmp_path), n_processes=2, process_id=0,
+                          timeout_s=0.4)
+        for _ in range(4):
+            time.sleep(0.15)
+            assert mon.poll() == []
+    finally:
+        w.stop()
+
+
+def test_monitor_run_nonce_change_counts_as_advance(tmp_path):
+    # A restarted peer begins a fresh run at seq 1; the LOWER seq with a
+    # new nonce must still read as alive.
+    HeartbeatWriter(str(tmp_path), 1).beat_once()
+    mon = PeerMonitor(str(tmp_path), n_processes=2, process_id=0,
+                      timeout_s=0.3)
+    mon.poll()
+    time.sleep(0.2)
+    HeartbeatWriter(str(tmp_path), 1).beat_once()  # fresh nonce, seq 1
+    time.sleep(0.2)
+    assert mon.poll() == []  # nonce change reset the grace window
+
+
+def test_suspend_heartbeats_silences_writer(tmp_path):
+    w = HeartbeatWriter(str(tmp_path), 0, interval_s=0.02).start()
+    try:
+        suspend_heartbeats()
+        time.sleep(0.1)
+        with open(heartbeat_path(str(tmp_path), 0)) as f:
+            seq_frozen = json.load(f)["seq"]
+        time.sleep(0.15)
+        with open(heartbeat_path(str(tmp_path), 0)) as f:
+            assert json.load(f)["seq"] == seq_frozen
+    finally:
+        resume_heartbeats()
+        w.stop()
+
+
+def test_supervisor_guarded_raises_on_lost_peer(tmp_path):
+    sup = PodSupervisor(str(tmp_path), n_processes=2, process_id=0,
+                        interval_s=0.05, timeout_s=0.3)
+    # Peer 1 never beats: a phase that blocks forever must turn into
+    # HostLostError within ~one timeout, not hang.
+    hang = threading.Event()
+    t0 = time.monotonic()
+    with pytest.raises(HostLostError):
+        sup.guarded(hang.wait, site="unit.hang")
+    assert time.monotonic() - t0 < 5.0
+    hang.set()
+    assert sup.survivors() == [0]
+
+
+def test_supervisor_guarded_passes_result_through(tmp_path):
+    sup = PodSupervisor(str(tmp_path), n_processes=1, process_id=0)
+    assert sup.guarded(lambda: 41 + 1, site="unit.ok") == 42
+
+
+# -- run nonce / exchange dir -----------------------------------------------
+
+
+def test_negotiate_run_nonce_single_process_is_local(tmp_path):
+    a = negotiate_run_nonce(None)
+    b = negotiate_run_nonce(
+        PodSupervisor(str(tmp_path), n_processes=1, process_id=0))
+    assert a != b and len(a) == 16
+    int(a, 16)  # hex
+
+
+def test_exchange_dir_sweeps_stale_runs(tmp_path):
+    pod = str(tmp_path)
+    old = exchange_dir(pod, "deadbeef00000000")
+    open(os.path.join(old, "novel.p000.npz"), "wb").close()
+    new = exchange_dir(pod, "feedface00000000", sweep_stale=True)
+    assert os.path.isdir(new) and not os.path.exists(old)
+    # sweeping again with the same nonce keeps the current dir
+    assert exchange_dir(pod, "feedface00000000", sweep_stale=True) == new
+    assert os.path.isdir(new)
+
+
+def test_fs_exchange_single_process_roundtrip(tmp_path):
+    from tse1m_tpu.parallel.multihost import fs_exchange
+
+    payload = {"digests": np.arange(6, dtype=np.uint64).reshape(3, 2),
+               "miss": np.array([True, False, True])}
+    out = fs_exchange(str(tmp_path), "novel", payload)
+    assert len(out) == 1
+    np.testing.assert_array_equal(out[0]["digests"], payload["digests"])
+    np.testing.assert_array_equal(out[0]["miss"], payload["miss"])
+    assert os.path.exists(os.path.join(str(tmp_path), "novel.p000.npz"))
+
+
+# -- manifest merge ----------------------------------------------------------
+
+
+def _fragment(ok: bool, counts: dict, steps: list) -> dict:
+    return {"ok": ok, "degradation_counts": counts, "steps": steps,
+            "summary": {"ok": len(steps)}, "started_at": "t",
+            "wall_seconds": 1.5}
+
+
+def test_merge_run_manifests_sums_counts_and_tags_steps(tmp_path):
+    d = str(tmp_path)
+    for pid, counts in ((0, {"chunk_halving": 1}),
+                        (1, {"chunk_halving": 2, "host_lost": 1})):
+        with open(fragment_manifest_path(d, pid), "w") as f:
+            json.dump(_fragment(True, counts,
+                                [{"step": "cluster", "status": "ok"}]), f)
+    merged = merge_run_manifests(d, 2)
+    assert merged["ok"] is True
+    assert merged["degradation_counts"] == {"chunk_halving": 3,
+                                            "host_lost": 1}
+    assert [s["process"] for s in merged["steps"]] == [0, 1]
+    assert merged["pod"] == {"n_processes": 2, "merged_from": [0, 1],
+                             "missing": []}
+    on_disk = json.load(open(os.path.join(d, "run_manifest.json")))
+    assert on_disk["degradation_counts"] == merged["degradation_counts"]
+
+
+def test_merge_records_missing_fragment_and_fails_ok(tmp_path):
+    d = str(tmp_path)
+    with open(fragment_manifest_path(d, 0), "w") as f:
+        json.dump(_fragment(True, {}, [{"step": "cluster",
+                                        "status": "ok"}]), f)
+    merged = merge_run_manifests(d, 2)  # fragment 1 never written
+    assert merged["ok"] is False
+    assert merged["pod"]["missing"] == [1]
+
+
+def test_merge_any_failed_fragment_fails_pod_ok(tmp_path):
+    d = str(tmp_path)
+    for pid, ok in ((0, True), (1, False)):
+        with open(fragment_manifest_path(d, pid), "w") as f:
+            json.dump(_fragment(ok, {}, []), f)
+    assert merge_run_manifests(d, 2)["ok"] is False
+
+
+def test_sweep_stale_fragments(tmp_path):
+    d = str(tmp_path)
+    for pid in (0, 1, 2):
+        open(fragment_manifest_path(d, pid), "w").write("{}")
+    assert sweep_stale_fragments(d) == 3
+    assert not os.path.exists(fragment_manifest_path(d, 0))
+
+
+# -- digest-range sharding ---------------------------------------------------
+
+
+def _items(n: int, seed: int = 0) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    return rng.integers(1, 2**20, size=(n, 16), dtype=np.uint32)
+
+
+def test_digest_range_ids_deterministic_and_in_range():
+    d = row_digests(_items(500))
+    rid = digest_range_ids(d, 4)
+    assert rid.shape == (500,) and rid.min() >= 0 and rid.max() < 4
+    np.testing.assert_array_equal(rid, digest_range_ids(d, 4))
+    # roughly uniform under the multilinear hash (no empty range at N=500)
+    assert len(np.unique(rid)) == 4
+
+
+def test_sharded_store_refuses_single_host_root(tmp_path):
+    single = os.path.join(str(tmp_path), "single")
+    SignatureStore(single, POLICY)
+    with pytest.raises(ValueError) as ei:
+        ShardedSignatureStore(single, POLICY, n_processes=2, process_id=0)
+    assert "--sig-store" in str(ei.value)
+    assert "single-host store" in str(ei.value)
+
+
+def test_sharded_store_refuses_policy_mismatch(tmp_path):
+    root = os.path.join(str(tmp_path), "pod")
+    ShardedSignatureStore(root, POLICY)
+    with pytest.raises(ValueError) as ei:
+        ShardedSignatureStore(root, {**POLICY, "seed": 99})
+    assert "policy" in str(ei.value)
+
+
+def test_sharded_store_single_writer_per_range(tmp_path):
+    root = os.path.join(str(tmp_path), "pod")
+    items = _items(200)
+    d = row_digests(items)
+    sigs = np.arange(200 * 32, dtype=np.uint32).reshape(200, 32)
+    s0 = ShardedSignatureStore(root, POLICY, n_processes=2, process_id=0)
+    s1 = ShardedSignatureStore(root, POLICY, n_processes=2, process_id=1)
+    assert s0.owned == [0] and s1.owned == [1]
+    # each process appends only its owned range's rows
+    w0 = s0.append(d, sigs)
+    w1 = s1.append(d, sigs)
+    assert w0 > 0 and w1 > 0 and w0 + w1 <= 200
+    # every process probes EVERY range (reads are global)
+    hit, loc = ShardedSignatureStore(root, POLICY, n_processes=2,
+                                     process_id=0).probe(d)
+    assert hit.all()
+    # the non-owned range is read-only: direct append refuses
+    ro = s0.range_store(1)
+    assert ro.read_only
+    with pytest.raises(RuntimeError) as ei:
+        ro.append(d[:1], sigs[:1])
+    assert "read-only" in str(ei.value)
+
+
+def test_sharded_store_gather_roundtrip_and_reassignment(tmp_path):
+    root = os.path.join(str(tmp_path), "pod")
+    items = _items(300, seed=2)
+    d = row_digests(items)
+    sigs = np.arange(300 * 32, dtype=np.uint32).reshape(300, 32)
+    for pid in (0, 1):
+        ShardedSignatureStore(root, POLICY, n_processes=2,
+                              process_id=pid).append(d, sigs)
+    # survivor shape: one process inherits every range
+    from tse1m_tpu.observability import pop_degradation_events
+
+    pop_degradation_events()
+    solo = ShardedSignatureStore(root, POLICY, n_processes=1, process_id=0)
+    assert solo.owned == [0, 1] and solo.reassigned_ranges == [1]
+    events = pop_degradation_events()
+    assert any(e["kind"] == "shard_range_reassigned" for e in events)
+    hit, loc = solo.probe(d)
+    assert hit.all()
+    np.testing.assert_array_equal(solo.load_signatures(loc), sigs)
+
+
+def test_pod_row_range_partitions_exactly():
+    from tse1m_tpu.parallel.multihost import pod_row_range
+
+    for n, nproc in ((800, 2), (801, 2), (7, 3), (2, 4)):
+        spans = [pod_row_range(n, nproc, p) for p in range(nproc)]
+        assert spans[0][0] == 0 and spans[-1][1] == n
+        for (a0, a1), (b0, b1) in zip(spans, spans[1:]):
+            assert a1 == b0
+
+
+# -- pod routing refusals ----------------------------------------------------
+
+
+def test_cluster_sessions_mesh_plus_sig_store_refuses_loudly(tmp_path):
+    """The pre-pod behavior silently DROPPED --sig-store under a mesh;
+    the API-level entry point must refuse with an error naming the flag
+    and the supported route."""
+    from tse1m_tpu.cluster import ClusterParams, cluster_sessions
+    from tse1m_tpu.parallel.mesh import make_mesh
+
+    params = ClusterParams(n_hashes=32, n_bands=4, use_pallas="never",
+                           sig_store=os.path.join(str(tmp_path), "s"))
+    with pytest.raises(ValueError) as ei:
+        cluster_sessions(_items(64), params, mesh=make_mesh())
+    msg = str(ei.value)
+    assert "--sig-store" in msg and "cluster_sessions_pod" in msg
+
+
+def test_cluster_sessions_pod_requires_store():
+    from tse1m_tpu.cluster import ClusterParams
+    from tse1m_tpu.cluster.pipeline import cluster_sessions_pod
+
+    with pytest.raises(ValueError) as ei:
+        cluster_sessions_pod(_items(8), 8, ClusterParams())
+    assert "sig_store" in str(ei.value)
+
+
+# -- scrub --verify-sigs -----------------------------------------------------
+
+
+def _populated_store(tmp_path, n=400):
+    """A store populated through the real pod path (single process)."""
+    from tse1m_tpu.cluster import ClusterParams
+    from tse1m_tpu.cluster.pipeline import cluster_sessions_pod
+    from tse1m_tpu.data.synth import synth_session_sets
+
+    items, _ = synth_session_sets(n, set_size=16, seed=13)
+    root = os.path.join(str(tmp_path), "pod_store")
+    params = ClusterParams(n_hashes=32, n_bands=4, use_pallas="never",
+                           sig_store=root)
+    cluster_sessions_pod(items, n, params)
+    return root, items
+
+
+def test_verify_sigs_clean_store_reports_ok(tmp_path):
+    root, items = _populated_store(tmp_path)
+    store = ShardedSignatureStore(root, {"n_hashes": 32, "seed": 0,
+                                         "quant_bits": 0})
+    rep = store.verify_signatures(items, sample=64, seed=0)
+    assert rep["store_scrub_verify_ok"] is True
+    assert rep["store_scrub_verify_sampled"] > 0
+    assert rep["store_scrub_verify_mismatch"] == 0
+
+
+def test_verify_sigs_catches_pre_framing_corruption(tmp_path):
+    """Flip a byte inside a committed sig shard and RESTAMP its CRC —
+    the frame now vouches for corrupt bytes (the pre-framing hole) and
+    only the sampled raw-row recompute can catch it."""
+    root, items = _populated_store(tmp_path)
+    range_dirs = [os.path.join(root, d) for d in sorted(os.listdir(root))
+                  if d.startswith("range_")]
+    corrupted = False
+    for rd in range_dirs:
+        man_path = os.path.join(rd, "store_manifest.json")
+        man = json.load(open(man_path))
+        if not man["shards"]:
+            continue
+        entry = man["shards"][0]
+        sig_path = os.path.join(rd, f"sig_{entry['id']:05d}.npy")
+        with open(sig_path, "r+b") as f:
+            f.seek(os.path.getsize(sig_path) - 4)  # inside the data tail
+            b = f.read(1)
+            f.seek(-1, os.SEEK_CUR)
+            f.write(bytes([b[0] ^ 0xFF]))
+        entry["sig_crc"] = file_crc(sig_path)  # frame inherits the rot
+        with open(man_path, "w") as f:
+            json.dump(man, f)
+        corrupted = True
+        break
+    assert corrupted, "populated store committed no shards"
+    store = ShardedSignatureStore(root, {"n_hashes": 32, "seed": 0,
+                                         "quant_bits": 0})
+    rep = store.verify_signatures(items, sample=10_000, seed=0)
+    assert rep["store_scrub_verify_ok"] is False
+    assert rep["store_scrub_verify_mismatch"] >= 1
+    assert rep["store_scrub_verify_quarantined"] >= 1
+    # quarantined rows now probe as misses -> they recompute next run
+    hit, _ = store.probe(row_digests(np.ascontiguousarray(
+        items, dtype=np.uint32)))
+    assert not hit.all()
+
+
+def test_cli_scrub_verify_sigs_keys(tmp_path, capsys, monkeypatch):
+    from tse1m_tpu.cli import main
+
+    root, _ = _populated_store(tmp_path)
+    monkeypatch.setenv("TSE1M_RESULT_DIR",
+                       os.path.join(str(tmp_path), "res"))
+    rc = main(["scrub", root, "--verify-sigs", "--verify-n", "400",
+               "--verify-seed", "13", "--verify-set-size", "16",
+               "--verify-sample", "64"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["store_scrub_verify_sampled"] > 0
+    assert out["store_scrub_verify_ok"] is True
+    assert out["store_scrub_ranges"] >= 1

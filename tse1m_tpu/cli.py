@@ -327,6 +327,78 @@ def _cmd_collect(args) -> int:
     return 0
 
 
+def run_pod_cluster(items, n: int, params):
+    """Pod-supervised store-enabled clustering (the `--sig-store`-under-
+    a-mesh path), shared by ``cli cluster`` and the chaos/CI drivers.
+
+    Starts this process's heartbeat writer + peer monitor
+    (resilience/coordinator.py, beating under ``<sig_store>/pod/``),
+    feeds this process's local row slice through
+    ``cluster_sessions_pod``, and supervises every cross-host phase: a
+    peer whose heartbeat stops is declared lost, and the lowest-id
+    survivor FAILS OVER — it re-executes the whole partition solo on its
+    local devices with the lost hosts' digest ranges reassigned
+    (``shard_range_reassigned`` events) — while every other survivor
+    exits loudly.
+
+    In-process failover covers lost WORKERS only.  Process 0 hosts the
+    XLA coordination service, and its client fatals every survivor
+    within ~1 s of the leader's socket closing — faster than any
+    heartbeat can observe — so a lost leader fences the whole pod and
+    recovery is the scheduler's respawn: a fresh run against the same
+    sharded store root inherits every digest range and recomputes
+    whatever the dead pod never appended (probe-as-miss), yielding the
+    exact labels an uninterrupted run would have (the leader-death chaos
+    test pins this).
+
+    Returns ``(labels, pod_report)``; ``pod_report`` carries the
+    survivor/lost accounting for the merged manifest."""
+    import numpy as np
+
+    import jax
+
+    from .cluster.pipeline import cluster_sessions_pod
+    from .observability import record_degradation
+    from .parallel import multihost
+    from .resilience.coordinator import (HostLostError, PodSupervisor,
+                                         exchange_dir, negotiate_run_nonce)
+
+    nproc = jax.process_count()
+    pid = jax.process_index()
+    items = np.ascontiguousarray(items, dtype=np.uint32)
+    pod: dict = {"pod_process_id": pid}
+    if nproc == 1:
+        labels = cluster_sessions_pod(items, n, params)
+        return labels, pod
+    sup = PodSupervisor(os.path.join(params.sig_store, "pod"),
+                        nproc, pid).start()
+    try:
+        try:
+            nonce = negotiate_run_nonce(sup)
+            xch = exchange_dir(os.path.join(params.sig_store, "pod"),
+                               nonce, sweep_stale=pid == 0)
+            lo, hi = multihost.pod_row_range(n, nproc, pid)
+            labels = cluster_sessions_pod(items[lo:hi], n, params,
+                                          supervisor=sup,
+                                          exchange_dir=xch)
+            return labels, pod
+        except HostLostError as e:
+            survivors = sup.survivors()
+            if not survivors or pid != min(survivors):
+                raise  # one process fails over; the rest exit loudly
+            record_degradation("pod_failover", site="cli.cluster",
+                               detail={"lost": e.lost, "survivor": pid})
+            log.warning(
+                "pod: host(s) %s lost at %s; process %d failing over — "
+                "re-executing solo with their digest ranges reassigned",
+                e.lost, e.site, pid)
+            labels = cluster_sessions_pod(items, n, params, solo=True)
+            pod.update(pod_survivor=pid, pod_lost=e.lost)
+            return labels, pod
+    finally:
+        sup.stop()
+
+
 def _cmd_cluster(args) -> int:
     """North-star session dedup: MinHash+LSH clustering with an ARI report
     against the planted truth (and the host oracle on a subsample).
@@ -339,38 +411,103 @@ def _cmd_cluster(args) -> int:
     embeds the per-stage probe/load/h2d walls).
 
     Multi-host aware: under TSE1M_COORDINATOR/…_NUM_PROCESSES (see
-    parallel/multihost.py) the mesh spans every host's devices and a
-    barrier keeps the report phase from racing slow hosts.  Note the
-    synthetic items are generated in full on every host (the planted-truth
-    permutation is global, so deterministic per-slice generation isn't
-    possible) and only this process's contiguous row slice is *fed* to the
-    devices — a real study would stream each host's slice from the DB
-    (parallel/multihost.local_row_range).  Single-process this degrades to
-    the plain local run."""
+    parallel/multihost.py) the mesh spans every host's devices; with
+    ``--sig-store`` the store shards per host by digest range
+    (run_pod_cluster — heartbeats, host-loss failover) and each process
+    records a manifest FRAGMENT that the coordinator merges into one
+    ``run_manifest.json``.  Note the synthetic items are generated in
+    full on every host (the planted-truth permutation is global, so
+    deterministic per-slice generation isn't possible) and only this
+    process's contiguous row slice is *fed* to the devices — a real
+    study would stream each host's slice from the DB
+    (parallel/multihost.local_row_range).  Single-process this degrades
+    to the plain local run."""
     import json
 
+    from .observability.merge import (fragment_manifest_path,
+                                      merge_run_manifests)
+    from .parallel import multihost
     from .resilience import StepRunner
 
     cfg = load_config()
     sig_store = args.sig_store or cfg.sig_store
-    manifest_path = os.path.join(cfg.result_dir, "run_manifest.json")
+    # Distributed bring-up must precede any backend use (and decides
+    # which manifest this process writes).
+    distributed = multihost.initialize_from_env()
+    import jax
+
+    pid = jax.process_index() if distributed else 0
+    nproc = jax.process_count() if distributed else 1
+    if nproc > 1:
+        manifest_path = fragment_manifest_path(cfg.result_dir, pid)
+        try:  # this process's stale fragment from a previous run
+            os.remove(manifest_path)
+        except OSError:
+            pass
+    else:
+        manifest_path = os.path.join(cfg.result_dir, "run_manifest.json")
     runner = StepRunner(manifest_path)
-    rec = runner.run("cluster", _run_cluster_step, args, sig_store)
+    rec = runner.run("cluster", _run_cluster_step, args, sig_store,
+                     distributed)
+    if nproc > 1:
+        survivor = (rec.result or {}).get("pod_survivor")
+        if pid == 0 or survivor == pid:
+            _await_fragments(cfg.result_dir, nproc)
+            merged = merge_run_manifests(cfg.result_dir, nproc)
+            log.info("pod manifest merged from %s (missing: %s) -> %s",
+                     merged["pod"]["merged_from"],
+                     merged["pod"]["missing"],
+                     os.path.join(cfg.result_dir, "run_manifest.json"))
     if rec.result is not None:
         print(json.dumps(rec.result))
-    return runner.exit_code()
+    from .resilience.coordinator import hard_exit_if_host_lost
+
+    # A run that declared a host lost cannot tear down jax.distributed
+    # (the Shutdown barrier needs the dead task); all state is on disk.
+    return hard_exit_if_host_lost(runner.exit_code())
 
 
-def _run_cluster_step(args, sig_store: str | None) -> dict:
+def _await_fragments(result_dir: str, nproc: int) -> None:
+    """Give slower peers one heartbeat-timeout window to land their
+    manifest fragments before merging — a dead peer's fragment is
+    recorded as missing, never waited on forever."""
+    import time as _time
+
+    from .observability.merge import fragment_manifest_path
+    from .resilience.coordinator import heartbeat_timeout_s
+    from .resilience.watchdog import deadline_clock
+
+    deadline = deadline_clock() + heartbeat_timeout_s()
+    while deadline_clock() < deadline:
+        if all(os.path.exists(fragment_manifest_path(result_dir, p))
+               for p in range(nproc)):
+            return
+        _time.sleep(0.2)
+
+
+def _run_cluster_step(args, sig_store: str | None,
+                      distributed: bool) -> dict:
     from .cluster import (ClusterParams, adjusted_rand_index,
                           cluster_sessions, host_cluster)
+    from .cluster.store import ShardedSignatureStore
     from .data.synth import synth_session_sets
     from .parallel import multihost
 
-    distributed = multihost.initialize_from_env()
     items, truth = synth_session_sets(args.n, seed=args.seed)
     params = ClusterParams(seed=args.seed, sig_store=sig_store)
-    if distributed:
+    pod_report: dict = {}
+    if sig_store and (distributed
+                      or ShardedSignatureStore.is_sharded_root(sig_store)):
+        # Pod path: per-host digest-range sharded store + supervision.
+        # (Single-process against a sharded root is the resumed-after-
+        # host-loss shape: this process inherits every range.)
+        if args.checkpoint_dir:
+            log.warning("--checkpoint-dir is ignored on the pod path: "
+                        "the sharded signature store IS the durable "
+                        "state (novel signatures append per chunk); "
+                        "this run has no chunk checkpoints")
+        labels, pod_report = run_pod_cluster(items, args.n, params)
+    elif distributed:
         import numpy as np
 
         if args.checkpoint_dir:
@@ -379,10 +516,6 @@ def _run_cluster_step(args, sig_store: str | None) -> dict:
                         "(give each process its own directory and the "
                         "resumable API if you need it); this run is NOT "
                         "checkpointed")
-        if sig_store:
-            log.warning("--sig-store is ignored under multi-host: the "
-                        "signature store is a single-host wire lever "
-                        "(mesh feeds ride local/ICI links)")
         mesh = multihost.global_mesh()
         # Feed only this process's contiguous LOGICAL slice; the padded-put
         # helper grows the tail block to the mesh multiple with zero rows
@@ -409,7 +542,9 @@ def _run_cluster_step(args, sig_store: str | None) -> dict:
 
         report["sig_store"] = sig_store
         report.update({k_: v for k_, v in last_run_info.items()
-                       if k_.startswith("cache_") or k_ == "wire_mb"})
+                       if k_.startswith(("cache_", "pod_"))
+                       or k_ == "wire_mb"})
+        report.update(pod_report)
     # Degradation-ladder telemetry (observability plane): how many times
     # the run survived by degrading.  The events themselves attach to the
     # step record (StepRunner pops them into run_manifest.json).
@@ -443,7 +578,17 @@ def _cmd_scrub(args) -> int:
     ``store_scrub_*`` key namespace, recorded in run_manifest.json like
     any step.  ``--repair`` re-frames legacy (pre-CRC) shards and sweeps
     orphans; ``--compact`` folds the append shards into one.  ``--strict``
-    exits nonzero when any corruption was found (CI gate)."""
+    exits nonzero when any corruption was found (CI gate).  A pod-sharded
+    root (pod_topology.json present) scrubs every digest range.
+
+    ``--verify-sigs`` goes past the CRC frame: sampled recompute of
+    stored signatures from raw rows (the synthetic corpus the cluster
+    command runs on; ``--verify-n/--verify-seed/--verify-set-size`` pick
+    it, ``--verify-sample`` bounds the recompute).  The frame only proves
+    the bytes have not rotted SINCE framing — corruption that happened
+    before the frame was written was inherited as "correct", and this is
+    the check that catches it (``store_scrub_verify_*`` keys; mismatching
+    shards quarantine and their rows recompute)."""
     import json
 
     from .resilience import StepRunner
@@ -458,10 +603,24 @@ def _cmd_scrub(args) -> int:
     runner = StepRunner(manifest_path)
 
     def scrub_step() -> dict:
-        from .cluster.store import SignatureStore
+        from .cluster.store import ShardedSignatureStore, SignatureStore
 
-        store = SignatureStore.open_existing(directory)
+        if ShardedSignatureStore.is_sharded_root(directory):
+            with open(os.path.join(directory, "pod_topology.json"),
+                      encoding="utf-8") as f:
+                policy = json.load(f)["policy"]
+            store = ShardedSignatureStore(directory, policy)
+        else:
+            store = SignatureStore.open_existing(directory)
         report = store.scrub(repair=args.repair, compact=args.compact)
+        if args.verify_sigs:
+            from .data.synth import synth_session_sets
+
+            items, _ = synth_session_sets(args.verify_n,
+                                          set_size=args.verify_set_size,
+                                          seed=args.verify_seed)
+            report.update(store.verify_signatures(
+                items, sample=args.verify_sample, seed=args.verify_seed))
         report["store_scrub_dir"] = directory
         return report
 
@@ -470,10 +629,12 @@ def _cmd_scrub(args) -> int:
         print(json.dumps(rec.result))
     if rec.status != "ok":
         return 1
-    if args.strict and rec.result.get("store_scrub_corrupt", 0):
-        log.error("scrub found %d corrupt shard(s) (quarantined; rows "
-                  "recompute on the next warm run)",
-                  rec.result["store_scrub_corrupt"])
+    corrupt = (rec.result.get("store_scrub_corrupt", 0)
+               + rec.result.get("store_scrub_verify_mismatch", 0))
+    if args.strict and corrupt:
+        log.error("scrub found %d corrupt/mismatching row-or-shard(s) "
+                  "(quarantined; rows recompute on the next warm run)",
+                  corrupt)
         return 1
     return 0
 
@@ -552,6 +713,17 @@ def main(argv=None) -> int:
                    help="fold the append shards into one large shard")
     p.add_argument("--strict", action="store_true",
                    help="exit nonzero when any corruption was found")
+    p.add_argument("--verify-sigs", action="store_true",
+                   help="sampled recompute of stored signatures from raw "
+                        "rows — catches pre-framing corruption the CRC "
+                        "frame inherited as 'correct' "
+                        "(store_scrub_verify_* keys)")
+    p.add_argument("--verify-n", type=int, default=2000,
+                   help="rows of the synthetic corpus to verify against")
+    p.add_argument("--verify-seed", type=int, default=0)
+    p.add_argument("--verify-set-size", type=int, default=64)
+    p.add_argument("--verify-sample", type=int, default=256,
+                   help="max sampled rows recomputed on host")
     p.set_defaults(fn=_cmd_scrub)
 
     p = sub.add_parser("cluster", help="MinHash+LSH session dedup demo")

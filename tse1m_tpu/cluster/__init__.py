@@ -17,7 +17,7 @@ from .metrics import adjusted_rand_index
 from .minhash import band_keys, make_hash_params, minhash_signatures
 from .host import host_cluster
 from .pipeline import (ClusterParams, cluster_sessions,
-                       cluster_sessions_resumable)
+                       cluster_sessions_pod, cluster_sessions_resumable)
 
 __all__ = [
     "adjusted_rand_index",
@@ -27,5 +27,6 @@ __all__ = [
     "host_cluster",
     "ClusterParams",
     "cluster_sessions",
+    "cluster_sessions_pod",
     "cluster_sessions_resumable",
 ]

@@ -765,11 +765,23 @@ def cluster_sessions(items, params: ClusterParams | None = None,
     bucket-sort stage.
     """
     params = params or ClusterParams()
+    if params.sig_store and mesh is not None:
+        # Refuse loudly rather than silently dropping the store (the
+        # pre-pod behavior): this entry point has no per-host row
+        # ownership to shard the probe by.  The pod path carries the
+        # store under a mesh.
+        raise ValueError(
+            "--sig-store (ClusterParams.sig_store) is not supported on "
+            "cluster_sessions under a mesh: the signature store shards "
+            "per host by digest range. Feed each process's host-resident "
+            "local rows through cluster_sessions_pod (cli cluster routes "
+            "there automatically under a mesh), or drop sig_store for a "
+            "cold mesh run.")
     if params.sig_store and mesh is None:
         # Warm path (cluster/store.py + cluster/incremental.py): probe the
-        # persistent signature cache, ship only the novel tail.  Mesh runs
-        # feed over local/ICI links where the wire is not the bound, so
-        # the store stays a single-host lever.
+        # persistent signature cache, ship only the novel tail.  A
+        # pod-sharded store root routes to the pod path instead (see
+        # _cluster_with_store).
         return _cluster_with_store(
             np.ascontiguousarray(items, dtype=np.uint32), params)
     a, b = make_hash_params(params.n_hashes, params.seed)
@@ -1163,7 +1175,16 @@ def _cluster_with_store(items: np.ndarray, params: ClusterParams,
     ``merge_only=True`` (the resumable caller): return None instead of
     running the union path, so the caller can fall back to its chunk-
     checkpointed cold pipeline and populate the store afterwards."""
-    from .store import SignatureStore, row_digests
+    from .store import ShardedSignatureStore, SignatureStore, row_digests
+
+    if ShardedSignatureStore.is_sharded_root(params.sig_store):
+        # A pod-sharded store probed by a plain single-process run (the
+        # resumed-after-host-loss shape): route through the pod path over
+        # the local device mesh — this process inherits every digest
+        # range, reassignments fire as degradation events, and the lost
+        # hosts' un-appended rows probe as misses and recompute.
+        return cluster_sessions_pod(items, items.shape[0], params,
+                                    solo=jax.process_count() > 1)
 
     rec = StageRecorder()
     t_all = time.perf_counter()
@@ -1369,3 +1390,198 @@ def _store_populate_from_run(params: ClusterParams, qbits: int,
     last_run_info.update(cache_hit_rate=round(float(hit.mean()), 4),
                          cache_mode="populate",
                          cache_novel_rows=int((~hit).sum()))
+
+
+# ---------------------------------------------------------------------------
+# Pod warm path (cluster/store.ShardedSignatureStore +
+# resilience/coordinator.py): `--sig-store` under a mesh.
+#
+# Each process probes ONLY its local row range (bounding host MinHash
+# work at N/nproc) against the digest-range-sharded store — every range
+# is readable by every host, writable by exactly its owner — then
+# device-MinHashes only its local novel tail through the existing
+# degraded streaming pipeline.  The cross-host data plane is the SHARED
+# STORE ROOT, not a device collective: the sharded store already
+# requires a shared filesystem, novel (digest, signature) tails exchange
+# as atomic per-run files (parallel/multihost.fs_exchange) so each owner
+# appends its digest range's rows, and each host assembles the full
+# signature matrix (its own slice + peers' novel tails + peers' cached
+# rows gathered straight from the store) and runs the band-sharded tail
+# kernel (cluster/sharded.py, minus the MinHash stage) on its LOCAL
+# device mesh.  The tail is replicated per host — it is the cheap stage,
+# MinHash over novel rows is the partitioned one — which buys two things:
+# no cross-process XLA executable (the CPU backend cannot run one at
+# all), and no collective that can hang forever on a dead peer; every
+# cross-host wait polls the heartbeat monitor instead.  Labels are
+# bit-identical to a cold run over the same rows.
+#
+# ``solo=True`` runs the same path with the exchange skipped: the
+# coordinator's failover shape — a survivor re-executing the whole
+# partition after peers were declared lost (jax.distributed has no
+# elastic membership).  The lost hosts' digest ranges open under this
+# process's ownership (`shard_range_reassigned` events) and their
+# un-appended rows probe as misses and recompute — the exact semantics
+# torn/corrupt shards already have, which is why failover labels equal an
+# uninterrupted run's elementwise.
+
+
+def cluster_sessions_pod(local_items, n_rows: int,
+                         params: ClusterParams | None = None,
+                         mesh: jax.sharding.Mesh | None = None,
+                         axis: str = "data", supervisor=None,
+                         exchange_dir: str | None = None,
+                         solo: bool = False) -> np.ndarray:
+    """Store-enabled clustering across pod processes.
+
+    ``local_items``: this process's host-resident LOGICAL rows — the
+    ``multihost.pod_row_range(n_rows, nproc, pid)`` slice (all rows when
+    single-process or ``solo``).  ``mesh`` must be a LOCAL device mesh
+    (defaults to one over ``jax.local_devices()``).  ``supervisor``
+    (resilience.PodSupervisor) makes every cross-host wait raise
+    HostLostError on a dead peer instead of hanging; ``exchange_dir`` is
+    this run's negotiated exchange directory
+    (resilience/coordinator.exchange_dir — required for multi-process
+    runs).  Returns the full [n_rows] label vector on every process."""
+    from ..parallel import multihost
+    from ..parallel.mesh import shard_along
+    from .sharded import _sharded_label_kernel_from_sig
+    from .store import ShardedSignatureStore, row_digests
+
+    params = params or ClusterParams()
+    if not params.sig_store:
+        raise ValueError("cluster_sessions_pod requires params.sig_store "
+                         "(the pod path IS the store path; use "
+                         "cluster_sessions for cold runs)")
+    if mesh is None:
+        mesh = jax.sharding.Mesh(np.array(jax.local_devices()), (axis,))
+    nproc = 1 if solo else jax.process_count()
+    pid = 0 if solo else jax.process_index()
+    local_only = solo or nproc == 1
+    if not local_only and exchange_dir is None:
+        raise ValueError("multi-process cluster_sessions_pod needs the "
+                         "run's exchange_dir (negotiate it via "
+                         "resilience.coordinator — cli.run_pod_cluster "
+                         "does)")
+    monitor = supervisor.monitor if supervisor is not None else None
+
+    rec = StageRecorder()
+    t_all = time.perf_counter()
+    last_run_info.clear()
+    local_items = np.ascontiguousarray(local_items, dtype=np.uint32)
+    lo, hi = ((0, n_rows) if local_only
+              else multihost.pod_row_range(n_rows, nproc, pid))
+    k_local = hi - lo
+    if local_items.shape[0] != k_local:
+        raise ValueError(
+            f"process {pid} must feed rows [{lo}, {hi}) of the logical "
+            f"array ({k_local} rows), got {local_items.shape[0]}")
+    # Auto wire quantization stays off under the pod path (it keys off a
+    # GLOBAL byte/max inventory no single host holds); explicit bits
+    # apply — and land in the store policy, which refuses mismatches.
+    qbits = params.wire_quant_bits if params.wire_quant_bits > 0 else 0
+    h = params.n_hashes
+    with rec.stage("probe"):
+        digests = row_digests(local_items)  # RAW ids, pre-quantization
+        store = ShardedSignatureStore(params.sig_store,
+                                      _store_policy(params, qbits),
+                                      n_processes=nproc, process_id=pid)
+        hit, loc = store.probe(digests)
+    sig_local = np.zeros((k_local, h), np.uint32)
+    if hit.any():
+        with rec.stage("load", nbytes=int(hit.sum()) * h * 4):
+            sig_local[hit] = store.load_signatures(loc[hit])
+    miss = ~hit
+    if miss.any():
+        # Per-host novel tail: only this process's content-novel rows
+        # touch the device, through the existing degradation-aware
+        # streaming pipeline (OOM halving / stall retry / CPU failover).
+        sub = local_items[miss]
+        if qbits:
+            sub = quantize_ids(sub, qbits)
+        a, b = make_hash_params(params.n_hashes, params.seed)
+        a, b = jnp.asarray(a), jnp.asarray(b)
+        sig_d, _ = _minhash_streamed(sub, a, b, params, rec)
+        with rec.stage("d2h", nbytes=int(sig_d.size) * 4):
+            sig_local[miss] = np.asarray(sig_d)
+    if local_only:
+        payloads = [{"digests": digests, "miss": miss,
+                     "novel_sigs": sig_local[miss]}]
+    else:
+        # Novel-tail exchange over the shared store root (doubles as the
+        # barrier between per-host MinHash and the replicated tail); the
+        # wait polls the heartbeat monitor — a dead peer raises
+        # HostLostError here, never a hang.
+        payloads = multihost.fs_exchange(
+            exchange_dir, "novel", {"digests": digests, "miss": miss,
+                                    "novel_sigs": sig_local[miss]},
+            monitor=monitor)
+    # Each digest range's OWNER appends its rows (single-writer per
+    # range); duplicate content MinHashed by two hosts dedups in append.
+    all_nd = np.concatenate([p["digests"][p["miss"].astype(bool)]
+                             for p in payloads])
+    all_ns = np.concatenate([p["novel_sigs"] for p in payloads])
+    mine = store.owned_mask(all_nd)
+    appended = store.append(all_nd[mine], all_ns[mine])
+    total_rows = sum(int(p["digests"].shape[0]) for p in payloads)
+    total_hits = sum(int((~p["miss"].astype(bool)).sum())
+                     for p in payloads)
+    # Full signature matrix, pid order == logical row order
+    # (pod_row_range deals contiguous slices): peers' novel tails came
+    # over the exchange; peers' cached rows gather straight from the
+    # store (readable by every host — committed before this run, so the
+    # read cannot race this run's appends).
+    parts: list[np.ndarray] = []
+    with rec.stage("load", nbytes=(total_rows - k_local) * h * 4):
+        for p, pay in enumerate(payloads):
+            if p == pid:  # pid is 0 on every local-only shape
+                parts.append(sig_local)
+                continue
+            pmiss = pay["miss"].astype(bool)
+            psig = np.zeros((pay["digests"].shape[0], h), np.uint32)
+            psig[pmiss] = pay["novel_sigs"]
+            if (~pmiss).any():
+                chit, cloc = store.probe(pay["digests"][~pmiss])
+                if not chit.all():
+                    raise RuntimeError(
+                        f"pod: {int((~chit).sum())} row(s) process {p} "
+                        "reported cached are no longer in the store "
+                        "(eviction or quarantine raced the run); rerun — "
+                        "the rows will probe as misses and recompute")
+                psig[~pmiss] = store.load_signatures(cloc)
+            parts.append(psig)
+    sig_full = parts[0] if len(parts) == 1 else np.concatenate(parts)
+    hit_rate = float(total_hits) / max(total_rows, 1)
+    last_run_info.update(
+        encoding="pod-store", cache_mode="pod",
+        cache_hit_rate=round(hit_rate, 4),
+        cache_novel_rows=int(total_rows - total_hits),
+        cache_store_rows=int(store.n_rows), wire_quant_bits=qbits,
+        pod_processes=nproc, pod_n_ranges=store.n_ranges,
+        pod_owned_ranges=list(store.owned),
+        pod_reassigned_ranges=list(store.reassigned_ranges),
+        pod_appended_rows=int(appended))
+    # Replicated tail on the LOCAL mesh: row-sharded signatures in,
+    # replicated labels out — the sharded kernel family minus its MinHash
+    # stage.  Pad rows carry zero signatures: they sit past every real
+    # index (hub election by min original index can never elect them over
+    # a real row) and are sliced off the label vector.
+    n_dev = mesh.devices.size
+    pad_rows = (-n_rows) % n_dev
+    sig_feed = (np.concatenate(
+        [sig_full, np.zeros((pad_rows, h), np.uint32)])
+        if pad_rows else sig_full)
+    with rec.stage("h2d", nbytes=sig_feed.nbytes):
+        sig_arr = jax.device_put(sig_feed,
+                                 shard_along(mesh, axis=axis, rank=2))
+        jax.block_until_ready(sig_arr)
+    kernel = _sharded_label_kernel_from_sig(mesh, axis, params.n_bands,
+                                            params.threshold,
+                                            params.n_iters)
+    with rec.stage("compute"):
+        labels_d = kernel(sig_arr)
+        jax.block_until_ready(labels_d)
+    with rec.stage("d2h", nbytes=n_rows * 4):
+        labels = np.asarray(labels_d)[:n_rows]
+    _record_wire(rec)
+    _finish_run(rec, t_all)
+    return labels

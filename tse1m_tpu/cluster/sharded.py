@@ -40,6 +40,39 @@ from .lsh import bucket_representatives, estimated_jaccard, propagate_labels
 from .minhash import band_keys, minhash_signatures
 
 
+def _band_sharded_tail(sig_loc, keys_loc, axis: str, pad_bands: int,
+                       threshold: float, n_iters: int):
+    """The shared bucket/verify/propagate tail, from this device's row
+    shard of (signatures, band keys) to replicated labels.  Called from
+    inside a shard_map body by both the item-fed and the signature-fed
+    kernels — one implementation is what keeps their labels (and the
+    single-device path's) bit-identical."""
+    if pad_bands:
+        nl = keys_loc.shape[0]
+        gid = (jax.lax.axis_index(axis).astype(jnp.uint32) * nl
+               + jnp.arange(nl, dtype=jnp.uint32))
+        keys_loc = jnp.concatenate(
+            [keys_loc,
+             jnp.broadcast_to(gid[:, None], (nl, pad_bands))], axis=1)
+    # Re-shard: each device gets ALL rows of its B/d bands.  Global row
+    # ids are recoverable because all_to_all concatenates source shards
+    # in axis order, matching the contiguous row sharding.
+    kt = jax.lax.all_to_all(keys_loc, axis, split_axis=1, concat_axis=0,
+                            tiled=True)                # [N, B/d]
+    sig = jax.lax.all_gather(sig_loc, axis, axis=0, tiled=True)  # [N, H]
+    n = sig.shape[0]
+
+    # Same election + verification as the single-device path, applied
+    # to this device's owned bands — one shared implementation is what
+    # keeps the mesh labels bit-identical (lsh.band_hub_election).
+    reps_t = bucket_representatives(kt)                # [N, B/d]
+    est_t = estimated_jaccard(sig, reps_t)
+    self_idx = jnp.arange(n, dtype=jnp.int32)[:, None]
+    valid_t = (est_t >= threshold) & (reps_t != self_idx)
+    return propagate_labels(reps_t, valid_t, n_iters=n_iters,
+                            axis_name=axis)
+
+
 @lru_cache(maxsize=32)
 def _sharded_cluster_kernel(mesh, axis: str, n_bands: int, threshold: float,
                             n_iters: int, packed: bool = False):
@@ -74,29 +107,31 @@ def _sharded_cluster_kernel(mesh, axis: str, n_bands: int, threshold: float,
             items_loc = p[..., 0] | (p[..., 1] << 8) | (p[..., 2] << 16)
         sig_loc = minhash_signatures(items_loc, a, b)      # [N/d, H]
         keys_loc = band_keys(sig_loc, n_bands)             # [N/d, B]
-        if pad_bands:
-            nl = keys_loc.shape[0]
-            gid = (jax.lax.axis_index(axis).astype(jnp.uint32) * nl
-                   + jnp.arange(nl, dtype=jnp.uint32))
-            keys_loc = jnp.concatenate(
-                [keys_loc,
-                 jnp.broadcast_to(gid[:, None], (nl, pad_bands))], axis=1)
-        # Re-shard: each device gets ALL rows of its B/d bands.  Global row
-        # ids are recoverable because all_to_all concatenates source shards
-        # in axis order, matching the contiguous row sharding.
-        kt = jax.lax.all_to_all(keys_loc, axis, split_axis=1, concat_axis=0,
-                                tiled=True)                # [N, B/d]
-        sig = jax.lax.all_gather(sig_loc, axis, axis=0, tiled=True)  # [N, H]
-        n = sig.shape[0]
+        return _band_sharded_tail(sig_loc, keys_loc, axis, pad_bands,
+                                  threshold, n_iters)
 
-        # Same election + verification as the single-device path, applied
-        # to this device's owned bands — one shared implementation is what
-        # keeps the mesh labels bit-identical (lsh.band_hub_election).
-        reps_t = bucket_representatives(kt)                # [N, B/d]
-        est_t = estimated_jaccard(sig, reps_t)
-        self_idx = jnp.arange(n, dtype=jnp.int32)[:, None]
-        valid_t = (est_t >= threshold) & (reps_t != self_idx)
-        return propagate_labels(reps_t, valid_t, n_iters=n_iters,
-                                axis_name=axis)
+    return kernel
+
+
+@lru_cache(maxsize=32)
+def _sharded_label_kernel_from_sig(mesh, axis: str, n_bands: int,
+                                   threshold: float, n_iters: int):
+    """The pod warm path's tail kernel: row-sharded PRECOMPUTED MinHash
+    signatures in (each host feeds cached store gathers + its novel
+    tail's fresh signatures), replicated labels out.  Skips the MinHash
+    stage entirely — the signatures either came out of the per-host
+    signature store or were device-computed over the novel rows only —
+    and runs the exact `_band_sharded_tail` the item-fed kernel runs, so
+    labels are bit-identical to a cold run over the same rows."""
+    n_dev = mesh.shape[axis]
+    pad_bands = (-n_bands) % n_dev
+
+    @jax.jit
+    @partial(shard_map, mesh=mesh, check_vma=False,
+             in_specs=(P(axis, None),), out_specs=P(None))
+    def kernel(sig_loc):
+        keys_loc = band_keys(sig_loc, n_bands)             # [N/d, B]
+        return _band_sharded_tail(sig_loc, keys_loc, axis, pad_bands,
+                                  threshold, n_iters)
 
     return kernel

@@ -208,11 +208,21 @@ def digests_fingerprint(digests: np.ndarray) -> str:
 class SignatureStore:
     """Content-addressed (digest -> MinHash signature) store + the last
     run's LSH state, under one directory.  Single-writer; readers see
-    only manifest-committed shards."""
+    only manifest-committed shards.
+
+    ``read_only=True`` opens the store as a pure reader (the pod path's
+    non-owned digest ranges): probes and gathers work, but nothing on
+    disk is touched — no manifest rewrites, no orphan sweep, no
+    quarantine moves, no auto-compaction — so a reader can never race
+    the range's single writer.  A shard that fails its frame still reads
+    as absent (in-memory drop + degradation event); the owner quarantines
+    it for real on its next open."""
 
     def __init__(self, directory: str, policy: dict,
-                 max_bytes: int | None = None) -> None:
+                 max_bytes: int | None = None,
+                 read_only: bool = False) -> None:
         self.directory = directory
+        self.read_only = bool(read_only)
         os.makedirs(directory, exist_ok=True)
         self.policy = {k: int(policy[k]) for k in POLICY_KEYS}
         if max_bytes is None:
@@ -241,15 +251,24 @@ class SignatureStore:
             self.shards = [dict(s) for s in prior.get("shards", [])]
             self._probe_gen = int(prior.get("probe_gen", 0))
             if prior.get("crc_algo", _CRC_ALGO) != _CRC_ALGO:
-                self._reframe_all()
+                if self.read_only:
+                    # Cannot re-frame another host's shards; skip frame
+                    # verification (legacy-entry semantics) rather than
+                    # quarantine every shard under the wrong polynomial.
+                    for entry in self.shards:
+                        entry.pop("sig_crc", None)
+                        entry.pop("key_crc", None)
+                else:
+                    self._reframe_all()
         else:
             self.shards = []
             self._probe_gen = 0
             self._write_manifest()
         self._validate_shards()
-        self._sweep_orphans()
-        if len(self.shards) >= self._compact_threshold():
-            self.compact()
+        if not self.read_only:
+            self._sweep_orphans()
+            if len(self.shards) >= self._compact_threshold():
+                self.compact()
         self._build_index()
 
     @classmethod
@@ -267,6 +286,13 @@ class SignatureStore:
                 f"{directory} has no readable signature-store manifest "
                 f"({e})") from e
         return cls(directory, policy, max_bytes=max_bytes)
+
+    def _require_writable(self, op: str) -> None:
+        if self.read_only:
+            raise RuntimeError(
+                f"signature store at {self.directory} is open read-only "
+                f"(a non-owned pod digest range); {op}() belongs to the "
+                "range's single writer")
 
     @staticmethod
     def _compact_threshold() -> int:
@@ -297,6 +323,8 @@ class SignatureStore:
             return None
 
     def _write_manifest(self) -> None:
+        if self.read_only:
+            return  # readers never publish — the range owner's job
         with atomic_write(self._manifest_path) as f:
             json.dump({"policy": self.policy, "crc_algo": _CRC_ALGO,
                        "probe_gen": self._probe_gen,
@@ -348,7 +376,7 @@ class SignatureStore:
     def _quarantine_file(self, path: str) -> str | None:
         """Move a corrupt artifact into quarantine/ (never delete — the
         operator may want the evidence); returns the new path."""
-        if not os.path.exists(path):
+        if self.read_only or not os.path.exists(path):
             return None
         qdir = os.path.join(self.directory, _QUARANTINE_DIR)
         os.makedirs(qdir, exist_ok=True)
@@ -578,6 +606,7 @@ class SignatureStore:
         batch keep their first occurrence.  The shard write is atomic,
         CRC-framed, and runs under the shared retry engine (a torn write
         — or an injected one — rewrites the temp files from scratch)."""
+        self._require_writable("append")
         if digests.shape[0] == 0:
             return 0
         hit, _, _ = self.bulk_probe(digests)
@@ -658,6 +687,7 @@ class SignatureStore:
         to do).  Crash-safe: the new shard commits via the manifest like
         any append; a SIGKILL mid-write leaves temps the next open
         sweeps and the old shards untouched."""
+        self._require_writable("compact")
         if len(self.shards) < max(2, min_shards):
             return 0
         old = list(self.shards)
@@ -804,6 +834,62 @@ class SignatureStore:
             "store_scrub_repaired": bool(repair),
         }
 
+    def verify_signatures(self, items: np.ndarray, sample: int = 256,
+                          seed: int = 0) -> dict:
+        """Sampled end-to-end recompute of stored signatures from raw
+        rows (``scrub --verify-sigs``): the CRC frame only proves the
+        bytes have not changed SINCE framing — corruption that happened
+        before the frame was written (a flipped bit on the wire to disk,
+        a bad append batch) is inherited as "correct" forever.  This
+        closes that hole: digest ``items``, probe, draw a seeded sample
+        of the hits, recompute their MinHash signatures on host from the
+        raw ids (quantized per the store policy, so the oracle sees the
+        same universe the device did) and compare elementwise.  A shard
+        holding any mismatching row is quarantined — its rows probe as
+        misses and recompute, the same semantics torn/corrupt shards get.
+        Returns the ``store_scrub_verify_*`` report keys."""
+        from .encode import quantize_ids
+        from .host import host_signatures
+        from .minhash import make_hash_params
+
+        items = np.ascontiguousarray(items, dtype=np.uint32)
+        digests = row_digests(items)
+        hit, shard, row = self.bulk_probe(digests)
+        idx = np.flatnonzero(hit)
+        if idx.size > sample > 0:
+            rng = np.random.default_rng(seed)
+            idx = np.sort(rng.choice(idx, size=sample, replace=False))
+        report = {"store_scrub_verify_sampled": int(idx.size),
+                  "store_scrub_verify_mismatch": 0,
+                  "store_scrub_verify_quarantined": 0,
+                  "store_scrub_verify_ok": True}
+        if idx.size == 0:
+            return report
+        stored = self.load_signatures(shard[idx], row[idx])
+        rows = items[idx]
+        qb = self.policy["quant_bits"]
+        if qb:
+            rows = quantize_ids(rows, qb)
+        a, b = make_hash_params(self.policy["n_hashes"],
+                                self.policy["seed"])
+        want = host_signatures(rows, a, b)
+        bad = ~np.all(stored == want, axis=1)
+        if not bad.any():
+            return report
+        bad_sids = {int(s) for s in np.unique(shard[idx][bad])}
+        for entry in list(self.shards):
+            if int(entry["id"]) in bad_sids:
+                self._quarantine_shard(
+                    entry, "sampled signature recompute mismatch "
+                           "(pre-framing corruption)")
+                self.shards.remove(entry)
+        self._write_manifest()
+        self._build_index()
+        report.update(store_scrub_verify_mismatch=int(bad.sum()),
+                      store_scrub_verify_quarantined=len(bad_sids),
+                      store_scrub_verify_ok=False)
+        return report
+
     def _state_frame_ok(self) -> bool:
         meta = self._load_json(self._state_path)
         if meta is None:
@@ -829,6 +915,7 @@ class SignatureStore:
         False — state intentionally not saved — when any row's signature
         is not locatable in the store (eviction raced the run); a warm
         merge must never gather from a shard that is gone."""
+        self._require_writable("save_state")
         if locator.size and int(locator.min()) < 0:
             log.warning("not saving LSH state: %d row(s) have no stored "
                         "signature (store eviction?)",
@@ -931,5 +1018,220 @@ class _suppress_oserror:
         return et is not None and issubclass(et, OSError)
 
 
-__all__ = ["POLICY_KEYS", "SignatureStore", "digests_fingerprint",
-           "file_crc", "row_digests"]
+# -- pod-scale sharding ------------------------------------------------------
+#
+# One process per host, one digest range per process: the 128-bit content
+# digest space is split into ``n_ranges`` contiguous ranges by the top 32
+# bits of lane ``a`` (uniform under the multilinear hash), and each range
+# is a complete SignatureStore under ``range_NNNN/`` of the shared root.
+# A range has exactly ONE writer — the owning process appends its novel
+# rows and stamps its manifests — while every process may open every
+# range read-only for the warm probe/gather, so the pod probe is complete
+# without any cross-host signature traffic.  Ownership is a pure function
+# of (range id, live process count): range r belongs to process
+# ``r % n_processes``, so a pod resumed with fewer hosts deterministically
+# reassigns the lost hosts' ranges to survivors (each reassignment fires a
+# ``shard_range_reassigned`` degradation event) and rows whose appends died
+# with their host simply probe as misses and recompute — the exact
+# semantics torn/corrupt shards already have.
+
+_TOPOLOGY = "pod_topology.json"
+
+
+def digest_range_ids(digests: np.ndarray, n_ranges: int) -> np.ndarray:
+    """[N, 2] uint64 digests -> [N] int32 owning range (contiguous split
+    of the top 32 bits of lane a — stable across processes/machines)."""
+    hi = np.ascontiguousarray(digests, dtype="<u8")[:, 0] >> np.uint64(32)
+    return ((hi * np.uint64(n_ranges)) >> np.uint64(32)).astype(np.int32)
+
+
+class ShardedSignatureStore:
+    """Per-host digest-range sharded signature store (pod warm path).
+
+    ``root`` holds ``pod_topology.json`` (range count + policy — the
+    commit point, written once at creation) and one ``range_NNNN/``
+    SignatureStore per range.  This process owns — and exclusively
+    writes — the ranges ``{r : r % n_processes == process_id}``; all
+    other ranges open read-only on first touch.  ``reassigned_ranges``
+    lists owned ranges whose creation-topology owner is not a live
+    process id (a lost host's range this process inherited)."""
+
+    def __init__(self, root: str, policy: dict, n_processes: int = 1,
+                 process_id: int = 0, n_ranges: int | None = None,
+                 max_bytes: int | None = None) -> None:
+        if os.path.exists(os.path.join(root, _MANIFEST)):
+            raise ValueError(
+                f"signature store at {root} is a single-host store "
+                "(store_manifest.json present); a pod run needs a sharded "
+                "root — point --sig-store at a fresh directory")
+        self.root = root
+        self.policy = {k: int(policy[k]) for k in POLICY_KEYS}
+        self.process_id = int(process_id)
+        self.n_processes = max(1, int(n_processes))
+        self.max_bytes = max_bytes
+        os.makedirs(root, exist_ok=True)
+        topo_path = os.path.join(root, _TOPOLOGY)
+        topo = None
+        if os.path.exists(topo_path):
+            try:
+                with open(topo_path, encoding="utf-8") as f:
+                    topo = json.load(f)
+            except (OSError, ValueError) as e:
+                log.warning("unreadable %s (%s); rewriting", topo_path, e)
+        if topo is None:
+            topo = {"n_ranges": int(n_ranges or self.n_processes),
+                    "policy": self.policy}
+            try:
+                with atomic_write(topo_path) as f:
+                    json.dump(topo, f)
+            except OSError:
+                # Every pod process races to commit the (identical)
+                # topology at first open; atomic_write's fixed tmp name
+                # means the loser's rename can fail — the winner's file
+                # is the commit, re-read it.
+                with open(topo_path, encoding="utf-8") as f:
+                    topo = json.load(f)
+        if topo.get("policy") != self.policy:
+            raise ValueError(
+                f"sharded signature store at {root} was built under a "
+                f"different policy (have {topo.get('policy')}, want "
+                f"{self.policy}); use a fresh directory or delete it")
+        self.n_ranges = int(topo["n_ranges"])
+        self.owned = [r for r in range(self.n_ranges)
+                      if r % self.n_processes == self.process_id]
+        # A range whose creation-deal owner (one range per process at
+        # creation: owner == range id) is no longer a live process id has
+        # been inherited from a lost host.
+        self.reassigned_ranges = [r for r in self.owned
+                                  if r >= self.n_processes
+                                  and r < self.n_ranges]
+        for r in self.reassigned_ranges:
+            record_degradation(
+                "shard_range_reassigned", site="store.pod",
+                detail={"range": int(r), "from_process": int(r),
+                        "to_process": self.process_id})
+        self._stores: dict[int, SignatureStore] = {}
+
+    @staticmethod
+    def is_sharded_root(root: str) -> bool:
+        return os.path.exists(os.path.join(root, _TOPOLOGY))
+
+    def _range_dir(self, r: int) -> str:
+        return os.path.join(self.root, f"range_{r:04d}")
+
+    def range_store(self, r: int) -> SignatureStore:
+        store = self._stores.get(r)
+        if store is None:
+            store = SignatureStore(self._range_dir(r), self.policy,
+                                   max_bytes=self.max_bytes,
+                                   read_only=r not in self.owned)
+            self._stores[r] = store
+        return store
+
+    def owned_mask(self, digests: np.ndarray) -> np.ndarray:
+        rid = digest_range_ids(digests, self.n_ranges)
+        return (rid % self.n_processes) == self.process_id
+
+    def probe(self, digests: np.ndarray
+              ) -> tuple[np.ndarray, np.ndarray]:
+        """[N, 2] digests -> (hit [N] bool, loc [N, 3] int32
+        (range, shard, row); -1 rows for misses).  Reads every range —
+        the pod probe is complete even though this process writes only
+        its own ranges."""
+        n = digests.shape[0]
+        hit = np.zeros(n, bool)
+        loc = np.full((n, 3), -1, np.int32)
+        if n == 0:
+            return hit, loc
+        rid = digest_range_ids(digests, self.n_ranges)
+        for r in np.unique(rid):
+            sel = np.flatnonzero(rid == r)
+            h, sh, rw = self.range_store(int(r)).bulk_probe(digests[sel])
+            hit[sel] = h
+            loc[sel, 0] = int(r)
+            loc[sel, 1] = sh
+            loc[sel, 2] = rw
+        loc[~hit] = -1
+        return hit, loc
+
+    def load_signatures(self, loc: np.ndarray) -> np.ndarray:
+        """Gather [K, n_hashes] signatures by (range, shard, row)
+        triples (every row must be a probe hit)."""
+        k = int(loc.shape[0])
+        out = np.empty((k, self.policy["n_hashes"]), np.uint32)
+        for r in np.unique(loc[:, 0]):
+            sel = np.flatnonzero(loc[:, 0] == r)
+            out[sel] = self.range_store(int(r)).load_signatures(
+                loc[sel, 1], loc[sel, 2])
+        return out
+
+    def append(self, digests: np.ndarray, sigs: np.ndarray) -> int:
+        """Append novel rows into their owning range stores; rows whose
+        range this process does not own are skipped (their owner appends
+        them from the allgathered novel tail)."""
+        if digests.shape[0] == 0:
+            return 0
+        rid = digest_range_ids(digests, self.n_ranges)
+        written = 0
+        for r in self.owned:
+            sel = np.flatnonzero(rid == r)
+            if sel.size:
+                written += self.range_store(r).append(digests[sel],
+                                                      sigs[sel])
+        return written
+
+    @property
+    def n_rows(self) -> int:
+        return sum(self.range_store(r).n_rows
+                   for r in range(self.n_ranges))
+
+    def scrub(self, repair: bool = False, compact: bool = False) -> dict:
+        """Aggregate scrub over every range (repair/compact only on owned
+        ranges — a reader must not rewrite another host's range)."""
+        out: dict = {"store_scrub_ranges": self.n_ranges,
+                     "store_scrub_owned_ranges": len(self.owned)}
+        state_ok = True
+        for r in range(self.n_ranges):
+            mine = r in self.owned
+            rep = self.range_store(r).scrub(repair=repair and mine,
+                                            compact=compact and mine)
+            for k, v in rep.items():
+                if isinstance(v, bool):
+                    continue
+                out[k] = out.get(k, 0) + v if isinstance(v, (int, float)) \
+                    else v
+            state_ok = state_ok and rep.get("store_scrub_state_ok", True)
+        out["store_scrub_state_ok"] = state_ok
+        out["store_scrub_repaired"] = bool(repair)
+        out["store_scrub_mb"] = round(out.get("store_scrub_mb", 0), 3)
+        return out
+
+    def verify_signatures(self, items: np.ndarray, sample: int = 256,
+                          seed: int = 0) -> dict:
+        """Sampled raw-row recompute across every range (see
+        SignatureStore.verify_signatures); the sample budget splits by
+        each range's share of the probed hits."""
+        digests = row_digests(np.ascontiguousarray(items, np.uint32))
+        rid = digest_range_ids(digests, self.n_ranges)
+        out = {"store_scrub_verify_sampled": 0,
+               "store_scrub_verify_mismatch": 0,
+               "store_scrub_verify_quarantined": 0,
+               "store_scrub_verify_ok": True}
+        per = max(1, sample // self.n_ranges)
+        for r in range(self.n_ranges):
+            sel = np.flatnonzero(rid == r)
+            if not sel.size:
+                continue
+            rep = self.range_store(int(r)).verify_signatures(
+                items[sel], sample=per, seed=seed + r)
+            for k in ("store_scrub_verify_sampled",
+                      "store_scrub_verify_mismatch",
+                      "store_scrub_verify_quarantined"):
+                out[k] += rep[k]
+            out["store_scrub_verify_ok"] &= rep["store_scrub_verify_ok"]
+        return out
+
+
+__all__ = ["POLICY_KEYS", "ShardedSignatureStore", "SignatureStore",
+           "digest_range_ids", "digests_fingerprint", "file_crc",
+           "row_digests"]

@@ -625,7 +625,8 @@ def nondeterminism(src: FileSource) -> list[Finding]:
 # plane's arithmetic unauditable.  Scope: the watchdog module itself plus
 # any function whose name claims deadline/watchdog/stall semantics.
 
-_WATCHDOG_PLANE = ("tse1m_tpu/resilience/watchdog.py",)
+_WATCHDOG_PLANE = ("tse1m_tpu/resilience/watchdog.py",
+                   "tse1m_tpu/resilience/coordinator.py")
 _CLOCK_CALLS = {"time.time", "time.time_ns", "time.monotonic",
                 "time.monotonic_ns", "time.perf_counter",
                 "time.perf_counter_ns", "time.clock_gettime"}

@@ -179,7 +179,12 @@ def degradation_counts(events: list) -> dict:
     return by
 
 
-__all__ = ["STAGES", "StageRecorder", "degradation_counts",
-           "peek_degradation_events", "pop_degradation_events",
-           "record_degradation", "record_last_stages", "peek_last_stages",
-           "pop_last_stages"]
+from .merge import (MERGED_MANIFEST, fragment_manifest_path,
+                    merge_run_manifests, sweep_stale_fragments)
+
+__all__ = ["MERGED_MANIFEST", "STAGES", "StageRecorder",
+           "degradation_counts", "fragment_manifest_path",
+           "merge_run_manifests", "peek_degradation_events",
+           "pop_degradation_events", "record_degradation",
+           "record_last_stages", "peek_last_stages", "pop_last_stages",
+           "sweep_stale_fragments"]

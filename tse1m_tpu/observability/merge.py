@@ -1,0 +1,128 @@
+"""Pod manifest aggregation: one merged ``run_manifest.json`` per run.
+
+Before the pod plane, a multi-process run recorded only process 0's
+manifest — every other host's degradation events, scrub stats and stage
+timings were simply lost.  Now each process's StepRunner writes a
+per-process FRAGMENT (``run_manifest.p<NNN>.json``) and the coordinator
+(process 0, or the failover survivor) folds every fragment into the one
+``run_manifest.json`` operators read:
+
+- ``degradation_counts`` sums across processes — the one-glance answer
+  to "what did the supervision plane absorb, pod-wide";
+- ``steps`` concatenates every process's step records, each tagged with
+  its ``process`` id (stage timings and per-step degradation events ride
+  along inside the records, exactly as single-process);
+- ``pod`` records the topology and which fragments were merged vs
+  missing — a host that died before writing its fragment shows up as
+  ``missing`` rather than silently narrowing the record;
+- ``ok`` is the pod-wide conjunction: any failed step on any host, or
+  any missing fragment, marks the merged run not-ok.
+
+Fragments are merged, never deleted: the per-host originals stay next to
+the merged manifest for post-mortems.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+from ..utils.atomic import atomic_write
+from ..utils.logging import get_logger
+
+log = get_logger("observability.merge")
+
+MERGED_MANIFEST = "run_manifest.json"
+_FRAGMENT_FMT = "run_manifest.p{:03d}.json"
+_FRAGMENT_GLOB = "run_manifest.p*.json"
+
+
+def fragment_manifest_path(result_dir: str, process_id: int) -> str:
+    """The per-process manifest fragment path for a pod run."""
+    return os.path.join(result_dir, _FRAGMENT_FMT.format(int(process_id)))
+
+
+def _load_fragment(path: str) -> dict | None:
+    try:
+        with open(path, encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError) as e:
+        log.warning("unreadable manifest fragment %s (%s); recording as "
+                    "missing", path, e)
+        return None
+
+
+def merge_run_manifests(result_dir: str, n_processes: int,
+                        out_path: str | None = None) -> dict:
+    """Fold every process's manifest fragment into the merged manifest.
+
+    Fragments beyond ``n_processes`` (stale from an earlier, larger pod)
+    are ignored; expected-but-absent fragments are recorded under
+    ``pod.missing``.  Returns the merged payload (also written atomically
+    to ``out_path`` / ``<result_dir>/run_manifest.json``)."""
+    out_path = out_path or os.path.join(result_dir, MERGED_MANIFEST)
+    fragments: dict[int, dict] = {}
+    missing: list[int] = []
+    for pid in range(int(n_processes)):
+        frag = _load_fragment(fragment_manifest_path(result_dir, pid))
+        if frag is None:
+            missing.append(pid)
+        else:
+            fragments[pid] = frag
+    counts: dict[str, int] = {}
+    steps: list[dict] = []
+    summary: dict[str, int] = {}
+    started = None
+    wall = 0.0
+    for pid in sorted(fragments):
+        frag = fragments[pid]
+        for kind, n in (frag.get("degradation_counts") or {}).items():
+            counts[kind] = counts.get(kind, 0) + int(n)
+        for status, n in (frag.get("summary") or {}).items():
+            summary[status] = summary.get(status, 0) + int(n)
+        for step in frag.get("steps", []):
+            steps.append({**step, "process": pid})
+        if frag.get("started_at") is not None:
+            started = (frag["started_at"] if started is None
+                       else min(started, frag["started_at"]))
+        wall = max(wall, float(frag.get("wall_seconds", 0.0)))
+    payload = {
+        "started_at": started,
+        "wall_seconds": wall,
+        "ok": (not missing
+               and all(f.get("ok", False) for f in fragments.values())),
+        "summary": summary,
+        "degradation_counts": counts,
+        "pod": {
+            "n_processes": int(n_processes),
+            "merged_from": sorted(fragments),
+            "missing": missing,
+        },
+        "steps": steps,
+    }
+    os.makedirs(result_dir or ".", exist_ok=True)
+    with atomic_write(out_path) as f:
+        json.dump(payload, f, indent=2, default=str)
+    if missing:
+        log.warning("pod manifest merged with %d missing fragment(s): %s "
+                    "(hosts that died before recording)", len(missing),
+                    missing)
+    return payload
+
+
+def sweep_stale_fragments(result_dir: str) -> int:
+    """Remove fragments from a PREVIOUS pod run so a smaller re-run's
+    merge cannot pick up a dead topology's records; returns count."""
+    n = 0
+    for p in glob.glob(os.path.join(result_dir, _FRAGMENT_GLOB)):
+        try:
+            os.remove(p)
+            n += 1
+        except OSError:
+            pass
+    return n
+
+
+__all__ = ["MERGED_MANIFEST", "fragment_manifest_path",
+           "merge_run_manifests", "sweep_stale_fragments"]

@@ -27,6 +27,7 @@ is also how the driver's virtual-device dryrun exercises the code path.
 from __future__ import annotations
 
 import os
+import time
 
 import jax
 import numpy as np
@@ -69,8 +70,28 @@ def initialize_from_env() -> bool:
             "is not; every process must export its unique id (0..n-1)")
     pid = int(pid_raw)
     try:
-        jax.distributed.initialize(coordinator_address=coord,
-                                   num_processes=int(nproc), process_id=pid)
+        # global_state.initialize rather than the public wrapper: the
+        # extra knobs make the XLA coordination service's OWN death
+        # detection inert (default: ~100 s after a peer dies, every
+        # survivor's error-poll thread LOG(FATAL)s the process — i.e. a
+        # lost host EXECUTES THE SURVIVORS, the exact opposite of what
+        # the pod failover plane needs).  Host loss is the file-heartbeat
+        # monitor's job (resilience/coordinator.py); the service stays up
+        # only for bring-up and the run-nonce KV store, and a pod run
+        # that declared a loss must exit via
+        # coordinator.hard_exit_if_host_lost (the Shutdown barrier can
+        # never pass once a peer is dead).
+        from jax._src import distributed as _dist
+
+        _dist.global_state.initialize(
+            coordinator_address=coord, num_processes=int(nproc),
+            process_id=pid,
+            service_heartbeat_interval_seconds=10,
+            service_max_missing_heartbeats=int(os.environ.get(
+                "TSE1M_DIST_MAX_MISSED_HEARTBEATS", 100_000)),
+            client_heartbeat_interval_seconds=10,
+            client_max_missing_heartbeats=int(os.environ.get(
+                "TSE1M_DIST_MAX_MISSED_HEARTBEATS", 100_000)))
     except RuntimeError:
         # Already initialised (idempotent second call) — anything else
         # (backend up before init, unreachable coordinator) re-raises.
@@ -183,3 +204,70 @@ def all_processes_ready(tag: str = "barrier") -> None:
         from jax.experimental import multihost_utils
 
         multihost_utils.sync_global_devices(tag)
+
+
+def pod_row_range(n_rows: int, n_processes: int,
+                  process_id: int) -> tuple[int, int]:
+    """[start, stop) of the rows this process materialises on the pod
+    warm path — a plain contiguous ceil deal over PROCESSES, not devices.
+
+    The pod path never builds a cross-process device array (its label
+    tail runs replicated on each host's local mesh), so the deal has no
+    device-layout constraint to honor; what it must be is a pure function
+    of (n_rows, n_processes, process_id) so a failover survivor can
+    reconstruct exactly which rows a lost host owned."""
+    per = -(-int(n_rows) // max(1, int(n_processes)))
+    start = min(int(process_id) * per, int(n_rows))
+    return start, min(start + per, int(n_rows))
+
+
+def fs_exchange(xch_dir: str, tag: str, payload: dict,
+                monitor=None, timeout_s: float = 600.0) -> list:
+    """All-to-all host exchange over the shared filesystem: write this
+    process's arrays atomically, wait for every peer's, return the
+    per-process payload list (pid order).
+
+    This is the pod warm path's data plane — the digest-range-sharded
+    signature store already requires a shared root (cluster/store.py), so
+    the same root carries the novel-tail exchange; no cross-process XLA
+    executable is involved, which the CPU backend cannot run at all and
+    which would otherwise hang forever on a dead peer.  The wait polls
+    ``monitor`` (resilience.PeerMonitor) between sleeps, so a host that
+    stops heartbeating mid-exchange raises HostLostError here instead of
+    stalling the pod; ``timeout_s`` is the no-monitor backstop.  The
+    exchange doubles as a barrier: returning implies every process
+    reached ``tag``.  ``xch_dir`` must be per-run (see
+    resilience/coordinator.exchange_dir) — names carry no run identity."""
+    from ..resilience.watchdog import deadline_clock
+
+    nproc, pid = jax.process_count(), jax.process_index()
+    os.makedirs(xch_dir, exist_ok=True)
+
+    def _path(p: int) -> str:
+        return os.path.join(xch_dir, f"{tag}.p{p:03d}.npz")
+
+    tmp = _path(pid) + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **{k: np.ascontiguousarray(v)
+                       for k, v in payload.items()})
+    os.replace(tmp, _path(pid))  # atomic: a peer never reads a torn file
+    out: dict[int, dict] = {pid: {k: np.ascontiguousarray(v)
+                                  for k, v in payload.items()}}
+    deadline = deadline_clock() + float(timeout_s)
+    pending = set(range(nproc)) - {pid}
+    while pending:
+        for p in sorted(pending):
+            if os.path.exists(_path(p)):
+                with np.load(_path(p)) as z:
+                    out[p] = {k: z[k] for k in z.files}
+                pending.discard(p)
+        if not pending:
+            break
+        if monitor is not None:
+            monitor.check(site=f"pod.exchange:{tag}")
+        if deadline_clock() > deadline:
+            raise TimeoutError(
+                f"pod exchange '{tag}': no payload from process(es) "
+                f"{sorted(pending)} within {timeout_s:.0f}s")
+        time.sleep(0.1)
+    return [out[p] for p in range(nproc)]

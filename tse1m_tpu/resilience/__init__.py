@@ -32,6 +32,9 @@ from __future__ import annotations
 
 import os
 
+from .coordinator import (HeartbeatWriter, HostLostError, PeerMonitor,
+                          PodSupervisor, resume_heartbeats,
+                          suspend_heartbeats)
 from .faults import (FaultPlan, FaultRule, InjectedConnectionDrop,
                      InjectedFault, active_plan, clear_plan, fault_point,
                      install_plan, reraise_if_fault)
@@ -42,12 +45,14 @@ from .watchdog import (Deadline, StageWatchdog, StallError, deadline_clock,
                        run_with_deadline, watchdog_enabled)
 
 __all__ = [
-    "Deadline", "FaultPlan", "FaultRule", "InjectedConnectionDrop",
-    "InjectedFault", "RetryError", "RetryPolicy", "StageWatchdog",
-    "StallError", "StepRunner", "active_plan", "clear_plan",
-    "deadline_clock", "deadline_guard", "fault_point", "install_plan",
-    "io_retry_policy", "is_device_loss", "is_resource_exhausted",
-    "reraise_if_fault", "retry_call", "run_with_deadline",
+    "Deadline", "FaultPlan", "FaultRule", "HeartbeatWriter",
+    "HostLostError", "InjectedConnectionDrop", "InjectedFault",
+    "PeerMonitor", "PodSupervisor", "RetryError", "RetryPolicy",
+    "StageWatchdog", "StallError", "StepRunner", "active_plan",
+    "clear_plan", "deadline_clock", "deadline_guard", "fault_point",
+    "install_plan", "io_retry_policy", "is_device_loss",
+    "is_resource_exhausted", "reraise_if_fault", "resume_heartbeats",
+    "retry_call", "run_with_deadline", "suspend_heartbeats",
     "watchdog_enabled",
 ]
 

@@ -1,0 +1,407 @@
+"""Pod-scale supervision: peer heartbeats + coordinator-driven failover.
+
+The PR 5 watchdog bounds every stage *inside* one process; the failure it
+cannot see is a whole host going away — SIGKILLed by the scheduler, wedged
+in a kernel hang, or partitioned off the network.  Under `jax.distributed`
+that failure is maximally silent: the survivors block forever inside the
+next collective, because the collective cannot know its peer is never
+coming.  This module turns "lost host" into a first-class, recoverable
+failure, the same shape fault-tolerant multi-host training stacks use
+(elastic membership + re-execution of the lost worker's partition, the
+MapReduce recipe):
+
+- :class:`HeartbeatWriter` — every process beats a monotonically
+  increasing ``seq`` into ``hb_<pid>.json`` under a shared directory
+  (atomic tmp+rename, so a reader never sees a torn beat).  Beats carry
+  NO timestamps: wall clocks are not comparable across hosts, and the
+  watchdog plane forbids them anyway (graftlint ``watchdog-clock``).
+- :class:`PeerMonitor` — declares a peer lost when its ``seq`` has not
+  advanced within ``timeout_s`` measured on the LOCAL
+  :func:`~.watchdog.deadline_clock`.  Only local monotonic deltas are
+  ever compared, so NTP steps on either host cannot fire or starve the
+  monitor.
+- :class:`PodSupervisor` — owns both, plus :meth:`guarded`: run a
+  cross-host phase (a collective, a barrier) on a reaper-able thread
+  while polling the monitor — a dead peer turns an infinite collective
+  hang into :class:`HostLostError` within one heartbeat timeout.  The
+  caller (cli's pod cluster step) then fails over: the lowest-id
+  survivor re-executes solo with the lost host's digest range
+  reassigned (`cluster/store.ShardedSignatureStore`), every other
+  survivor exits loudly.  Every declaration/reassignment/failover fires
+  a degradation event into the merged pod ``run_manifest.json``.
+
+The fault plane's ``hostloss`` kind (resilience/faults.py) wedges a host
+for the chaos tests: it calls :func:`suspend_heartbeats` then sleeps at a
+production seat — the process is alive but silent, exactly the failure
+mode heartbeats exist to catch (``kill`` already covers the dead-process
+variant).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import shutil
+import threading
+import time
+from typing import Callable
+
+from ..utils.atomic import atomic_write
+from ..utils.logging import get_logger
+from .watchdog import deadline_clock
+
+log = get_logger("resilience.coordinator")
+
+_HB_PREFIX = "hb_"
+_XCH_PREFIX = "xch_"
+
+
+def heartbeat_interval_s() -> float:
+    return float(os.environ.get("TSE1M_HEARTBEAT_INTERVAL_S", 0.5))
+
+
+def heartbeat_timeout_s() -> float:
+    return float(os.environ.get("TSE1M_HEARTBEAT_TIMEOUT_S", 10.0))
+
+
+class HostLostError(RuntimeError):
+    """Peer host(s) declared lost (heartbeat timeout / dead collective)."""
+
+    def __init__(self, lost: list, site: str = ""):
+        self.lost = sorted(int(p) for p in lost)
+        self.site = site
+        super().__init__(
+            f"{site or 'pod'}: host(s) {self.lost} declared lost — no "
+            "heartbeat within the timeout; their digest ranges reassign "
+            "to survivors and their rows recompute")
+
+
+# The fault plane's hostloss kind flips this: a wedged host stays alive
+# but stops beating, so peers declare it lost through the production
+# heartbeat path (zero test-only branches in the monitor).
+_suspended = threading.Event()
+
+# Latches when ANY monitor in this process declares a host lost: the
+# jax.distributed runtime is poisoned from that moment (its Shutdown
+# barrier can never pass without the dead task) and the process must
+# leave through hard_exit_if_host_lost.
+_loss_seen = threading.Event()
+
+
+def saw_host_loss() -> bool:
+    return _loss_seen.is_set()
+
+
+# Failover scope note: in-process failover covers lost WORKERS only.
+# Process 0 hosts the XLA coordination service; when it dies, every
+# survivor's error-poll thread observes the closed socket and LOG(FATAL)s
+# the process within ~1 s — faster than any heartbeat could detect, and
+# unstoppable from Python.  A lost leader therefore fences the whole pod
+# (every worker exits), and recovery is the scheduler's respawn: a fresh
+# run against the same sharded store root inherits every digest range and
+# recomputes whatever the dead pod never appended (probe-as-miss), so the
+# respawned labels equal an uninterrupted run's (pinned by the
+# leader-death chaos test).
+
+
+def hard_exit_if_host_lost(code: int) -> int:
+    """Exit NOW via ``os._exit`` when this run declared a host lost (and
+    is actually distributed); otherwise return ``code`` for the normal
+    return path.
+
+    Once a pod peer is dead, the XLA coordination client cannot
+    disconnect: ``client.shutdown()`` waits at a Shutdown barrier the
+    dead task will never join and LOG(FATAL)s the survivor — an exit
+    code of -SIGABRT from the process that *survived* the failover.
+    All durable state (manifests, store shards, labels) is written with
+    atomic renames before the callers invoke this, so skipping the
+    interpreter's atexit teardown loses nothing."""
+    import jax
+
+    if _loss_seen.is_set() and jax.process_count() > 1:
+        log.warning("pod: host loss was declared this run — exiting "
+                    "without jax.distributed teardown (code %d)", code)
+        import sys
+
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(code)
+    return code
+
+
+def suspend_heartbeats() -> None:
+    _suspended.set()
+
+
+def resume_heartbeats() -> None:
+    _suspended.clear()
+
+
+def heartbeat_path(directory: str, process_id: int) -> str:
+    return os.path.join(directory, f"{_HB_PREFIX}{int(process_id):03d}.json")
+
+
+class HeartbeatWriter:
+    """Beat ``seq`` into this process's heartbeat file from a daemon
+    thread.  Atomic writes only — a peer's read never races a beat."""
+
+    def __init__(self, directory: str, process_id: int,
+                 interval_s: float | None = None) -> None:
+        self.directory = directory
+        self.process_id = int(process_id)
+        self.interval_s = (heartbeat_interval_s()
+                           if interval_s is None else float(interval_s))
+        self._lock = threading.Lock()
+        self._seq = 0
+        # Per-run nonce: a fresh run restarts seq at 1, which a stale
+        # heartbeat file from a previous run (higher seq) would otherwise
+        # mask forever — any nonce change counts as an advance.
+        self._run_id = os.urandom(8).hex()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        os.makedirs(directory, exist_ok=True)
+
+    def beat_once(self) -> int:
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        with atomic_write(heartbeat_path(self.directory,
+                                         self.process_id)) as f:
+            json.dump({"process_id": self.process_id, "seq": seq,
+                       "run": self._run_id}, f)
+        return seq
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            if not _suspended.is_set():
+                try:
+                    self.beat_once()
+                except OSError as e:
+                    log.warning("heartbeat write failed (%s); peers may "
+                                "declare this host lost", e)
+            self._stop.wait(self.interval_s)
+
+    def start(self) -> "HeartbeatWriter":
+        if self._thread is None:
+            self.beat_once()  # visible before any peer's grace expires
+            t = threading.Thread(target=self._run, daemon=True,
+                                 name=f"tse1m-heartbeat:{self.process_id}")
+            with self._lock:
+                self._thread = t
+            t.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+class PeerMonitor:
+    """Track peers' heartbeat seqs; declare lost on no advance within
+    ``timeout_s`` of the LOCAL deadline_clock.  Lost declarations latch —
+    a host that resumes beating after the declaration stays lost for this
+    run (its range was already reassigned; let the next run readmit it)."""
+
+    def __init__(self, directory: str, n_processes: int, process_id: int,
+                 timeout_s: float | None = None) -> None:
+        self.directory = directory
+        self.process_id = int(process_id)
+        self.peers = [p for p in range(int(n_processes))
+                      if p != self.process_id]
+        self.timeout_s = (heartbeat_timeout_s()
+                          if timeout_s is None else float(timeout_s))
+        now = deadline_clock()
+        self._lock = threading.Lock()
+        # peer -> (last (run, seq) seen, deadline_clock() at last advance).
+        # Absent files get the full grace window from monitor start.
+        self._seen = {p: ((None, -1), now) for p in self.peers}
+        self._lost: set[int] = set()
+
+    def _read_beat(self, peer: int):
+        """(run nonce, seq) of the peer's last beat, or None."""
+        try:
+            with open(heartbeat_path(self.directory, peer),
+                      encoding="utf-8") as f:
+                d = json.load(f)
+            return (d.get("run"), int(d["seq"]))
+        except (OSError, ValueError, KeyError):
+            return None
+
+    def poll(self) -> list:
+        """Refresh peer state; returns the (latched) lost list."""
+        now = deadline_clock()
+        with self._lock:
+            for peer in self.peers:
+                if peer in self._lost:
+                    continue
+                beat = self._read_beat(peer)
+                (last_run, last_seq), last_t = self._seen[peer]
+                advanced = beat is not None and (
+                    beat[0] != last_run or beat[1] > last_seq)
+                if advanced:
+                    self._seen[peer] = (beat, now)
+                elif now - last_t > self.timeout_s:
+                    self._lost.add(peer)
+                    _loss_seen.set()
+                    log.warning(
+                        "pod: host %d declared lost (no heartbeat advance "
+                        "in %.1fs, last seq %d)", peer, self.timeout_s,
+                        last_seq)
+                    from ..observability import record_degradation
+
+                    record_degradation(
+                        "host_lost", site="coordinator",
+                        detail={"process": int(peer),
+                                "timeout_s": self.timeout_s,
+                                "last_seq": int(last_seq)})
+            return sorted(self._lost)
+
+    def check(self, site: str = "") -> None:
+        """Raise :class:`HostLostError` when any peer is lost."""
+        lost = self.poll()
+        if lost:
+            raise HostLostError(lost, site=site)
+
+
+class PodSupervisor:
+    """One per process: this process's heartbeat writer + the peer
+    monitor, and the guarded-phase wrapper that converts a dead peer's
+    infinite collective hang into :class:`HostLostError`."""
+
+    _POLL_S = 0.25
+
+    def __init__(self, directory: str, n_processes: int, process_id: int,
+                 interval_s: float | None = None,
+                 timeout_s: float | None = None) -> None:
+        self.directory = directory
+        self.n_processes = int(n_processes)
+        self.process_id = int(process_id)
+        self.writer = HeartbeatWriter(directory, process_id,
+                                      interval_s=interval_s)
+        self.monitor = PeerMonitor(directory, n_processes, process_id,
+                                   timeout_s=timeout_s)
+
+    def start(self) -> "PodSupervisor":
+        self.writer.start()
+        return self
+
+    def stop(self) -> None:
+        self.writer.stop()
+
+    def survivors(self) -> list:
+        lost = set(self.monitor.poll())
+        return [p for p in range(self.n_processes) if p not in lost]
+
+    def guarded(self, fn: Callable, site: str = "pod.collective"):
+        """Run a cross-host phase with host-loss supervision.
+
+        ``fn`` runs on a daemon worker thread; while it blocks (a
+        collective waiting on every peer), the monitor polls — a lost
+        peer raises :class:`HostLostError` here and the hung attempt is
+        abandoned (the thread cannot be killed; it is daemon and its
+        result is discarded — the standard watchdog cancel semantics).
+        A ``fn`` that *fails* while a peer looks dead re-raises as
+        :class:`HostLostError` once the monitor confirms within the
+        heartbeat timeout: a collective erroring with "connection reset"
+        because its peer was SIGKILLed is a host loss, not a bug."""
+        box: dict = {}
+
+        def worker() -> None:
+            try:
+                box["result"] = fn()
+            except BaseException as e:  # graftlint: disable=broad-except -- relayed verbatim below
+                box["error"] = e
+
+        t = threading.Thread(target=worker, daemon=True,
+                             name=f"tse1m-pod:{site}")
+        t.start()
+        while True:
+            t.join(self._POLL_S)
+            if not t.is_alive():
+                break
+            self.monitor.check(site=site)
+        if "error" in box:
+            err = box["error"]
+            # Confirm (or clear) peer death before relaying: give the
+            # monitor one full timeout window to observe stalled beats.
+            deadline = deadline_clock() + self.monitor.timeout_s
+            while deadline_clock() < deadline:
+                if self.monitor.poll():
+                    break
+                time.sleep(self._POLL_S)
+            lost = self.monitor.poll()
+            if lost:
+                raise HostLostError(lost, site=site) from err
+            raise err
+        return box.get("result")
+
+
+# -- per-run exchange-dir negotiation ---------------------------------------
+#
+# The pod's bulk data plane is the shared store root (the sharded store
+# already requires one; see cluster/store.py) — novel-tail exchanges are
+# atomic files under a PER-RUN directory, because the pod dir outlives
+# runs and a slow host reading a previous run's exchange file would merge
+# stale signatures silently.  The per-run name comes from a nonce process
+# 0 publishes through the jax.distributed key-value service: that service
+# lives inside process 0's run and dies with it, so a nonce read from it
+# can never be a previous run's — staleness-free by construction.  (The
+# heartbeat plane deliberately does NOT ride the same service: when
+# process 0 dies, the KV store dies with it, and the survivors' monitor —
+# plain files — is what must keep working to declare the loss.)
+
+
+def _kv_client():
+    from jax._src import distributed  # run-scoped KV service
+
+    return distributed.global_state.client
+
+
+_NONCE_KEY = "tse1m/pod/run_nonce"
+
+
+def negotiate_run_nonce(supervisor: "PodSupervisor | None" = None) -> str:
+    """One hex nonce shared by every process of THIS run.
+
+    Process 0 generates and publishes it; peers block on the KV get in
+    short slices, polling the heartbeat monitor between them so a process
+    0 that dies pre-publish raises :class:`HostLostError` instead of a
+    bare timeout.  Single-process runs mint a local nonce."""
+    if supervisor is None or supervisor.n_processes == 1:
+        return os.urandom(8).hex()
+    if supervisor.process_id == 0:
+        nonce = os.urandom(8).hex()
+        _kv_client().key_value_set(_NONCE_KEY, nonce)
+        return nonce
+    deadline = deadline_clock() + supervisor.monitor.timeout_s * 2
+    while True:
+        try:
+            return _kv_client().blocking_key_value_get(_NONCE_KEY, 1000)
+        except RuntimeError as e:  # XlaRuntimeError: deadline exceeded
+            supervisor.monitor.check(site="pod.nonce")
+            if deadline_clock() > deadline:
+                raise TimeoutError(
+                    "pod: no run nonce from process 0 within "
+                    f"{supervisor.monitor.timeout_s * 2:.0f}s (it is "
+                    "beating but has not announced a run)") from e
+
+
+def exchange_dir(pod_dir: str, nonce: str,
+                 sweep_stale: bool = False) -> str:
+    """This run's exchange directory under the pod dir; process 0 passes
+    ``sweep_stale=True`` to remove dead runs' exchange dirs (runs against
+    one store are sequential — a surviving dir is garbage, not a peer)."""
+    if sweep_stale:
+        for old in glob.glob(os.path.join(pod_dir, _XCH_PREFIX + "*")):
+            if os.path.basename(old) != _XCH_PREFIX + nonce:
+                shutil.rmtree(old, ignore_errors=True)
+    path = os.path.join(pod_dir, _XCH_PREFIX + nonce)
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+__all__ = ["HeartbeatWriter", "HostLostError", "PeerMonitor",
+           "PodSupervisor", "exchange_dir", "hard_exit_if_host_lost",
+           "heartbeat_interval_s", "heartbeat_path", "heartbeat_timeout_s",
+           "negotiate_run_nonce", "resume_heartbeats", "saw_host_loss",
+           "suspend_heartbeats"]

@@ -30,6 +30,11 @@ Fault kinds:
   plane (resilience/watchdog.py) is what turns this into a recoverable
   cancellation; without a watchdog the seat genuinely hangs, which is
   the point.
+- ``hostloss``: suspend this process's pod heartbeats
+  (resilience/coordinator.suspend_heartbeats), then sleep ``stall_s`` —
+  a wedged host that is alive but silent.  Peers declare it lost through
+  the production heartbeat monitor and fail its digest range over;
+  ``kill`` covers the dead-process variant of the same failure.
 """
 
 from __future__ import annotations
@@ -56,7 +61,7 @@ class InjectedConnectionDrop(ConnectionError, InjectedFault):
 
 
 _KINDS = ("raise", "connection_drop", "delay", "torn_write", "kill",
-          "stall")
+          "stall", "hostloss")
 
 
 @dataclass
@@ -148,6 +153,12 @@ class FaultPlan:
             time.sleep(rule.delay_s)
             return
         if rule.kind == "stall":
+            time.sleep(rule.stall_s)
+            return
+        if rule.kind == "hostloss":
+            from .coordinator import suspend_heartbeats
+
+            suspend_heartbeats()
             time.sleep(rule.stall_s)
             return
         if rule.kind == "kill":
