@@ -87,13 +87,18 @@ def run_store(args) -> int:
 
 def run_pod(args) -> int:
     """Pod-supervised store-enabled clustering (cli.run_pod_cluster):
-    under TSE1M_COORDINATOR/…_NUM_PROCESSES each spawned process brings
-    up jax.distributed, shards the signature store by digest range,
-    beats heartbeats and supervises its peers — the production pod path,
-    end to end.  The chaos/CI drivers SIGKILL or wedge (``hostloss``
-    fault kind) one worker mid-run and assert the survivor fails over:
-    labels land in ``--out`` (.npy), run info in ``--info``, and manifest
-    fragments + the merged manifest under ``--result-dir``."""
+    under TSE1M_NUM_PROCESSES/…_PROCESS_ID each spawned process takes
+    its pod identity straight from the env — jax.distributed is NEVER
+    initialized, so no XLA coordination client exists to fatal a
+    survivor when a peer (including the leader) dies.  Each process
+    shards the signature store by digest range, beats heartbeats, holds
+    epoch leases and supervises its peers — the production pod path,
+    end to end.  The chaos/CI drivers SIGKILL, wedge (``hostloss``) or
+    wedge-then-wake (``zombie``) one worker mid-run and assert the
+    survivor fails over (promoting itself when the leader died) while
+    any woken zombie self-fences: labels land in ``--out`` (.npy), run
+    info in ``--info``, and manifest fragments + the merged manifest
+    under ``--result-dir``."""
     import json
     import os
 
@@ -106,22 +111,19 @@ def run_pod(args) -> int:
         jax.config.update("jax_platforms", "cpu")
     import numpy as np
 
-    from tse1m_tpu.parallel import multihost
-
-    distributed = multihost.initialize_from_env()
     from tse1m_tpu.cli import run_pod_cluster
     from tse1m_tpu.cluster import ClusterParams
     from tse1m_tpu.cluster.pipeline import last_run_info
     from tse1m_tpu.data.synth import synth_session_sets
     from tse1m_tpu.observability.merge import (fragment_manifest_path,
                                                merge_run_manifests)
+    from tse1m_tpu.parallel import multihost
     from tse1m_tpu.resilience import StepRunner
 
     items = synth_session_sets(args.n, set_size=16, seed=args.seed)[0]
     params = ClusterParams(n_hashes=32, n_bands=4, use_pallas="never",
                            sig_store=args.store_dir)
-    nproc = jax.process_count() if distributed else 1
-    pid = jax.process_index() if distributed else 0
+    nproc, pid = multihost.pod_process_env()
     if args.result_dir and nproc > 1:
         manifest_path = fragment_manifest_path(args.result_dir, pid)
     elif args.result_dir:
@@ -138,6 +140,8 @@ def run_pod(args) -> int:
                           if k != "stages"}}
 
     rec = runner.run("pod-cluster", step)
+    if (rec.result or {}).get("pod_epoch") is not None:
+        runner.set_meta(epoch=rec.result["pod_epoch"])
     if args.result_dir and nproc > 1:
         survivor = (rec.result or {}).get("pod_survivor")
         if pid == 0 or survivor == pid:
@@ -145,16 +149,14 @@ def run_pod(args) -> int:
 
             _await_fragments(args.result_dir, nproc)
             merge_run_manifests(args.result_dir, nproc)
-    from tse1m_tpu.resilience.coordinator import hard_exit_if_host_lost
-
     if rec.status != "ok":
-        return hard_exit_if_host_lost(1)
+        return 1
     np.save(args.out, box["labels"])
     if args.info:
         with open(args.info, "w") as f:
             json.dump(rec.result, f)
     print("POD_OK", pid, flush=True)
-    return hard_exit_if_host_lost(0)
+    return 0
 
 
 def run_compact(args) -> int:

@@ -189,9 +189,99 @@ def seat_heartbeat_timeout(store: str) -> dict:
     return _pod_loss_seat(KILL_WORKER_PLAN, expect_rc1=(SIGKILL,))
 
 
+def seat_zombie(store: str) -> dict:
+    """A wedged writer that WAKES after its range was reassigned: the
+    zombie must self-fence on its superseded epoch lease — zero appends
+    to the old range, a ``lease_superseded`` degradation in its own
+    fragment — while the survivor's labels stay elementwise-equal to an
+    uninterrupted run."""
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    import numpy as np
+    from pod_harness import (SIGKILL, cold_labels, make_zombie_waker,
+                             spawn_pod, zombie_plan)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        cold = cold_labels(tmp, n=800, seed=13)
+        store_dir = os.path.join(tmp, "store")
+        rdir = os.path.join(tmp, "results")
+        wake = os.path.join(tmp, "wake_zombie")
+        res = spawn_pod(tmp, store_dir, rdir, n=800, seed=13,
+                        plans={1: zombie_plan(wake)},
+                        expect_finish=(0, 1), straggler_timeout=240,
+                        on_poll=make_zombie_waker(store_dir, wake))
+        assert res[0]["rc"] == 0, res[0]["err"][-4000:]
+        assert np.array_equal(res[0]["labels"], cold), \
+            "failover labels diverged from the uninterrupted run"
+        info = res[0]["info"]
+        assert info["pod_survivor"] == 0 and info["pod_lost"] == [1], info
+        assert 1 in info["pod_reassigned_ranges"], info
+        # the woken zombie fenced: nonzero exit, no labels, and the
+        # lease_superseded event countable in its own fragment
+        assert res[1]["rc"] not in (0, SIGKILL), (
+            f"zombie rc={res[1]['rc']} — it must wake and self-fence, "
+            "not succeed or be killed wedged\n" + res[1]["err"][-2000:])
+        assert res[1]["labels"] is None, \
+            "fenced zombie must abandon the run, not emit labels"
+        frag = json.load(open(os.path.join(
+            rdir, "run_manifest.p001.json")))
+        counts1 = frag["degradation_counts"]
+        assert counts1.get("lease_superseded", 0) >= 1, counts1
+        merged = json.load(open(os.path.join(rdir, "run_manifest.json")))
+        counts = merged["degradation_counts"]
+        for kind in ("host_lost", "pod_failover", "epoch_advance"):
+            assert counts.get(kind, 0) >= 1, (kind, counts)
+        return {"ari_vs_planted": 1.0,
+                "degradation_events": sum(counts.values())
+                + counts1.get("lease_superseded", 0),
+                "degradation_counts": {**counts,
+                                       "lease_superseded":
+                                       counts1.get("lease_superseded")},
+                "chunk_halvings": 0, "store_scrub_corrupt": 0,
+                "store_scrub_quarantined": 0}
+
+
+def seat_leader_loss_promote(store: str) -> dict:
+    """SIGKILL the LEADER mid-run: worker 1 must promote itself over
+    the shared-filesystem plane (no XLA coordination client exists to
+    fatal it), advance the epoch, re-execute solo with labels
+    elementwise-equal to an uninterrupted run, and write the ONE merged
+    run_manifest.json — no respawn."""
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    import numpy as np
+    from pod_harness import KILL_WORKER_PLAN, SIGKILL, cold_labels, \
+        spawn_pod
+
+    with tempfile.TemporaryDirectory() as tmp:
+        cold = cold_labels(tmp, n=800, seed=13)
+        store_dir = os.path.join(tmp, "store")
+        rdir = os.path.join(tmp, "results")
+        res = spawn_pod(tmp, store_dir, rdir, n=800, seed=13,
+                        plans={0: KILL_WORKER_PLAN}, expect_finish=(1,))
+        assert res[0]["rc"] == SIGKILL, res[0]["rc"]
+        assert res[1]["rc"] == 0, res[1]["err"][-4000:]
+        assert np.array_equal(res[1]["labels"], cold), \
+            "promoted-leader labels diverged from the uninterrupted run"
+        info = res[1]["info"]
+        assert info["pod_survivor"] == 1 and info["pod_lost"] == [0], info
+        assert info["pod_promoted_leader"] is True, info
+        assert 0 in info["pod_reassigned_ranges"], info
+        merged = json.load(open(os.path.join(rdir, "run_manifest.json")))
+        counts = merged["degradation_counts"]
+        for kind in ("host_lost", "pod_failover", "leader_promoted",
+                     "epoch_advance", "shard_range_reassigned"):
+            assert counts.get(kind, 0) >= 1, (kind, counts)
+        assert merged["pod"]["missing"] == [0], merged["pod"]
+        return {"ari_vs_planted": 1.0,
+                "degradation_events": sum(counts.values()),
+                "degradation_counts": counts, "chunk_halvings": 0,
+                "store_scrub_corrupt": 0, "store_scrub_quarantined": 0}
+
+
 SEATS = {"stall": seat_stall, "oom": seat_oom, "kill": seat_kill,
          "corrupt-shard": seat_corrupt_shard, "hostloss": seat_hostloss,
-         "heartbeat-timeout": seat_heartbeat_timeout}
+         "heartbeat-timeout": seat_heartbeat_timeout,
+         "zombie": seat_zombie,
+         "leader-loss-promote": seat_leader_loss_promote}
 
 
 def main() -> int:

@@ -1,0 +1,25 @@
+"""Lease mutations that bypass the atomic-write helper or stamp
+wall-clock time (spoofed into the watchdog plane's name scope)."""
+import json
+import time
+
+
+def write_lease_direct(path, epoch, owner):
+    # BAD: a raw writable open can leave a TORN lease a reader
+    # misparses as absent — two writers could then hold one range.
+    with open(path, "w") as f:
+        json.dump({"epoch": epoch, "owner": owner}, f)
+
+
+def renew_lease_stamped(path, epoch):
+    # BAD x2: nonatomic write AND a wall-clock stamp (clocks are not
+    # comparable across hosts; fencing is by epoch only).
+    rec = {"epoch": epoch, "ts": time.time()}
+    with open(path, mode="w") as f:
+        json.dump(rec, f)
+
+
+def heartbeat_flush(path, seq):
+    # BAD: heartbeat files share the lease plane's atomicity contract.
+    with open(path, "a") as f:
+        f.write(str(seq))

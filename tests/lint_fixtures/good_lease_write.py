@@ -1,0 +1,26 @@
+"""Lease lifecycle through the blessed seats: atomic writes only, epochs
+instead of clocks, reads are plain opens."""
+import json
+
+from tse1m_tpu.utils.atomic import atomic_write
+
+
+def write_lease_atomic(path, epoch, owner, nonce):
+    # the one blessed mutation shape (resilience.coordinator.write_lease)
+    with atomic_write(path) as f:
+        json.dump({"epoch": epoch, "owner": owner, "nonce": nonce}, f)
+
+
+def read_lease_plain(path):
+    # reads never mutate; a read-mode open is out of scope
+    try:
+        with open(path, encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def unrelated_report_writer(path, payload):
+    # no lease/heartbeat semantics in the name: out of this rule's scope
+    with open(path, "w") as f:
+        json.dump(payload, f)

@@ -61,14 +61,23 @@ def _worker_env(base: dict, port: int, pid: int, nproc: int,
 
 def spawn_pod(tmp: str, store: str, result_dir: str, n: int = 800,
               seed: int = 13, plans: dict | None = None,
-              timeout: int = 480) -> dict:
+              timeout: int = 480, expect_finish=(0,),
+              straggler_timeout: int = 30, on_poll=None) -> dict:
     """Run one 2-process pod clustering; returns per-pid
     {rc, out, err, labels, info}.  ``plans`` maps pid -> fault plan dict
-    (installed only in that worker).  A worker still alive after the
-    others exit (a wedged ``hostloss`` host) is SIGKILLed — the fencing
-    a real scheduler provides."""
+    (installed only in that worker).  ``expect_finish`` names the pids
+    that must exit on their own (the survivors — with leader-loss
+    promotion that can be pid 1); once they have, any remaining worker
+    gets ``straggler_timeout`` seconds and is then SIGKILLed — the
+    fencing a real scheduler provides for a forever-wedged host.
+    ``on_poll`` (optional callable) runs each poll tick — the zombie
+    test uses it to touch the wake file once the survivor's epoch
+    advance is on disk."""
+    import time as _time
+
     port = free_port()
     plans = plans or {}
+    expect_finish = set(expect_finish)
     procs, outs, infos = [], [], []
     for pid in range(2):
         out = os.path.join(tmp, f"labels_p{pid}.npy")
@@ -83,18 +92,24 @@ def spawn_pod(tmp: str, store: str, result_dir: str, n: int = 800,
              "--seed", str(seed), "--result-dir", result_dir],
             cwd=REPO, env=env, stdout=subprocess.PIPE,
             stderr=subprocess.PIPE, text=True))
+
+    def _poll_until(pids, deadline) -> None:
+        while _time.monotonic() < deadline:
+            if on_poll is not None:
+                on_poll()
+            if all(procs[p].poll() is not None for p in pids):
+                return
+            _time.sleep(0.25)
+
+    _poll_until(expect_finish, _time.monotonic() + timeout)
+    _poll_until({0, 1}, _time.monotonic() + straggler_timeout)
     results: dict[int, dict] = {}
-    # Reap process 0 first: in the loss scenarios it is the survivor and
-    # the wedged peer never exits on its own.
     for pid in (0, 1):
         p = procs[pid]
-        try:
-            out_s, err_s = p.communicate(timeout=timeout)
-        except subprocess.TimeoutExpired:
+        if p.poll() is None:
             p.kill()
-            out_s, err_s = p.communicate()
+        out_s, err_s = p.communicate(timeout=60)
         results[pid] = {"rc": p.returncode, "out": out_s, "err": err_s}
-        timeout = 30  # the rest either already exited or are wedged
     import numpy as np
 
     for pid in (0, 1):
@@ -162,3 +177,34 @@ KILL_WORKER_PLAN = {"rules": [{"site": "pipeline.h2d", "kind": "kill"}]}
 WEDGE_WORKER_PLAN = {"rules": [{"site": "pipeline.h2d",
                                 "kind": "hostloss", "stall_s": 300}]}
 SIGKILL = -signal.SIGKILL
+
+
+def zombie_plan(wake_path: str, stall_s: float = 240.0) -> dict:
+    """A wedged-then-woken writer: heartbeats suspend at the first H2D
+    put, the process sleeps until ``wake_path`` appears (the parent
+    touches it once the survivor's epoch advance is on disk — see
+    ``make_zombie_waker``), then heartbeats resume and the writer
+    continues straight into its superseded-lease append."""
+    return {"rules": [{"site": "pipeline.h2d", "kind": "zombie",
+                       "stall_s": stall_s, "wake_path": wake_path}]}
+
+
+def make_zombie_waker(store: str, wake_path: str):
+    """An ``on_poll`` callback: touch ``wake_path`` once the pod's
+    membership ledger shows an advanced epoch (>= 1) — i.e. the
+    survivor has re-dealt the zombie's range and superseded its lease,
+    so waking it now deterministically exercises the fence."""
+    membership = os.path.join(store, "pod", "membership.json")
+
+    def _tick() -> None:
+        if os.path.exists(wake_path):
+            return
+        try:
+            with open(membership) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            return
+        if int(rec.get("epoch", 0)) >= 1:
+            open(wake_path, "w").close()
+
+    return _tick

@@ -1,0 +1,385 @@
+"""Elastic pod membership units: epoch leases + zombie fencing
+(resilience/coordinator.py, cluster/store.ShardedSignatureStore), the
+MembershipLedger's elastic re-deal, the PeerMonitor replay guard and
+epoch-scoped latch, the quant-drop degradation rung, and the
+epoch-tagged manifest merge — everything here is in-process and fast;
+the real 2-process zombie / leader-promotion runs live in
+tests/test_pod_chaos.py (slow) and the CI fault-matrix ``zombie`` /
+``leader-loss-promote`` seats."""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from tse1m_tpu.cluster.store import ShardedSignatureStore, row_digests
+from tse1m_tpu.observability import pop_degradation_events
+from tse1m_tpu.observability.merge import (fragment_manifest_path,
+                                           merge_run_manifests)
+from tse1m_tpu.resilience.coordinator import (HeartbeatWriter,
+                                              LeaseSupersededError,
+                                              MembershipLedger, PeerMonitor,
+                                              acquire_lease, heartbeat_path,
+                                              read_lease, verify_lease,
+                                              write_lease)
+
+POLICY = {"n_hashes": 32, "seed": 13, "quant_bits": 0}
+
+
+# -- heartbeat replay guard ---------------------------------------------------
+
+
+def test_monitor_rejects_nonce_rollback(tmp_path):
+    """A stale heartbeat file replaying an ALREADY-SEEN nonce must not
+    resurrect a host — only a genuinely new nonce counts as an advance."""
+    d = str(tmp_path)
+    w = HeartbeatWriter(d, 1)
+    w.beat_once()
+    with open(heartbeat_path(d, 1)) as f:
+        stale = f.read()  # nonce A, seq 1
+    mon = PeerMonitor(d, n_processes=2, process_id=0, timeout_s=0.3)
+    assert mon.poll() == []  # nonce A observed
+    w2 = HeartbeatWriter(d, 1)  # restarted peer: nonce B
+    w2.beat_once()
+    assert mon.poll() == []  # nonce B is new: advance
+    # rollback: the stale nonce-A file resurfaces (restored backup / NFS
+    # cache) — it must NOT read as an advance, so the host times out
+    from tse1m_tpu.utils.atomic import atomic_write
+
+    with atomic_write(heartbeat_path(d, 1)) as f:
+        f.write(stale)
+    time.sleep(0.45)
+    assert mon.poll() == [1]
+
+
+def test_monitor_rejects_seq_regression(tmp_path):
+    """A regressed seq under the current nonce is a stale file, not a
+    live beat."""
+    d = str(tmp_path)
+    w = HeartbeatWriter(d, 1)
+    w.beat_once()
+    w.beat_once()
+    w.beat_once()  # seq 3
+    mon = PeerMonitor(d, n_processes=2, process_id=0, timeout_s=0.3)
+    assert mon.poll() == []
+    # regress the file to seq 1 under the SAME nonce
+    with open(heartbeat_path(d, 1)) as f:
+        rec = json.load(f)
+    from tse1m_tpu.utils.atomic import atomic_write
+
+    rec["seq"] = 1
+    with atomic_write(heartbeat_path(d, 1)) as f:
+        json.dump(rec, f)
+    time.sleep(0.45)
+    assert mon.poll() == [1]
+
+
+def test_monitor_epoch_scoped_latch_readmits_new_nonce(tmp_path):
+    """Lost in epoch N, alive in epoch N+1 — but only via a NEW nonce;
+    the stale file stays dead across the epoch boundary."""
+    d = str(tmp_path)
+    w = HeartbeatWriter(d, 1)
+    w.beat_once()
+    mon = PeerMonitor(d, n_processes=2, process_id=0, timeout_s=0.3)
+    mon.poll()
+    time.sleep(0.45)
+    assert mon.poll() == [1]          # lost in epoch 0
+    w.beat_once()
+    assert mon.poll() == [1]          # latched within the epoch
+    assert mon.advance_epoch() == 1
+    assert mon.poll() == []           # fresh grace window in epoch 1
+    HeartbeatWriter(d, 1).beat_once()  # NEW nonce: genuinely re-admitted
+    time.sleep(0.45)
+    assert mon.poll() == []
+    assert mon.ever_lost() == [1]     # history keeps the epoch-0 loss
+
+
+def test_monitor_epoch_advance_stale_file_times_out_again(tmp_path):
+    d = str(tmp_path)
+    w = HeartbeatWriter(d, 1)
+    w.beat_once()
+    mon = PeerMonitor(d, n_processes=2, process_id=0, timeout_s=0.3)
+    mon.poll()
+    time.sleep(0.45)
+    assert mon.poll() == [1]
+    mon.advance_epoch()
+    # nothing new on disk: the old nonce's file cannot resurrect the
+    # host in the new epoch either
+    time.sleep(0.45)
+    assert mon.poll() == [1]
+
+
+# -- membership ledger --------------------------------------------------------
+
+
+def test_ledger_fresh_bootstrap_matches_modulo_deal(tmp_path):
+    led = MembershipLedger(str(tmp_path), n_ranges=4)
+    rec = led.bootstrap([0, 1], "n0")
+    assert rec["epoch"] == 0 and rec["moved"] == []
+    assert rec["owners"] == {0: 0, 1: 1, 2: 0, 3: 1}  # == r % nproc
+
+
+def test_ledger_same_members_keeps_epoch_and_owners(tmp_path):
+    led = MembershipLedger(str(tmp_path), n_ranges=2)
+    a = led.bootstrap([0, 1], "n0")
+    b = led.bootstrap([0, 1], "n1")
+    assert b["epoch"] == a["epoch"] == 0
+    assert b["owners"] == a["owners"] and b["moved"] == []
+    assert b["nonce"] == "n1"
+
+
+def test_ledger_loss_advance_moves_only_lost_ranges(tmp_path):
+    led = MembershipLedger(str(tmp_path), n_ranges=4)
+    led.bootstrap([0, 1], "n0")
+    pop_degradation_events()
+    rec = led.advance([0], "n1", reason="host_lost")
+    assert rec["epoch"] == 1
+    assert rec["owners"] == {0: 0, 1: 0, 2: 0, 3: 0}
+    assert rec["moved"] == [1, 3]  # only the lost host's ranges moved
+    kinds = [e["kind"] for e in pop_degradation_events()]
+    assert "epoch_advance" in kinds
+
+
+def test_ledger_recovery_readmits_with_minimal_moves(tmp_path):
+    led = MembershipLedger(str(tmp_path), n_ranges=4)
+    led.bootstrap([0, 1], "n0")
+    led.advance([0], "n1", reason="host_lost")   # epoch 1: all -> 0
+    pop_degradation_events()
+    rec = led.bootstrap([0, 1], "n2")            # host 1 recovered
+    assert rec["epoch"] == 2
+    # elastic: process 0 keeps its balanced share; only the overflow
+    # re-deals to the re-admitted member
+    assert sorted(rec["moved"]) == [r for r, o in rec["owners"].items()
+                                    if o == 1]
+    assert sum(1 for o in rec["owners"].values() if o == 0) == 2
+    assert sum(1 for o in rec["owners"].values() if o == 1) == 2
+    kinds = [e["kind"] for e in pop_degradation_events()]
+    assert "epoch_advance" in kinds and "host_readmitted" in kinds
+
+
+def test_ledger_epoch_is_monotonic_across_changes(tmp_path):
+    led = MembershipLedger(str(tmp_path), n_ranges=2)
+    epochs = [led.bootstrap([0, 1], "a")["epoch"],
+              led.advance([1], "b", reason="host_lost")["epoch"],
+              led.bootstrap([0, 1], "c")["epoch"]]
+    assert epochs == sorted(epochs) and len(set(epochs)) == 3
+
+
+def test_ledger_wait_for_adopts_matching_nonce(tmp_path):
+    led = MembershipLedger(str(tmp_path), n_ranges=2)
+    led.bootstrap([0, 1], "want")
+    rec = led.wait_for("want", timeout_s=1.0)
+    assert rec["nonce"] == "want"
+    with pytest.raises(TimeoutError):
+        led.wait_for("other", timeout_s=0.3)
+
+
+# -- leases -------------------------------------------------------------------
+
+
+def test_lease_acquire_verify_roundtrip(tmp_path):
+    root = str(tmp_path)
+    acquire_lease(root, 0, epoch=0, owner=1, nonce="n")
+    assert read_lease(root, 0) == {"range": 0, "epoch": 0, "owner": 1,
+                                   "nonce": "n"}
+    verify_lease(root, 0, epoch=0, owner=1, nonce="n")  # no raise
+
+
+def test_lease_superseded_by_later_epoch(tmp_path):
+    root = str(tmp_path)
+    acquire_lease(root, 0, epoch=0, owner=1, nonce="old")
+    acquire_lease(root, 0, epoch=1, owner=0, nonce="new")  # re-deal
+    with pytest.raises(LeaseSupersededError) as ei:
+        verify_lease(root, 0, epoch=0, owner=1, nonce="old")
+    assert ei.value.current["epoch"] == 1
+    with pytest.raises(LeaseSupersededError):
+        acquire_lease(root, 0, epoch=0, owner=1, nonce="old")
+
+
+def test_lease_same_epoch_conflicting_owner_refuses(tmp_path):
+    root = str(tmp_path)
+    acquire_lease(root, 0, epoch=2, owner=0, nonce="a")
+    with pytest.raises(LeaseSupersededError):
+        acquire_lease(root, 0, epoch=2, owner=1, nonce="b")
+    # same owner, fresh run nonce: a clean re-run refreshes
+    acquire_lease(root, 0, epoch=2, owner=0, nonce="c")
+    verify_lease(root, 0, epoch=2, owner=0, nonce="c")
+
+
+def test_lease_missing_or_wrong_nonce_fences(tmp_path):
+    root = str(tmp_path)
+    with pytest.raises(LeaseSupersededError):
+        verify_lease(root, 3, epoch=0, owner=0, nonce="n")  # absent
+    write_lease(root, 3, epoch=0, owner=0, nonce="other-run")
+    with pytest.raises(LeaseSupersededError):
+        verify_lease(root, 3, epoch=0, owner=0, nonce="n")
+
+
+# -- lease-fenced sharded store ----------------------------------------------
+
+
+def _items(n: int, seed: int = 0) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    return rng.integers(1, 2**20, size=(n, 16), dtype=np.uint32)
+
+
+def _membership(epoch, owners, nonce="n", moved=()):
+    return {"epoch": epoch, "nonce": nonce, "owners": owners,
+            "members": sorted(set(owners.values())),
+            "moved": list(moved)}
+
+
+def test_sharded_store_membership_ownership_and_leases(tmp_path):
+    root = os.path.join(str(tmp_path), "pod")
+    m0 = _membership(0, {0: 0, 1: 1})
+    s0 = ShardedSignatureStore(root, POLICY, n_processes=2, process_id=0,
+                               n_ranges=2, membership=m0)
+    assert s0.owned == [0]
+    assert read_lease(root, 0)["owner"] == 0  # acquired at open
+    assert read_lease(root, 1) is None        # not ours to take
+    items = _items(100)
+    d = row_digests(items)
+    sigs = np.arange(100 * 32, dtype=np.uint32).reshape(100, 32)
+    assert s0.append(d, sigs) > 0  # valid lease: appends fine
+
+
+def test_zombie_append_self_fences_with_zero_writes(tmp_path):
+    """The tentpole contract, in-process: a writer holding an epoch-0
+    lease whose range is re-dealt at epoch 1 must raise
+    LeaseSupersededError at append, demote to read-only, write ZERO
+    rows, and record the lease_superseded degradation event."""
+    root = os.path.join(str(tmp_path), "pod")
+    zombie = ShardedSignatureStore(root, POLICY, n_processes=2,
+                                   process_id=1, n_ranges=2,
+                                   membership=_membership(
+                                       0, {0: 0, 1: 1}, nonce="z"))
+    # survivor advances the epoch and takes over range 1
+    survivor = ShardedSignatureStore(root, POLICY, n_processes=1,
+                                     process_id=0, n_ranges=2,
+                                     membership=_membership(
+                                         1, {0: 0, 1: 0}, nonce="s",
+                                         moved=[1]))
+    assert survivor.owned == [0, 1]
+    assert 1 in survivor.reassigned_ranges
+    items = _items(200, seed=3)
+    d = row_digests(items)
+    sigs = np.arange(200 * 32, dtype=np.uint32).reshape(200, 32)
+    pop_degradation_events()
+    with pytest.raises(LeaseSupersededError):
+        zombie.append(d, sigs)
+    assert zombie.fenced and zombie.owned == []
+    # zero appends: the superseded range holds exactly what it held
+    # (a legacy open without membership reads without touching leases)
+    reader = ShardedSignatureStore(root, POLICY, n_processes=1,
+                                   process_id=0)
+    assert reader.range_store(1).n_rows == 0
+    events = pop_degradation_events()
+    assert any(e["kind"] == "lease_superseded" for e in events)
+    # a fenced store appends nothing even if asked again
+    assert zombie.append(d, sigs) == 0
+    # the survivor's own append still works (it holds the epoch-1 lease)
+    assert survivor.append(d, sigs) > 0
+
+
+def test_legacy_writer_against_leased_root_fences(tmp_path):
+    """An un-leased (legacy/modulo) open against a root an epoch plane
+    governs must fence at append — it cannot prove tenure."""
+    root = os.path.join(str(tmp_path), "pod")
+    ShardedSignatureStore(root, POLICY, n_processes=1, process_id=0,
+                          n_ranges=2,
+                          membership=_membership(0, {0: 0, 1: 0}))
+    legacy = ShardedSignatureStore(root, POLICY, n_processes=1,
+                                   process_id=0)
+    items = _items(50, seed=5)
+    with pytest.raises(LeaseSupersededError):
+        legacy.append(row_digests(items),
+                      np.zeros((50, 32), np.uint32))
+    assert legacy.fenced
+
+
+def test_unleased_root_legacy_append_still_works(tmp_path):
+    """No membership, no lease files: the pre-epoch contract holds for
+    direct opens (tests, scrub, fresh single-host-style roots)."""
+    root = os.path.join(str(tmp_path), "pod")
+    s = ShardedSignatureStore(root, POLICY, n_processes=1, process_id=0,
+                              n_ranges=2)
+    items = _items(50, seed=7)
+    assert s.append(row_digests(items),
+                    np.zeros((50, 32), np.uint32)) > 0
+
+
+# -- epoch-tagged manifest merge (mid-run membership change) ------------------
+
+
+def _fragment(ok, counts, steps, epoch=None):
+    frag = {"ok": ok, "degradation_counts": counts, "steps": steps,
+            "summary": {"ok": len(steps)}, "started_at": "t",
+            "wall_seconds": 1.0}
+    if epoch is not None:
+        frag["epoch"] = epoch
+    return frag
+
+
+def test_merge_tags_steps_with_epochs_and_sums_once(tmp_path):
+    """A host that re-admits in epoch N+1 appears process-tagged WITH
+    its epoch, and degradation_counts sums across epochs without
+    double-counting (each fragment's events are counted exactly once)."""
+    d = str(tmp_path)
+    with open(fragment_manifest_path(d, 0), "w") as f:
+        json.dump(_fragment(True, {"host_lost": 1, "epoch_advance": 1},
+                            [{"step": "cluster", "status": "ok"}],
+                            epoch=0), f)
+    with open(fragment_manifest_path(d, 1), "w") as f:
+        json.dump(_fragment(True, {"shard_range_reassigned": 2,
+                                   "epoch_advance": 1},
+                            [{"step": "cluster", "status": "ok"}],
+                            epoch=1), f)
+    merged = merge_run_manifests(d, 2)
+    assert merged["degradation_counts"] == {"host_lost": 1,
+                                            "epoch_advance": 2,
+                                            "shard_range_reassigned": 2}
+    by_pid = {s["process"]: s for s in merged["steps"]}
+    assert by_pid[0]["epoch"] == 0 and by_pid[1]["epoch"] == 1
+    assert merged["pod"]["epochs"] == {"0": 0, "1": 1}
+    assert merged["pod"]["epoch"] == 1
+
+
+def test_merge_without_epochs_stays_compatible(tmp_path):
+    d = str(tmp_path)
+    for pid in (0, 1):
+        with open(fragment_manifest_path(d, pid), "w") as f:
+            json.dump(_fragment(True, {}, [{"step": "s",
+                                            "status": "ok"}]), f)
+    merged = merge_run_manifests(d, 2)
+    assert merged["pod"]["epoch"] is None
+    assert all("epoch" not in s for s in merged["steps"])
+
+
+# -- pod pipeline under membership (single-process, in-process) ---------------
+
+
+def test_pod_pipeline_epoch_advances_on_readmission(tmp_path):
+    """Run the pod pipeline 2-process-shaped ledger history, then a solo
+    resume: the ledger advances and every range re-deals to the solo
+    process; labels equal a fresh run's."""
+    from tse1m_tpu.cluster import ClusterParams
+    from tse1m_tpu.cluster.pipeline import cluster_sessions_pod, \
+        last_run_info
+    from tse1m_tpu.data.synth import synth_session_sets
+
+    items, _ = synth_session_sets(300, set_size=16, seed=13)
+    root = os.path.join(str(tmp_path), "pod_store")
+    params = ClusterParams(n_hashes=32, n_bands=4, use_pallas="never",
+                           sig_store=root)
+    # seed a 2-member epoch history (as if a 2-process run created it)
+    MembershipLedger(os.path.join(root, "pod"), 1).bootstrap([0, 1], "h0")
+    labels = cluster_sessions_pod(items, 300, params)
+    assert last_run_info["pod_epoch"] == 1  # advanced at readmission
+    labels2 = cluster_sessions_pod(items, 300, params)
+    np.testing.assert_array_equal(labels, labels2)
+    assert last_run_info["cache_hit_rate"] == 1.0
+    assert last_run_info["pod_epoch"] == 1  # unchanged members: no advance

@@ -46,6 +46,8 @@ def _rule_findings(rule: str, filename: str, relpath: str | None = None):
      "tse1m_tpu/collect/fixture.py"),
     ("watchdog-clock", "bad_watchdog_clock.py", "good_watchdog_clock.py",
      "tse1m_tpu/cluster/pipeline.py"),
+    ("watchdog-clock", "bad_lease_write.py", "good_lease_write.py",
+     "tse1m_tpu/cluster/store.py"),
 ])
 def test_rule_bad_fires_good_silent(rule, bad, good, spoof):
     assert _rule_findings(rule, bad, spoof), f"{rule} missed {bad}"

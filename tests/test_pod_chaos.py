@@ -1,13 +1,17 @@
 """Pod-scale chaos: REAL 2-process runs through the production pod path
 (tests/chaos_drivers.py ``pod`` via tests/pod_harness.py) — two workers
-bring up `jax.distributed`, shard the signature store by digest range,
-beat heartbeats, and exchange novel tails over the shared store root.
+take their pod identity from the env (jax.distributed never
+initialized), shard the signature store by digest range, beat
+heartbeats, hold epoch leases, and exchange novel tails over the shared
+store root.
 
-The headline assertion is the MapReduce-style failover contract: SIGKILL
-one worker mid-run and the surviving coordinator must re-execute the lost
-host's partition with its digest range reassigned, producing labels
-ELEMENTWISE-EQUAL to an uninterrupted run — and the merged
-run_manifest.json must say exactly what happened."""
+The headline assertions are the elastic-membership contracts: SIGKILL a
+worker (or the LEADER) mid-run and the surviving process must advance
+the membership epoch, re-execute the lost host's partition with its
+digest range re-dealt (promoting itself to leader when process 0 died),
+producing labels ELEMENTWISE-EQUAL to an uninterrupted run and one
+merged run_manifest.json — and a zombie writer woken after reassignment
+must self-fence on its superseded lease with zero appends."""
 
 from __future__ import annotations
 
@@ -18,8 +22,8 @@ import signal
 import numpy as np
 import pytest
 
-from pod_harness import (KILL_WORKER_PLAN, cold_labels, run_single_pod,
-                         spawn_pod)
+from pod_harness import (KILL_WORKER_PLAN, cold_labels, make_zombie_waker,
+                         run_single_pod, spawn_pod, zombie_plan)
 
 N, SEED = 800, 13
 
@@ -38,7 +42,8 @@ def test_two_process_pod_clean_then_warm(tmp_path, cold):
     for '--sig-store is no longer dropped under a mesh')."""
     tmp = str(tmp_path)
     store = os.path.join(tmp, "store")
-    r1 = spawn_pod(tmp, store, os.path.join(tmp, "r1"), n=N, seed=SEED)
+    r1 = spawn_pod(tmp, store, os.path.join(tmp, "r1"), n=N, seed=SEED,
+                   expect_finish=(0, 1))
     for pid in (0, 1):
         assert r1[pid]["rc"] == 0, r1[pid]["err"][-3000:]
         np.testing.assert_array_equal(r1[pid]["labels"], cold)
@@ -48,7 +53,8 @@ def test_two_process_pod_clean_then_warm(tmp_path, cold):
                   + r1[1]["info"]["pod_owned_ranges"]) == [0, 1]
 
     # warm re-run over the same corpus: every row is cached pod-wide
-    r2 = spawn_pod(tmp, store, os.path.join(tmp, "r2"), n=N, seed=SEED)
+    r2 = spawn_pod(tmp, store, os.path.join(tmp, "r2"), n=N, seed=SEED,
+                   expect_finish=(0, 1))
     for pid in (0, 1):
         assert r2[pid]["rc"] == 0, r2[pid]["err"][-3000:]
         np.testing.assert_array_equal(r2[pid]["labels"], cold)
@@ -68,8 +74,9 @@ def test_two_process_pod_clean_then_warm(tmp_path, cold):
     # merged manifest: both fragments folded, pod-wide ok
     m = json.load(open(os.path.join(tmp, "r2", "run_manifest.json")))
     assert m["ok"] is True
-    assert m["pod"] == {"n_processes": 2, "merged_from": [0, 1],
-                        "missing": []}
+    assert m["pod"]["n_processes"] == 2
+    assert m["pod"]["merged_from"] == [0, 1]
+    assert m["pod"]["missing"] == []
     assert {s["process"] for s in m["steps"]} == {0, 1}
 
 
@@ -106,23 +113,77 @@ def test_sigkill_worker_failover_labels_match_uninterrupted(tmp_path,
 
 
 @pytest.mark.slow
-def test_leader_death_fences_pod_and_respawn_recovers(tmp_path, cold):
-    """Process 0 hosts the XLA coordination service: its death fences
-    EVERY worker within seconds (the client's error-poll fatal — no
-    heartbeat can outrun a closed socket), so in-process failover is a
-    worker-loss tool only.  The recovery contract is the scheduler's
-    respawn: a fresh run against the same sharded root inherits every
-    digest range and produces labels elementwise-equal to an
-    uninterrupted run."""
+def test_leader_death_promotes_survivor_no_respawn(tmp_path, cold):
+    """SIGKILL the LEADER (process 0) mid-run: the pod plane has no XLA
+    coordination client to fatal the survivor, so worker 1 declares the
+    loss through the heartbeat monitor, PROMOTES itself (advancing the
+    membership epoch — leader death is one more reassignment), re-
+    executes solo with labels elementwise-equal to an uninterrupted run,
+    and writes the one merged run_manifest.json.  No respawn involved."""
     tmp = str(tmp_path)
     store = os.path.join(tmp, "store")
-    res = spawn_pod(tmp, store, os.path.join(tmp, "r"), n=N, seed=SEED,
-                    plans={0: KILL_WORKER_PLAN})
+    rdir = os.path.join(tmp, "r")
+    res = spawn_pod(tmp, store, rdir, n=N, seed=SEED,
+                    plans={0: KILL_WORKER_PLAN}, expect_finish=(1,))
     assert res[0]["rc"] == -signal.SIGKILL
-    assert res[1]["rc"] != 0, "worker 1 must not report success after " \
-                              "losing the coordination service"
-    # scheduler respawn: single process, same (now partial) store root
-    r = run_single_pod(tmp, store, n=N, seed=SEED)
-    assert r["rc"] == 0, r["err"][-3000:]
-    np.testing.assert_array_equal(r["labels"], cold)
-    assert r["info"]["pod_n_ranges"] == 2  # sharded topology inherited
+    assert res[1]["rc"] == 0, res[1]["err"][-4000:]
+    np.testing.assert_array_equal(res[1]["labels"], cold)
+    info = res[1]["info"]
+    assert info["pod_survivor"] == 1 and info["pod_lost"] == [0]
+    assert info["pod_promoted_leader"] is True
+    assert info["pod_epoch"] >= 1
+    assert 0 in info["pod_reassigned_ranges"]
+    # the promoted leader merged the fragments: one manifest, the dead
+    # leader recorded missing, the promotion countable
+    m = json.load(open(os.path.join(rdir, "run_manifest.json")))
+    assert m["pod"]["missing"] == [0]
+    for kind in ("host_lost", "pod_failover", "leader_promoted",
+                 "epoch_advance"):
+        assert m["degradation_counts"].get(kind, 0) >= 1, (kind, m)
+    # a later single-process run against the same root re-admits at the
+    # next epoch, fully warm and label-identical (elastic re-deal)
+    r2 = run_single_pod(tmp, store, n=N, seed=SEED)
+    assert r2["rc"] == 0, r2["err"][-3000:]
+    np.testing.assert_array_equal(r2["labels"], cold)
+    assert r2["info"]["cache_hit_rate"] == 1.0
+
+
+@pytest.mark.slow
+def test_zombie_writer_self_fences_on_superseded_lease(tmp_path, cold):
+    """Wedge worker 1 at its first H2D put (heartbeats suspended), let
+    process 0 declare it lost and fail over (epoch advance supersedes
+    the zombie's range lease), then WAKE the zombie: it must self-fence
+    — LeaseSupersededError at its first append, read-only demotion,
+    ZERO rows appended to the superseded range — while the survivor's
+    labels equal the uninterrupted run elementwise."""
+    tmp = str(tmp_path)
+    store = os.path.join(tmp, "store")
+    rdir = os.path.join(tmp, "r")
+    wake = os.path.join(tmp, "wake_zombie")
+    res = spawn_pod(tmp, store, rdir, n=N, seed=SEED,
+                    plans={1: zombie_plan(wake)},
+                    expect_finish=(0, 1), straggler_timeout=240,
+                    on_poll=make_zombie_waker(store, wake))
+    assert res[0]["rc"] == 0, res[0]["err"][-4000:]
+    np.testing.assert_array_equal(res[0]["labels"], cold)
+    info = res[0]["info"]
+    assert info["pod_survivor"] == 0 and info["pod_lost"] == [1]
+    assert 1 in info["pod_reassigned_ranges"]
+    # the zombie woke, found its lease superseded, and exited nonzero
+    # WITHOUT writing labels (it abandoned the run at the fence)
+    assert res[1]["rc"] not in (0, -signal.SIGKILL), res[1]["rc"]
+    assert res[1]["labels"] is None
+    # its own fragment records the fence as a degradation event
+    frag = json.load(open(os.path.join(rdir, "run_manifest.p001.json")))
+    assert frag["degradation_counts"].get("lease_superseded", 0) >= 1, frag
+    step = frag["steps"][0]
+    assert step["status"] == "failed"
+    assert "LeaseSupersededError" in (step["error"] or "")
+    # zero zombie appends: every committed shard in the zombie's old
+    # range carries the survivor's appends only — a fresh run against
+    # the store is fully warm and label-identical (nothing corrupt,
+    # nothing double-written)
+    r2 = run_single_pod(tmp, store, n=N, seed=SEED)
+    assert r2["rc"] == 0, r2["err"][-3000:]
+    np.testing.assert_array_equal(r2["labels"], cold)
+    assert r2["info"]["cache_hit_rate"] == 1.0

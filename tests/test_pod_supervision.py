@@ -175,8 +175,9 @@ def test_merge_run_manifests_sums_counts_and_tags_steps(tmp_path):
     assert merged["degradation_counts"] == {"chunk_halving": 3,
                                             "host_lost": 1}
     assert [s["process"] for s in merged["steps"]] == [0, 1]
-    assert merged["pod"] == {"n_processes": 2, "merged_from": [0, 1],
-                             "missing": []}
+    assert merged["pod"]["n_processes"] == 2
+    assert merged["pod"]["merged_from"] == [0, 1]
+    assert merged["pod"]["missing"] == []
     on_disk = json.load(open(os.path.join(d, "run_manifest.json")))
     assert on_disk["degradation_counts"] == merged["degradation_counts"]
 

@@ -1,0 +1,127 @@
+"""The second degradation rung: RESOURCE_EXHAUSTED first drops
+`wire_quant_bits` one step down the b-bit ladder (arXiv:1205.2958 — 8-10
+bits retain accuracy) BEFORE chunk-halving, persists the surviving width
+to the machine calibration, clamps checkpointed resumes to the surviving
+policy, and restores full fidelity once the device heals (a clean run at
+the degraded width)."""
+
+from __future__ import annotations
+
+import os
+from dataclasses import replace
+
+import numpy as np
+import pytest
+
+from tse1m_tpu.cluster import ClusterParams, cluster_sessions
+from tse1m_tpu.cluster.pipeline import (_degraded_quant_floor,
+                                        _next_quant_rung,
+                                        _persist_quant_bits,
+                                        cluster_sessions_resumable,
+                                        last_run_info)
+from tse1m_tpu.data.synth import synth_session_sets
+from tse1m_tpu.observability import pop_degradation_events
+from tse1m_tpu.resilience.faults import FaultPlan
+
+PARAMS = dict(n_hashes=32, n_bands=4, use_pallas="never")
+
+
+@pytest.fixture(autouse=True)
+def _isolated_calibration(tmp_path, monkeypatch):
+    monkeypatch.setenv("TSE1M_ROUTER_CAL",
+                       os.path.join(str(tmp_path), "cal.json"))
+    pop_degradation_events()
+    yield
+    pop_degradation_events()
+
+
+def _oom_plan(times: int = 1) -> FaultPlan:
+    return FaultPlan.from_dict({"rules": [{
+        "site": "pipeline.h2d", "kind": "raise", "times": times,
+        "message": "RESOURCE_EXHAUSTED: injected allocation failure"}]})
+
+
+def test_next_quant_rung_ladder():
+    assert _next_quant_rung(0) == 10    # quantization off -> first rung
+    assert _next_quant_rung(16) == 10
+    assert _next_quant_rung(10) == 8
+    assert _next_quant_rung(8) is None  # out of rungs -> chunk halving
+
+
+def test_oom_drops_quant_bits_before_halving():
+    items = synth_session_sets(400, set_size=16, seed=13)[0]
+    with _oom_plan().active():
+        labels = cluster_sessions(items, ClusterParams(**PARAMS))
+    events = pop_degradation_events()
+    kinds = [e["kind"] for e in events]
+    assert "quant_drop" in kinds
+    assert "chunk_halving" not in kinds  # the quant rung fired FIRST
+    drop = next(e for e in events if e["kind"] == "quant_drop")
+    assert drop["detail"]["to_bits"] == 10
+    assert last_run_info["wire_quant_bits"] == 10
+    assert last_run_info["quant_drops"] == 1
+    # surviving width persisted: the next run starts degraded
+    assert _degraded_quant_floor() == 10
+    # label parity with an explicit 10-bit run: the whole stream
+    # restarted in one universe, no mixed-width chunks
+    ref = cluster_sessions(items, ClusterParams(**PARAMS,
+                                                wire_quant_bits=10))
+    np.testing.assert_array_equal(labels, ref)
+
+
+def test_degraded_floor_clamps_then_restores_on_heal():
+    items = synth_session_sets(300, set_size=16, seed=7)[0]
+    _persist_quant_bits(10)
+    cluster_sessions(items, ClusterParams(**PARAMS))  # clean, clamped
+    assert last_run_info["wire_quant_bits"] == 10
+    events = pop_degradation_events()
+    assert any(e["kind"] == "quant_restore" for e in events)
+    assert _degraded_quant_floor() == 0  # device healed: floor cleared
+    cluster_sessions(items, ClusterParams(**PARAMS))
+    assert last_run_info["wire_quant_bits"] == 0  # full fidelity again
+
+
+def test_store_runs_never_quant_drop(tmp_path):
+    """The store policy key pins quant_bits, so store-enabled runs must
+    answer OOM with chunk halving — never a mid-run universe change."""
+    items = synth_session_sets(400, set_size=16, seed=3)[0]
+    params = ClusterParams(**PARAMS,
+                           sig_store=os.path.join(str(tmp_path), "store"))
+    with _oom_plan().active():
+        cluster_sessions(items, params)
+    kinds = [e["kind"] for e in pop_degradation_events()]
+    assert "chunk_halving" in kinds
+    assert "quant_drop" not in kinds
+    assert last_run_info["wire_quant_bits"] == 0
+
+
+def test_checkpoint_resume_clamps_to_surviving_policy(tmp_path):
+    """A checkpoint written under a (degraded or explicit) quant width
+    must resume under an AUTO policy by adopting that width — the shards
+    hold signatures of that universe.  Explicit mismatches still refuse."""
+    items = synth_session_sets(300, set_size=16, seed=5)[0]
+    ckpt = os.path.join(str(tmp_path), "ckpt")
+    p10 = ClusterParams(**PARAMS, wire_quant_bits=10)
+    first = cluster_sessions_resumable(items, p10, checkpoint_dir=ckpt,
+                                       cleanup=False)
+    # an explicit DIFFERENT width still refuses (changed-policy guard)
+    with pytest.raises(ValueError):
+        cluster_sessions_resumable(
+            items, replace(p10, wire_quant_bits=8), checkpoint_dir=ckpt)
+    # auto adopts the surviving 10-bit policy instead of refusing
+    second = cluster_sessions_resumable(
+        items, ClusterParams(**PARAMS), checkpoint_dir=ckpt)
+    np.testing.assert_array_equal(first, second)
+
+
+def test_checkpoint_resume_unquantized_ignores_floor(tmp_path):
+    """A floor persisted AFTER an unquantized checkpoint was written
+    must not re-plan the resume into a different universe."""
+    items = synth_session_sets(300, set_size=16, seed=9)[0]
+    ckpt = os.path.join(str(tmp_path), "ckpt")
+    first = cluster_sessions_resumable(items, ClusterParams(**PARAMS),
+                                       checkpoint_dir=ckpt, cleanup=False)
+    _persist_quant_bits(10)  # degradation happened elsewhere meanwhile
+    second = cluster_sessions_resumable(items, ClusterParams(**PARAMS),
+                                        checkpoint_dir=ckpt)
+    np.testing.assert_array_equal(first, second)

@@ -214,7 +214,10 @@ def test_oom_halves_chunk_and_persists_calibration(tmp_path, monkeypatch):
     the NEXT run's stream plan starts below the observed ceiling."""
     monkeypatch.delenv("TSE1M_ROUTER_CAL", raising=False)
     items = synth_session_sets(2048, set_size=16, seed=3)[0]
-    params = _params(h2d_chunks=4)
+    # wire_quant_bits=-1 disables the quant-drop rung (tested in
+    # tests/test_quant_rung.py) so this test exercises halving in
+    # isolation — halving is the label-invariant rung.
+    params = _params(h2d_chunks=4, wire_quant_bits=-1)
     want = cluster_sessions(items, params)
     pop_degradation_events()
 

@@ -329,71 +329,121 @@ def _cmd_collect(args) -> int:
 
 def run_pod_cluster(items, n: int, params):
     """Pod-supervised store-enabled clustering (the `--sig-store`-under-
-    a-mesh path), shared by ``cli cluster`` and the chaos/CI drivers.
+    a-pod path), shared by ``cli cluster`` and the chaos/CI drivers.
 
-    Starts this process's heartbeat writer + peer monitor
-    (resilience/coordinator.py, beating under ``<sig_store>/pod/``),
+    Pod identity comes from the env (multihost.pod_process_env) — the
+    pod plane NEVER initializes jax.distributed, so no XLA coordination
+    client exists to fatal a survivor when a peer (including the
+    leader) dies.  The run opens this run's membership epoch
+    (resilience/coordinator.MembershipLedger: the leader bootstraps, re-
+    admitting any recovered host via the elastic range re-deal; peers
+    adopt the record), starts the heartbeat writer + peer monitor, and
     feeds this process's local row slice through
-    ``cluster_sessions_pod``, and supervises every cross-host phase: a
-    peer whose heartbeat stops is declared lost, and the lowest-id
-    survivor FAILS OVER — it re-executes the whole partition solo on its
-    local devices with the lost hosts' digest ranges reassigned
-    (``shard_range_reassigned`` events) — while every other survivor
-    exits loudly.
+    ``cluster_sessions_pod`` under epoch leases.
 
-    In-process failover covers lost WORKERS only.  Process 0 hosts the
-    XLA coordination service, and its client fatals every survivor
-    within ~1 s of the leader's socket closing — faster than any
-    heartbeat can observe — so a lost leader fences the whole pod and
-    recovery is the scheduler's respawn: a fresh run against the same
-    sharded store root inherits every digest range and recomputes
-    whatever the dead pod never appended (probe-as-miss), yielding the
-    exact labels an uninterrupted run would have (the leader-death chaos
-    test pins this).
+    Failure handling:
+
+    - A peer whose heartbeat stops is declared lost, and the lowest-id
+      survivor FAILS OVER: it advances the membership epoch (the lost
+      hosts' ranges re-deal to it, their old-epoch leases supersede) and
+      re-executes the whole partition solo — while every other survivor
+      exits loudly.  When process 0 is among the lost, the survivor
+      PROMOTES itself to leader (``leader_promoted`` event): it owns the
+      next-epoch topology and merges the manifest fragments after the
+      run — leader death is one more reassignment, not a pod-wide fence.
+    - A zombie — this process, wedged past reassignment and then woken —
+      finds its lease superseded at its first append and self-fences:
+      the store demotes to read-only (``lease_superseded`` event) and
+      the run aborts with LeaseSupersededError, zero rows double-
+      written.
 
     Returns ``(labels, pod_report)``; ``pod_report`` carries the
-    survivor/lost accounting for the merged manifest."""
+    survivor/epoch accounting for the merged manifest."""
     import numpy as np
 
-    import jax
-
     from .cluster.pipeline import cluster_sessions_pod
+    from .cluster.store import ShardedSignatureStore
     from .observability import record_degradation
     from .parallel import multihost
-    from .resilience.coordinator import (HostLostError, PodSupervisor,
+    from .resilience.coordinator import (HostLostError, LeaseSupersededError,
+                                         MembershipLedger, PodSupervisor,
                                          exchange_dir, negotiate_run_nonce)
 
-    nproc = jax.process_count()
-    pid = jax.process_index()
+    nproc, pid = multihost.pod_process_env()
     items = np.ascontiguousarray(items, dtype=np.uint32)
     pod: dict = {"pod_process_id": pid}
+    pod_dir = os.path.join(params.sig_store, "pod")
+    ledger = MembershipLedger(
+        pod_dir, ShardedSignatureStore.root_n_ranges(params.sig_store,
+                                                     default=nproc))
     if nproc == 1:
-        labels = cluster_sessions_pod(items, n, params)
+        # Single process: leader of a one-member pod.  Bootstrapping the
+        # ledger (rather than skipping it) is what re-admits this host —
+        # or inherits the dead peers' ranges — at an epoch boundary when
+        # the previous run had different members.
+        nonce = negotiate_run_nonce(None)
+        membership = ledger.bootstrap([pid], nonce)
+        labels = cluster_sessions_pod(items, n, params,
+                                      membership=membership,
+                                      n_processes=1, process_id=pid)
+        pod.update(pod_epoch=membership["epoch"])
         return labels, pod
-    sup = PodSupervisor(os.path.join(params.sig_store, "pod"),
-                        nproc, pid).start()
+    sup = PodSupervisor(pod_dir, nproc, pid).start()
+    nonce = None  # may still be unset when the leader dies pre-publish
     try:
         try:
-            nonce = negotiate_run_nonce(sup)
-            xch = exchange_dir(os.path.join(params.sig_store, "pod"),
-                               nonce, sweep_stale=pid == 0)
+            nonce = negotiate_run_nonce(sup, pod_dir=pod_dir)
+            if pid == 0:
+                membership = ledger.bootstrap(list(range(nproc)), nonce)
+            else:
+                membership = ledger.wait_for(nonce, monitor=sup.monitor)
+            sup.monitor.advance_epoch(membership["epoch"])
+            xch = exchange_dir(pod_dir, nonce, sweep_stale=pid == 0)
             lo, hi = multihost.pod_row_range(n, nproc, pid)
             labels = cluster_sessions_pod(items[lo:hi], n, params,
                                           supervisor=sup,
-                                          exchange_dir=xch)
+                                          exchange_dir=xch,
+                                          membership=membership,
+                                          n_processes=nproc,
+                                          process_id=pid)
+            pod.update(pod_epoch=membership["epoch"])
             return labels, pod
+        except LeaseSupersededError as e:
+            # This process is the zombie: its range was re-dealt while it
+            # was wedged.  The store already demoted itself to read-only
+            # and recorded the lease_superseded event — nothing was
+            # double-written; abort loudly so the fragment records it.
+            log.error("pod: this process is fenced (%s); exiting without "
+                      "appending", e)
+            raise
         except HostLostError as e:
             survivors = sup.survivors()
             if not survivors or pid != min(survivors):
                 raise  # one process fails over; the rest exit loudly
             record_degradation("pod_failover", site="cli.cluster",
                                detail={"lost": e.lost, "survivor": pid})
+            promoted = 0 in e.lost and pid != 0
+            if promoted:
+                record_degradation("leader_promoted", site="cli.cluster",
+                                   detail={"from_process": 0,
+                                           "to_process": pid})
+                log.warning("pod: leader (process 0) lost; process %d "
+                            "promoting itself — it owns the next epoch "
+                            "and merges the manifest fragments", pid)
+            membership = ledger.advance([pid], nonce or os.urandom(8).hex(),
+                                        reason="host_lost")
+            sup.monitor.advance_epoch(membership["epoch"])
             log.warning(
-                "pod: host(s) %s lost at %s; process %d failing over — "
-                "re-executing solo with their digest ranges reassigned",
-                e.lost, e.site, pid)
-            labels = cluster_sessions_pod(items, n, params, solo=True)
-            pod.update(pod_survivor=pid, pod_lost=e.lost)
+                "pod: host(s) %s lost at %s; process %d failing over at "
+                "epoch %d — re-executing solo with their digest ranges "
+                "re-dealt (superseded leases fence any zombie)",
+                e.lost, e.site, pid, membership["epoch"])
+            labels = cluster_sessions_pod(items, n, params, solo=True,
+                                          membership=membership,
+                                          process_id=pid)
+            pod.update(pod_survivor=pid, pod_lost=e.lost,
+                       pod_epoch=membership["epoch"],
+                       pod_promoted_leader=promoted)
             return labels, pod
     finally:
         sup.stop()
@@ -431,13 +481,26 @@ def _cmd_cluster(args) -> int:
 
     cfg = load_config()
     sig_store = args.sig_store or cfg.sig_store
-    # Distributed bring-up must precede any backend use (and decides
-    # which manifest this process writes).
-    distributed = multihost.initialize_from_env()
-    import jax
+    from .cluster.store import ShardedSignatureStore
 
-    pid = jax.process_index() if distributed else 0
-    nproc = jax.process_count() if distributed else 1
+    # Routing decides the runtime: the POD path (a signature store under
+    # a multi-process env, or an already-sharded root) carries its own
+    # file-based identity and NEVER initializes jax.distributed — no XLA
+    # coordination client means a dead leader cannot fatal the
+    # survivors.  Only the mesh (storeless multi-host) path brings the
+    # distributed runtime up, and that must precede any backend use.
+    env_nproc, env_pid = multihost.pod_process_env()
+    pod_route = bool(sig_store) and (
+        env_nproc > 1 or ShardedSignatureStore.is_sharded_root(sig_store))
+    if pod_route:
+        distributed = False
+        nproc, pid = env_nproc, env_pid
+    else:
+        distributed = multihost.initialize_from_env()
+        import jax
+
+        pid = jax.process_index() if distributed else 0
+        nproc = jax.process_count() if distributed else 1
     if nproc > 1:
         manifest_path = fragment_manifest_path(cfg.result_dir, pid)
         try:  # this process's stale fragment from a previous run
@@ -448,7 +511,9 @@ def _cmd_cluster(args) -> int:
         manifest_path = os.path.join(cfg.result_dir, "run_manifest.json")
     runner = StepRunner(manifest_path)
     rec = runner.run("cluster", _run_cluster_step, args, sig_store,
-                     distributed)
+                     distributed, pod_route)
+    if (rec.result or {}).get("pod_epoch") is not None:
+        runner.set_meta(epoch=rec.result["pod_epoch"])
     if nproc > 1:
         survivor = (rec.result or {}).get("pod_survivor")
         if pid == 0 or survivor == pid:
@@ -486,21 +551,21 @@ def _await_fragments(result_dir: str, nproc: int) -> None:
 
 
 def _run_cluster_step(args, sig_store: str | None,
-                      distributed: bool) -> dict:
+                      distributed: bool, pod_route: bool = False) -> dict:
     from .cluster import (ClusterParams, adjusted_rand_index,
                           cluster_sessions, host_cluster)
-    from .cluster.store import ShardedSignatureStore
     from .data.synth import synth_session_sets
     from .parallel import multihost
 
     items, truth = synth_session_sets(args.n, seed=args.seed)
     params = ClusterParams(seed=args.seed, sig_store=sig_store)
     pod_report: dict = {}
-    if sig_store and (distributed
-                      or ShardedSignatureStore.is_sharded_root(sig_store)):
-        # Pod path: per-host digest-range sharded store + supervision.
+    if pod_route:
+        # Pod path: per-host digest-range sharded store + supervision,
+        # identity from the env (jax.distributed never initialized).
         # (Single-process against a sharded root is the resumed-after-
-        # host-loss shape: this process inherits every range.)
+        # host-loss shape: the membership ledger re-deals every range
+        # to this process at the next epoch.)
         if args.checkpoint_dir:
             log.warning("--checkpoint-dir is ignored on the pod path: "
                         "the sharded signature store IS the durable "

@@ -112,6 +112,20 @@ class ClusterCheckpoint:
             return self.meta["n_chunks"]
         return -(-self.meta["n"] // self.meta["step"])
 
+    @staticmethod
+    def peek_meta(directory: str) -> dict | None:
+        """The existing manifest's meta (or None) WITHOUT constructing a
+        checkpoint — the resume path reads the surviving wire policy
+        from here (e.g. a degraded wire_quant_bits) before planning, so
+        an auto-policy resume clamps to what the shards actually hold
+        instead of refusing."""
+        path = os.path.join(directory, _MANIFEST)
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
     def _load_manifest(self) -> dict | None:
         if not os.path.exists(self._manifest_path):
             return None

@@ -174,16 +174,30 @@ def _validate_encoding(params: ClusterParams) -> None:
 
 
 def _quant_bits(items: np.ndarray, params: ClusterParams) -> int:
-    """Effective wire_quant_bits under the policy; 0 = off/no gain."""
+    """Effective wire_quant_bits under the policy; 0 = off/no gain.
+
+    Storeless runs additionally clamp to the degraded floor a previous
+    run's RESOURCE_EXHAUSTED quant-drop persisted to the machine
+    calibration (the second degradation rung, below) — the next run
+    starts at a wire width the device is known to hold.  Store-enabled
+    runs never clamp: the store policy key carries quant_bits, and a
+    drifting policy would refuse (or worse, poison) the cache."""
     b = params.wire_quant_bits
     if b < 0 or items.size == 0:
         return 0
     if b == 0:
         if items.nbytes < _AUTO_MIN_BYTES:
-            return 0
-        b = _AUTO_QUANT_BITS
-    if width_bits(int(items.max())) <= b:
-        return 0  # already at or below the target universe
+            b = 0
+        else:
+            b = _AUTO_QUANT_BITS
+    if b and width_bits(int(items.max())) <= b:
+        b = 0  # already at or below the target universe
+    if params.sig_store or params.wire_quant_bits < 0:
+        return b
+    floor = _degraded_quant_floor()
+    if floor and (b == 0 or floor < b) \
+            and items.size and width_bits(int(items.max())) > floor:
+        return floor
     return b
 
 
@@ -338,6 +352,47 @@ def _persist_chunk_bytes(step: int, items: np.ndarray) -> None:
     row_bytes = int(items.shape[1]) * items.itemsize
     update_calibration(calibration_path(),
                        wire={"chunk_bytes": int(step) * row_bytes})
+
+
+# Second degradation rung, tried BEFORE chunk-halving on storeless
+# streams: drop wire_quant_bits one step down the b-bit-minwise ladder
+# (arXiv:1205.2958 — 8-10 bits retain clustering accuracy), re-quantize
+# from the raw host buffer, and restart the stream in the smaller
+# universe.  The surviving width persists to the machine calibration so
+# the next run starts degraded; a later run that completes cleanly at
+# the degraded width restores full fidelity (the device healed).
+_QUANT_RUNGS = (10, 8)
+
+
+def _next_quant_rung(bits: int) -> int | None:
+    """One step down the quantization ladder; None when out of rungs.
+    ``bits <= 0`` (quantization off) engages the first rung."""
+    for rung in _QUANT_RUNGS:
+        if bits <= 0 or rung < bits:
+            return rung
+    return None
+
+
+def _degraded_quant_floor() -> int:
+    """The persisted degraded wire width (0 = none)."""
+    from ..utils.calibration import calibration_path, load_calibration
+
+    v = load_calibration(calibration_path())["wire"].get("quant_bits")
+    return int(v) if v else 0
+
+
+def _persist_quant_bits(bits: int) -> None:
+    from ..utils.calibration import calibration_path, update_calibration
+
+    update_calibration(calibration_path(), wire={"quant_bits": int(bits)})
+
+
+def _restore_quant_bits() -> None:
+    """Device heal: clear the degraded floor so the next run ships full-
+    fidelity ids again."""
+    from ..utils.calibration import calibration_path, update_calibration
+
+    update_calibration(calibration_path(), wire={"quant_bits": None})
 
 
 def _make_watchdog() -> StageWatchdog:
@@ -542,13 +597,21 @@ def _stream_minhash_degraded(rows: np.ndarray, a, b, params: ClusterParams,
                              rec: StageRecorder, want_decoded: bool,
                              sup: "_DeviceSupervisor | None" = None,
                              wd: StageWatchdog | None = None,
-                             initial_step: int | None = None):
+                             initial_step: int | None = None,
+                             quant_ctx: dict | None = None):
     """The degradation-aware chunk driver every streaming path feeds
     through: stream `rows` chunk-by-chunk (double-buffered when
     params.overlap), surviving OOM by chunk halving, stalls by watchdog
     cancel+retry, and device loss by CPU failover — completed chunks are
-    never recomputed.  Returns (parts [(sig, keys) per chunk], decoded
-    chunk list when want_decoded else None, per-chunk wire bits)."""
+    never recomputed.  ``quant_ctx`` (``{"raw": pre-quantization items,
+    "bits": current effective width}``, storeless callers only) arms the
+    quant-drop rung: the FIRST answer to RESOURCE_EXHAUSTED is one step
+    down the b-bit ladder — re-quantize from the raw buffer and restart
+    the stream in the smaller universe (all chunks must share one
+    universe, so completed chunks are discarded) — and only past the
+    last rung does chunk halving engage.  Returns (parts [(sig, keys)
+    per chunk], decoded chunk list when want_decoded else None,
+    per-chunk wire bits)."""
     n = rows.shape[0]
     step = initial_step or _stream_plan(rows, params)
     wd = wd or _make_watchdog()
@@ -575,6 +638,32 @@ def _stream_minhash_degraded(rows: np.ndarray, a, b, params: ClusterParams,
             # Completed chunks are all full-step (only the final chunk is
             # short, and if it completed the loop completed).
             pos += done * step
+            if is_resource_exhausted(e) and quant_ctx is not None:
+                nxt = _next_quant_rung(int(quant_ctx.get("bits", 0)))
+                raw = quant_ctx.get("raw")
+                if (nxt is not None and raw is not None and raw.size
+                        and width_bits(int(raw.max())) > nxt):
+                    record_degradation(
+                        "quant_drop", site="pipeline.stream",
+                        detail={"from_bits": int(quant_ctx.get("bits", 0)),
+                                "to_bits": int(nxt),
+                                "error": f"{type(e).__name__}: {e}"[:200]})
+                    log.warning(
+                        "pipeline.stream: RESOURCE_EXHAUSTED; dropping "
+                        "wire_quant_bits %s -> %d and restarting the "
+                        "stream (b-bit rung before chunk halving)",
+                        quant_ctx.get("bits", 0) or "off", nxt)
+                    quant_ctx["bits"] = int(nxt)
+                    rows = quantize_ids(raw, nxt)
+                    last_run_info["wire_quant_bits"] = int(nxt)
+                    last_run_info["quant_drops"] = (
+                        last_run_info.get("quant_drops", 0) + 1)
+                    _persist_quant_bits(nxt)
+                    parts.clear()
+                    decoded.clear()
+                    wire_bits.clear()
+                    pos = 0
+                    continue
             if is_resource_exhausted(e):
                 new_step = _halved_step(step, params)
                 if new_step is None:
@@ -861,6 +950,8 @@ def cluster_sessions(items, params: ClusterParams | None = None,
         return out
 
     items = np.ascontiguousarray(items, dtype=np.uint32)
+    raw_items = items  # pre-quantization buffer (the quant-drop rung
+    #                    re-quantizes from here; _plan_wire never mutates)
     rec = StageRecorder()
     t_all = time.perf_counter()
     last_run_info.clear()
@@ -869,6 +960,8 @@ def cluster_sessions(items, params: ClusterParams | None = None,
     items, enc, qbits = _plan_wire(items, params)
     rec.add("encode", time.perf_counter() - t0)
     last_run_info.update(wire_quant_bits=qbits)
+    clamped = (params.sig_store is None and params.wire_quant_bits == 0
+               and qbits and qbits == _degraded_quant_floor())
     if enc is not None:
         last_run_info.update(
             encoding="delta", encode_s=round(time.perf_counter() - t0, 4),
@@ -879,13 +972,27 @@ def cluster_sessions(items, params: ClusterParams | None = None,
         return out
 
     last_run_info.update(encoding="plain")
-    sig, keys = _minhash_streamed(items, a, b, params, rec)
+    # The quant-drop rung is storeless-only (a store's policy key pins
+    # quant_bits — a mid-run drop would poison every cached signature)
+    # and respects an explicit wire_quant_bits=-1 ("never quantize").
+    quant_ctx = ({"raw": raw_items, "bits": qbits}
+                 if params.sig_store is None
+                 and params.wire_quant_bits >= 0 else None)
+    sig, keys = _minhash_streamed(items, a, b, params, rec,
+                                  quant_ctx=quant_ctx)
     with rec.stage("compute"):
         labels = _cluster_from_sig_jit(sig, keys, params.threshold,
                                        params.n_iters)
         jax.block_until_ready(labels)
     with rec.stage("d2h", nbytes=labels.size * 4):
         out = np.asarray(labels)
+    if (clamped and not last_run_info.get("quant_drops")
+            and not last_run_info.get("chunk_halvings")):
+        # Device heal: a full run held the degraded width with zero
+        # pressure — restore full fidelity for the next run.
+        record_degradation("quant_restore", site="pipeline.stream",
+                           detail={"from_bits": int(qbits)})
+        _restore_quant_bits()
     _record_wire(rec)
     _finish_run(rec, t_all)
     return out
@@ -932,6 +1039,22 @@ def cluster_sessions_resumable(items, params: ClusterParams | None = None,
     n = items.shape[0]
     if n == 0:
         return np.empty(0, np.int32)
+    if params.wire_quant_bits == 0 and params.sig_store is None:
+        # Clamp an auto-policy resume to the SURVIVING wire policy: the
+        # shards hold signatures of the universe the previous attempt
+        # actually used (possibly a degraded quant width from the
+        # RESOURCE_EXHAUSTED rung, possibly none), and an auto re-plan
+        # that resolves differently would refuse the resume.  Explicit
+        # widths still refuse on mismatch — that contract is the guard
+        # against genuinely changed policy.
+        prior_meta = ClusterCheckpoint.peek_meta(checkpoint_dir)
+        if prior_meta is not None:
+            from dataclasses import replace
+
+            prior_bits = int(prior_meta.get("wire_quant_bits", 0) or 0)
+            params = replace(params,
+                             wire_quant_bits=prior_bits if prior_bits
+                             else -1)
     digests = None
     if params.sig_store:
         # Warm-merge runs touch the device only for the novel tail and
@@ -1083,7 +1206,7 @@ def cluster_sessions_resumable(items, params: ClusterParams | None = None,
 
 
 def _minhash_streamed(items: np.ndarray, a, b, params: ClusterParams,
-                      rec: StageRecorder):
+                      rec: StageRecorder, quant_ctx: dict | None = None):
     """items -> (signatures, band keys), overlapping encode + H2D with
     compute.
 
@@ -1096,7 +1219,8 @@ def _minhash_streamed(items: np.ndarray, a, b, params: ClusterParams,
     CPU failover) is label-invariant here.
     """
     parts, _, wire_bits = _stream_minhash_degraded(items, a, b, params, rec,
-                                                   want_decoded=False)
+                                                   want_decoded=False,
+                                                   quant_ctx=quant_ctx)
     last_run_info["chunk_bits"] = wire_bits
     if len(parts) == 1:
         return parts[0]
@@ -1417,12 +1541,13 @@ def _store_populate_from_run(params: ClusterParams, qbits: int,
 #
 # ``solo=True`` runs the same path with the exchange skipped: the
 # coordinator's failover shape — a survivor re-executing the whole
-# partition after peers were declared lost (jax.distributed has no
-# elastic membership).  The lost hosts' digest ranges open under this
-# process's ownership (`shard_range_reassigned` events) and their
-# un-appended rows probe as misses and recompute — the exact semantics
-# torn/corrupt shards already have, which is why failover labels equal an
-# uninterrupted run's elementwise.
+# partition after peers were declared lost.  Elastic membership lives in
+# resilience/coordinator.MembershipLedger: the survivor advances the
+# epoch, the lost hosts' digest ranges re-deal to it under fresh epoch
+# leases (`shard_range_reassigned` events, superseded leases fencing any
+# zombie that later wakes), and their un-appended rows probe as misses
+# and recompute — the exact semantics torn/corrupt shards already have,
+# which is why failover labels equal an uninterrupted run's elementwise.
 
 
 def cluster_sessions_pod(local_items, n_rows: int,
@@ -1430,7 +1555,10 @@ def cluster_sessions_pod(local_items, n_rows: int,
                          mesh: jax.sharding.Mesh | None = None,
                          axis: str = "data", supervisor=None,
                          exchange_dir: str | None = None,
-                         solo: bool = False) -> np.ndarray:
+                         solo: bool = False,
+                         membership: dict | None = None,
+                         n_processes: int | None = None,
+                         process_id: int | None = None) -> np.ndarray:
     """Store-enabled clustering across pod processes.
 
     ``local_items``: this process's host-resident LOGICAL rows — the
@@ -1441,7 +1569,17 @@ def cluster_sessions_pod(local_items, n_rows: int,
     HostLostError on a dead peer instead of hanging; ``exchange_dir`` is
     this run's negotiated exchange directory
     (resilience/coordinator.exchange_dir — required for multi-process
-    runs).  Returns the full [n_rows] label vector on every process."""
+    runs).  ``membership`` is this run's epoch record
+    (resilience/coordinator.MembershipLedger): it decides range
+    ownership and arms the lease fence — a writer whose range was
+    re-dealt raises LeaseSupersededError at its first append instead of
+    double-writing.  A local-only call without one self-bootstraps a
+    single-member ledger under the store's pod dir (advancing the epoch
+    when the previous run had more members — the resumed-after-loss
+    shape).  ``n_processes``/``process_id`` carry explicit pod identity
+    (multihost.pod_process_env) so the pod plane never has to touch
+    jax.distributed; they default to the jax identity for mesh callers.
+    Returns the full [n_rows] label vector on every process."""
     from ..parallel import multihost
     from ..parallel.mesh import shard_along
     from .sharded import _sharded_label_kernel_from_sig
@@ -1454,14 +1592,33 @@ def cluster_sessions_pod(local_items, n_rows: int,
                          "cluster_sessions for cold runs)")
     if mesh is None:
         mesh = jax.sharding.Mesh(np.array(jax.local_devices()), (axis,))
-    nproc = 1 if solo else jax.process_count()
-    pid = 0 if solo else jax.process_index()
+    nproc = (int(n_processes) if n_processes is not None
+             else (1 if solo else jax.process_count()))
+    pid = (int(process_id) if process_id is not None
+           else (0 if solo else jax.process_index()))
     local_only = solo or nproc == 1
     if not local_only and exchange_dir is None:
         raise ValueError("multi-process cluster_sessions_pod needs the "
                          "run's exchange_dir (negotiate it via "
                          "resilience.coordinator — cli.run_pod_cluster "
                          "does)")
+    if membership is None and local_only:
+        # Self-bootstrap a single-member epoch: a resumed/solo run
+        # against an existing pod root advances the ledger (the lost
+        # hosts' ranges re-deal to this process and their old-epoch
+        # leases supersede), and a fresh root starts at epoch 0.
+        from ..resilience.coordinator import MembershipLedger
+
+        ledger = MembershipLedger(
+            os.path.join(params.sig_store, "pod"),
+            ShardedSignatureStore.root_n_ranges(params.sig_store,
+                                                default=max(nproc, 1)))
+        membership = ledger.bootstrap([pid], os.urandom(8).hex())
+    if membership is None:
+        raise ValueError("multi-process cluster_sessions_pod needs the "
+                         "run's membership record (the epoch deal from "
+                         "resilience.coordinator.MembershipLedger — "
+                         "cli.run_pod_cluster negotiates it)")
     monitor = supervisor.monitor if supervisor is not None else None
 
     rec = StageRecorder()
@@ -1484,7 +1641,9 @@ def cluster_sessions_pod(local_items, n_rows: int,
         digests = row_digests(local_items)  # RAW ids, pre-quantization
         store = ShardedSignatureStore(params.sig_store,
                                       _store_policy(params, qbits),
-                                      n_processes=nproc, process_id=pid)
+                                      n_processes=1 if local_only else nproc,
+                                      process_id=pid,
+                                      membership=membership)
         hit, loc = store.probe(digests)
     sig_local = np.zeros((k_local, h), np.uint32)
     if hit.any():
@@ -1514,7 +1673,7 @@ def cluster_sessions_pod(local_items, n_rows: int,
         payloads = multihost.fs_exchange(
             exchange_dir, "novel", {"digests": digests, "miss": miss,
                                     "novel_sigs": sig_local[miss]},
-            monitor=monitor)
+            monitor=monitor, n_processes=nproc, process_id=pid)
     # Each digest range's OWNER appends its rows (single-writer per
     # range); duplicate content MinHashed by two hosts dedups in append.
     all_nd = np.concatenate([p["digests"][p["miss"].astype(bool)]
@@ -1531,9 +1690,10 @@ def cluster_sessions_pod(local_items, n_rows: int,
     # store (readable by every host — committed before this run, so the
     # read cannot race this run's appends).
     parts: list[np.ndarray] = []
+    my_slot = 0 if local_only else pid  # payload list index of this host
     with rec.stage("load", nbytes=(total_rows - k_local) * h * 4):
         for p, pay in enumerate(payloads):
-            if p == pid:  # pid is 0 on every local-only shape
+            if p == my_slot:
                 parts.append(sig_local)
                 continue
             pmiss = pay["miss"].astype(bool)
@@ -1559,7 +1719,10 @@ def cluster_sessions_pod(local_items, n_rows: int,
         pod_processes=nproc, pod_n_ranges=store.n_ranges,
         pod_owned_ranges=list(store.owned),
         pod_reassigned_ranges=list(store.reassigned_ranges),
-        pod_appended_rows=int(appended))
+        pod_appended_rows=int(appended),
+        pod_epoch=(int(membership["epoch"]) if membership else None),
+        pod_members=list(membership.get("members", []))
+        if membership else None)
     # Replicated tail on the LOCAL mesh: row-sharded signatures in,
     # replicated labels out — the sharded kernel family minus its MinHash
     # stage.  Pad rows carry zero signatures: they sit past every real

@@ -1050,15 +1050,28 @@ class ShardedSignatureStore:
 
     ``root`` holds ``pod_topology.json`` (range count + policy — the
     commit point, written once at creation) and one ``range_NNNN/``
-    SignatureStore per range.  This process owns — and exclusively
-    writes — the ranges ``{r : r % n_processes == process_id}``; all
-    other ranges open read-only on first touch.  ``reassigned_ranges``
-    lists owned ranges whose creation-topology owner is not a live
-    process id (a lost host's range this process inherited)."""
+    SignatureStore per range.  Ownership comes from the pod's
+    ``membership`` record (resilience/coordinator.MembershipLedger —
+    epoch, member set, range → owner deal) when one is passed; without
+    one (legacy/scrub opens) it falls back to the pure modulo deal
+    ``{r : r % n_processes == process_id}``.  All other ranges open
+    read-only on first touch.  ``reassigned_ranges`` lists owned ranges
+    that changed writer at this epoch (a lost host's range this process
+    inherited, or a range handed back to a re-admitted host).
+
+    With a membership record the store is **lease-fenced**: at open it
+    acquires the current-epoch lease (coordinator.acquire_lease) for
+    every owned range, and every :meth:`append` re-verifies tenure
+    first.  A writer whose lease was superseded — a zombie that woke
+    after its range was re-dealt — demotes itself to read-only and
+    raises :class:`~..resilience.coordinator.LeaseSupersededError`
+    (recorded as a ``lease_superseded`` degradation event) instead of
+    double-writing."""
 
     def __init__(self, root: str, policy: dict, n_processes: int = 1,
                  process_id: int = 0, n_ranges: int | None = None,
-                 max_bytes: int | None = None) -> None:
+                 max_bytes: int | None = None,
+                 membership: dict | None = None) -> None:
         if os.path.exists(os.path.join(root, _MANIFEST)):
             raise ValueError(
                 f"signature store at {root} is a single-host store "
@@ -1097,24 +1110,64 @@ class ShardedSignatureStore:
                 f"different policy (have {topo.get('policy')}, want "
                 f"{self.policy}); use a fresh directory or delete it")
         self.n_ranges = int(topo["n_ranges"])
-        self.owned = [r for r in range(self.n_ranges)
-                      if r % self.n_processes == self.process_id]
-        # A range whose creation-deal owner (one range per process at
-        # creation: owner == range id) is no longer a live process id has
-        # been inherited from a lost host.
-        self.reassigned_ranges = [r for r in self.owned
-                                  if r >= self.n_processes
-                                  and r < self.n_ranges]
-        for r in self.reassigned_ranges:
-            record_degradation(
-                "shard_range_reassigned", site="store.pod",
-                detail={"range": int(r), "from_process": int(r),
-                        "to_process": self.process_id})
+        self.epoch: int | None = None
+        self.lease_nonce: str | None = None
+        self.fenced = False
+        if membership is not None:
+            # Epoch-lease plane: ownership is the ledger's elastic deal,
+            # and every owned range's current-epoch lease is taken now —
+            # a process opening after its ranges were re-dealt fences
+            # HERE, before it can write a byte.
+            from ..resilience.coordinator import acquire_lease
+
+            self.epoch = int(membership["epoch"])
+            self.lease_nonce = str(membership.get("nonce", ""))
+            owners = {int(k): int(v)
+                      for k, v in membership["owners"].items()}
+            self.owned = [r for r in range(self.n_ranges)
+                          if owners.get(r) == self.process_id]
+            moved = {int(r) for r in membership.get("moved", [])}
+            self.reassigned_ranges = [r for r in self.owned if r in moved]
+            for r in self.reassigned_ranges:
+                record_degradation(
+                    "shard_range_reassigned", site="store.pod",
+                    detail={"range": int(r), "epoch": self.epoch,
+                            "to_process": self.process_id})
+            for r in self.owned:
+                acquire_lease(root, r, self.epoch, self.process_id,
+                              self.lease_nonce)
+        else:
+            # Legacy modulo deal (direct/scrub opens, no ledger): a
+            # range whose creation-deal owner (one range per process at
+            # creation: owner == range id) is no longer a live process
+            # id has been inherited from a lost host.
+            self.owned = [r for r in range(self.n_ranges)
+                          if r % self.n_processes == self.process_id]
+            self.reassigned_ranges = [r for r in self.owned
+                                      if r >= self.n_processes
+                                      and r < self.n_ranges]
+            for r in self.reassigned_ranges:
+                record_degradation(
+                    "shard_range_reassigned", site="store.pod",
+                    detail={"range": int(r), "from_process": int(r),
+                            "to_process": self.process_id})
         self._stores: dict[int, SignatureStore] = {}
 
     @staticmethod
     def is_sharded_root(root: str) -> bool:
         return os.path.exists(os.path.join(root, _TOPOLOGY))
+
+    @staticmethod
+    def root_n_ranges(root: str, default: int = 1) -> int:
+        """The range count recorded in an existing root's topology, or
+        ``default`` for a root not yet created (the MembershipLedger
+        must deal the same ranges the store will split)."""
+        try:
+            with open(os.path.join(root, _TOPOLOGY),
+                      encoding="utf-8") as f:
+                return int(json.load(f)["n_ranges"])
+        except (OSError, ValueError, KeyError, TypeError):
+            return int(default)
 
     def _range_dir(self, r: int) -> str:
         return os.path.join(self.root, f"range_{r:04d}")
@@ -1129,8 +1182,12 @@ class ShardedSignatureStore:
         return store
 
     def owned_mask(self, digests: np.ndarray) -> np.ndarray:
+        """Rows whose digest range THIS process writes — per the epoch
+        deal when a membership record governs the store (a promoted
+        survivor owns every range regardless of its pid), else the
+        legacy modulo deal that self.owned already encodes."""
         rid = digest_range_ids(digests, self.n_ranges)
-        return (rid % self.n_processes) == self.process_id
+        return np.isin(rid, np.asarray(self.owned, dtype=np.int32))
 
     def probe(self, digests: np.ndarray
               ) -> tuple[np.ndarray, np.ndarray]:
@@ -1165,10 +1222,61 @@ class ShardedSignatureStore:
                 loc[sel, 1], loc[sel, 2])
         return out
 
+    def demote_to_read_only(self) -> None:
+        """Self-fence: this process writes NOTHING from here on — every
+        open range store flips read-only and the owned set empties (the
+        zombie contract: a superseded writer may still read/probe, but
+        its append path is gone for the rest of the process)."""
+        self.fenced = True
+        self.owned = []
+        for store in self._stores.values():
+            store.read_only = True
+
+    def _check_lease(self, r: int) -> None:
+        """Prove tenure on range ``r`` immediately before appending.
+        A superseded (or unprovable) lease demotes this store to
+        read-only and raises — zero rows reach the range."""
+        from ..resilience.coordinator import (LeaseSupersededError,
+                                              read_lease, verify_lease)
+
+        if self.fenced:
+            raise LeaseSupersededError(
+                r, {"epoch": self.epoch, "owner": self.process_id,
+                    "nonce": self.lease_nonce}, read_lease(self.root, r))
+        if self.epoch is not None:
+            try:
+                verify_lease(self.root, r, self.epoch, self.process_id,
+                             self.lease_nonce)
+            except LeaseSupersededError as e:
+                self.demote_to_read_only()
+                record_degradation(
+                    "lease_superseded", site="store.pod",
+                    detail={"range": int(r), "held_epoch": int(self.epoch),
+                            "process": self.process_id,
+                            "current": e.current})
+                log.warning("pod: %s", e)
+                raise
+            return
+        # Legacy (un-leased) open against a lease-fenced root: a lease
+        # file on disk means an epoch plane governs this root — a writer
+        # that cannot prove tenure must fence, not append.
+        cur = read_lease(self.root, r)
+        if cur is not None:
+            self.demote_to_read_only()
+            record_degradation(
+                "lease_superseded", site="store.pod",
+                detail={"range": int(r), "held_epoch": None,
+                        "process": self.process_id, "current": cur})
+            raise LeaseSupersededError(
+                r, {"epoch": None, "owner": self.process_id,
+                    "nonce": None}, cur)
+
     def append(self, digests: np.ndarray, sigs: np.ndarray) -> int:
         """Append novel rows into their owning range stores; rows whose
         range this process does not own are skipped (their owner appends
-        them from the allgathered novel tail)."""
+        them from the allgathered novel tail).  Every owned range's
+        current-epoch lease is verified first — a superseded writer
+        self-fences (LeaseSupersededError) before touching disk."""
         if digests.shape[0] == 0:
             return 0
         rid = digest_range_ids(digests, self.n_ranges)
@@ -1176,6 +1284,7 @@ class ShardedSignatureStore:
         for r in self.owned:
             sel = np.flatnonzero(rid == r)
             if sel.size:
+                self._check_lease(r)
                 written += self.range_store(r).append(digests[sel],
                                                       sigs[sel])
         return written

@@ -623,14 +623,41 @@ def nondeterminism(src: FileSource) -> list[Finding]:
 # logic forks the time base: a wall-clock seat can jump with NTP/DST and
 # fire (or starve) a watchdog, and even a second monotonic seat makes the
 # plane's arithmetic unauditable.  Scope: the watchdog module itself plus
-# any function whose name claims deadline/watchdog/stall semantics.
+# any function whose name claims deadline/watchdog/stall — or, since the
+# elastic-membership PR, heartbeat/lease — semantics.
+#
+# The lease extension adds a second check in the same scope: lease files
+# (the pod's zombie fence, resilience/coordinator.py) must only ever be
+# mutated through the atomic-write helper — a raw `open(..., "w")` in a
+# lease/heartbeat function can leave a TORN lease that a reader
+# misparses as absent and re-acquires, letting two writers hold one
+# range.  Wall-clock time in a lease is the same class of bug (clocks
+# are not comparable across hosts; fencing is by epoch only), and the
+# clock half of this rule already covers it once the name markers do.
 
 _WATCHDOG_PLANE = ("tse1m_tpu/resilience/watchdog.py",
                    "tse1m_tpu/resilience/coordinator.py")
 _CLOCK_CALLS = {"time.time", "time.time_ns", "time.monotonic",
                 "time.monotonic_ns", "time.perf_counter",
                 "time.perf_counter_ns", "time.clock_gettime"}
-_WATCHDOG_NAME_MARKERS = ("deadline", "watchdog", "stall")
+_WATCHDOG_NAME_MARKERS = ("deadline", "watchdog", "stall", "heartbeat",
+                          "lease")
+_LEASE_NAME_MARKERS = ("lease", "heartbeat")
+
+
+def _open_write_mode(node: ast.Call) -> bool:
+    """True when this is an `open(...)` call with a writable mode."""
+    if _dotted(node.func) != "open":
+        return False
+    mode = None
+    if len(node.args) >= 2:
+        mode = node.args[1]
+    for kw in node.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if not (isinstance(mode, ast.Constant) and isinstance(mode.value, str)):
+        return False
+    return any(c in mode.value for c in "wa+x")
 
 
 def watchdog_clock(src: FileSource) -> list[Finding]:
@@ -638,22 +665,34 @@ def watchdog_clock(src: FileSource) -> list[Finding]:
     parents = None
     in_plane = src.path in _WATCHDOG_PLANE
     for node in ast.walk(src.tree):
-        if not (isinstance(node, ast.Call)
-                and _dotted(node.func) in _CLOCK_CALLS):
+        if not isinstance(node, ast.Call):
+            continue
+        is_clock = _dotted(node.func) in _CLOCK_CALLS
+        is_write = _open_write_mode(node)
+        if not (is_clock or is_write):
             continue
         if parents is None:
             parents = _parents(src.tree)
         fn = _enclosing_function(node, parents)
         fname = fn.name if fn is not None else ""
-        if fname == "deadline_clock":
-            continue  # THE helper — the plane's one blessed raw-clock seat
-        if in_plane or any(m in fname.lower()
-                           for m in _WATCHDOG_NAME_MARKERS):
+        if is_clock:
+            if fname == "deadline_clock":
+                continue  # THE helper — the plane's one blessed raw-clock seat
+            if in_plane or any(m in fname.lower()
+                               for m in _WATCHDOG_NAME_MARKERS):
+                out.append(_f(src, node,
+                              f"raw clock `{_dotted(node.func)}()` in the "
+                              "watchdog plane — read time through "
+                              "resilience.watchdog.deadline_clock so every "
+                              "deadline shares one monotonic time base"))
+        elif in_plane or any(m in fname.lower()
+                             for m in _LEASE_NAME_MARKERS):
             out.append(_f(src, node,
-                          f"raw clock `{_dotted(node.func)}()` in the "
-                          "watchdog plane — read time through "
-                          "resilience.watchdog.deadline_clock so every "
-                          "deadline shares one monotonic time base"))
+                          "raw writable `open()` in lease/heartbeat code "
+                          "— every lease or heartbeat mutation goes "
+                          "through utils.atomic.atomic_write (see "
+                          "resilience.coordinator.write_lease) so a "
+                          "reader never sees a torn file"))
     return out
 
 

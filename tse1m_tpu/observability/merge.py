@@ -73,16 +73,28 @@ def merge_run_manifests(result_dir: str, n_processes: int,
     counts: dict[str, int] = {}
     steps: list[dict] = []
     summary: dict[str, int] = {}
+    epochs: dict[str, int] = {}
     started = None
     wall = 0.0
     for pid in sorted(fragments):
         frag = fragments[pid]
+        # Each fragment's degradation events are popped destructively
+        # into exactly one step record by its own StepRunner, so summing
+        # the per-fragment counts here counts every event exactly once —
+        # across processes AND across membership epochs (a host that
+        # re-admitted in a later epoch writes one fragment, tagged).
         for kind, n in (frag.get("degradation_counts") or {}).items():
             counts[kind] = counts.get(kind, 0) + int(n)
         for status, n in (frag.get("summary") or {}).items():
             summary[status] = summary.get(status, 0) + int(n)
+        epoch = frag.get("epoch")
+        if epoch is not None:
+            epochs[str(pid)] = int(epoch)
         for step in frag.get("steps", []):
-            steps.append({**step, "process": pid})
+            tagged = {**step, "process": pid}
+            if epoch is not None:
+                tagged["epoch"] = int(epoch)
+            steps.append(tagged)
         if frag.get("started_at") is not None:
             started = (frag["started_at"] if started is None
                        else min(started, frag["started_at"]))
@@ -98,6 +110,11 @@ def merge_run_manifests(result_dir: str, n_processes: int,
             "n_processes": int(n_processes),
             "merged_from": sorted(fragments),
             "missing": missing,
+            # Membership accounting: the epoch each fragment ran under
+            # (a re-admitted host appears at its later epoch) and the
+            # pod-wide latest epoch.
+            "epochs": epochs,
+            "epoch": (max(epochs.values()) if epochs else None),
         },
         "steps": steps,
     }

@@ -103,6 +103,32 @@ def initialize_from_env() -> bool:
     return True
 
 
+def pod_process_env() -> tuple[int, int]:
+    """(n_processes, process_id) for the POD plane, WITHOUT initializing
+    jax.distributed.
+
+    The pod path's data plane is the shared store root and its control
+    plane is the file-heartbeat coordinator — it needs process identity,
+    not an XLA coordination service.  Reading it straight from the env
+    (``TSE1M_NUM_PROCESSES`` / ``TSE1M_PROCESS_ID``) is what lets a
+    survivor outlive a dead leader: there is no coordination client to
+    LOG(FATAL) the process when the leader's service socket closes, so
+    leader loss is just another heartbeat timeout.  Falls back to the
+    already-initialized jax.distributed identity (the mesh path), else
+    single-process."""
+    nproc = os.environ.get(_ENV_NPROC)
+    pid = os.environ.get(_ENV_PID)
+    if nproc and int(nproc) > 1:
+        if not pid:
+            raise RuntimeError(
+                f"{_ENV_NPROC}={nproc} is set but {_ENV_PID} is not; "
+                "every pod process must export its unique id (0..n-1)")
+        return int(nproc), int(pid)
+    if jax.process_count() > 1:  # mesh bring-up already happened
+        return jax.process_count(), jax.process_index()
+    return 1, 0
+
+
 def global_mesh(axis: str = "data") -> jax.sharding.Mesh:
     """1-D mesh over every device of every process (== `make_mesh` on a
     single host; after `initialize_from_env` it spans the pod/cluster)."""
@@ -222,7 +248,9 @@ def pod_row_range(n_rows: int, n_processes: int,
 
 
 def fs_exchange(xch_dir: str, tag: str, payload: dict,
-                monitor=None, timeout_s: float = 600.0) -> list:
+                monitor=None, timeout_s: float = 600.0,
+                n_processes: int | None = None,
+                process_id: int | None = None) -> list:
     """All-to-all host exchange over the shared filesystem: write this
     process's arrays atomically, wait for every peer's, return the
     per-process payload list (pid order).
@@ -240,7 +268,11 @@ def fs_exchange(xch_dir: str, tag: str, payload: dict,
     resilience/coordinator.exchange_dir) — names carry no run identity."""
     from ..resilience.watchdog import deadline_clock
 
-    nproc, pid = jax.process_count(), jax.process_index()
+    # Explicit identity (the pod plane, which never brings up
+    # jax.distributed) wins; the jax identity is the mesh-path default.
+    nproc = (int(n_processes) if n_processes is not None
+             else jax.process_count())
+    pid = int(process_id) if process_id is not None else jax.process_index()
     os.makedirs(xch_dir, exist_ok=True)
 
     def _path(p: int) -> str:

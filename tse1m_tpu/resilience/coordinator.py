@@ -1,14 +1,11 @@
-"""Pod-scale supervision: peer heartbeats + coordinator-driven failover.
+"""Pod-scale supervision: peer heartbeats, epoch membership + failover.
 
 The PR 5 watchdog bounds every stage *inside* one process; the failure it
 cannot see is a whole host going away — SIGKILLed by the scheduler, wedged
-in a kernel hang, or partitioned off the network.  Under `jax.distributed`
-that failure is maximally silent: the survivors block forever inside the
-next collective, because the collective cannot know its peer is never
-coming.  This module turns "lost host" into a first-class, recoverable
-failure, the same shape fault-tolerant multi-host training stacks use
-(elastic membership + re-execution of the lost worker's partition, the
-MapReduce recipe):
+in a kernel hang, or partitioned off the network.  This module turns
+"lost host" into a first-class, recoverable failure, the same shape
+fault-tolerant multi-host training stacks use (elastic membership +
+re-execution of the lost worker's partition, the MapReduce recipe):
 
 - :class:`HeartbeatWriter` — every process beats a monotonically
   increasing ``seq`` into ``hb_<pid>.json`` under a shared directory
@@ -19,22 +16,41 @@ MapReduce recipe):
   advanced within ``timeout_s`` measured on the LOCAL
   :func:`~.watchdog.deadline_clock`.  Only local monotonic deltas are
   ever compared, so NTP steps on either host cannot fire or starve the
-  monitor.
-- :class:`PodSupervisor` — owns both, plus :meth:`guarded`: run a
-  cross-host phase (a collective, a barrier) on a reaper-able thread
-  while polling the monitor — a dead peer turns an infinite collective
-  hang into :class:`HostLostError` within one heartbeat timeout.  The
-  caller (cli's pod cluster step) then fails over: the lowest-id
-  survivor re-executes solo with the lost host's digest range
-  reassigned (`cluster/store.ShardedSignatureStore`), every other
-  survivor exits loudly.  Every declaration/reassignment/failover fires
-  a degradation event into the merged pod ``run_manifest.json``.
+  monitor.  Loss declarations latch PER EPOCH: a host lost in epoch N
+  can be alive again in epoch N+1 (:meth:`PeerMonitor.advance_epoch`),
+  but only by beating a genuinely NEW run nonce — a stale heartbeat
+  file replaying an already-seen nonce, or a regressed seq under the
+  current nonce, never counts as an advance (the replay guard).
+- :class:`MembershipLedger` — ``membership.json`` under the pod dir: a
+  monotonic **epoch**, the member set, and the range → owner deal.
+  Epochs advance on loss AND on recovery; the re-deal is ELASTIC — only
+  ranges whose owner left (or that rebalance onto a re-admitted member)
+  change writers, everything else keeps its owner, so a recovered host
+  re-admits at the next epoch boundary without a full rerun.
+- **Epoch leases** — one ``lease_NNNN.json`` per digest range under the
+  sharded store root (atomic tmp+rename; monotonic epoch + run nonce,
+  NO wall timestamps — fencing is by epoch comparison, never by clock).
+  Every ``ShardedSignatureStore`` writer must hold the current-epoch
+  lease before appending; a zombie that wakes after its range was
+  reassigned finds its lease superseded and self-fences
+  (:class:`LeaseSupersededError` → read-only demotion, recorded as a
+  degradation event) instead of double-writing.
+- :class:`PodSupervisor` — heartbeat writer + monitor, plus
+  :meth:`guarded`: run a cross-host phase on a reaper-able thread while
+  polling the monitor — a dead peer turns an infinite wait into
+  :class:`HostLostError` within one heartbeat timeout.  The caller
+  (cli's pod cluster step) then fails over: the lowest-id survivor
+  advances the membership epoch (promoting itself to leader when
+  process 0 is among the lost — the pod plane has no dependency on the
+  XLA coordination service, so leader death is one more reassignment,
+  not a pod-wide fence) and re-executes with the lost hosts' digest
+  ranges re-dealt.  Every declaration/reassignment/promotion fires a
+  degradation event into the merged pod ``run_manifest.json``.
 
 The fault plane's ``hostloss`` kind (resilience/faults.py) wedges a host
-for the chaos tests: it calls :func:`suspend_heartbeats` then sleeps at a
-production seat — the process is alive but silent, exactly the failure
-mode heartbeats exist to catch (``kill`` already covers the dead-process
-variant).
+forever for the chaos tests; the ``zombie`` kind wedges it and then
+RESUMES it — the writer that wakes at a production seat after its range
+was reassigned, exactly the failure the leases fence.
 """
 
 from __future__ import annotations
@@ -77,6 +93,28 @@ class HostLostError(RuntimeError):
             "to survivors and their rows recompute")
 
 
+class LeaseSupersededError(RuntimeError):
+    """This writer's epoch lease on a digest range was superseded — a
+    later epoch re-dealt the range to another process while this one was
+    wedged.  The holder must self-fence: demote to read-only and stop
+    appending (a zombie double-write would corrupt the single-writer
+    invariant the range depends on)."""
+
+    def __init__(self, range_id: int, held: dict, current: dict | None):
+        self.range_id = int(range_id)
+        self.held = dict(held)
+        self.current = dict(current) if current else None
+        cur = (f"epoch {current.get('epoch')} owned by process "
+               f"{current.get('owner')}" if current else "absent")
+        super().__init__(
+            f"lease on digest range {self.range_id} superseded: this "
+            f"writer holds epoch {held.get('epoch')} as process "
+            f"{held.get('owner')}, but the on-disk lease is {cur} — the "
+            "range was re-dealt while this process was wedged; demoting "
+            "to read-only (zero further appends) instead of double-"
+            "writing")
+
+
 # The fault plane's hostloss kind flips this: a wedged host stays alive
 # but stops beating, so peers declare it lost through the production
 # heartbeat path (zero test-only branches in the monitor).
@@ -93,16 +131,15 @@ def saw_host_loss() -> bool:
     return _loss_seen.is_set()
 
 
-# Failover scope note: in-process failover covers lost WORKERS only.
-# Process 0 hosts the XLA coordination service; when it dies, every
-# survivor's error-poll thread observes the closed socket and LOG(FATAL)s
-# the process within ~1 s — faster than any heartbeat could detect, and
-# unstoppable from Python.  A lost leader therefore fences the whole pod
-# (every worker exits), and recovery is the scheduler's respawn: a fresh
-# run against the same sharded store root inherits every digest range and
-# recomputes whatever the dead pod never appended (probe-as-miss), so the
-# respawned labels equal an uninterrupted run's (pinned by the
-# leader-death chaos test).
+# Failover scope note: the pod plane carries its own process identity
+# (parallel/multihost.pod_process_env) and never initializes the XLA
+# coordination service, so there is no client to LOG(FATAL) the
+# survivors when process 0 dies — leader loss is detected by the same
+# file heartbeats as any worker loss, and the lowest-id survivor
+# promotes itself over the shared-filesystem exchange plane (advances
+# the membership epoch, re-executes, merges the manifest fragments).
+# The mesh (non-pod) multi-host path still runs under jax.distributed;
+# hard_exit_if_host_lost remains its only safe exit after a loss.
 
 
 def hard_exit_if_host_lost(code: int) -> int:
@@ -162,6 +199,11 @@ class HeartbeatWriter:
         self._thread: threading.Thread | None = None
         os.makedirs(directory, exist_ok=True)
 
+    @property
+    def run_id(self) -> str:
+        """This run's heartbeat nonce (fresh per HeartbeatWriter)."""
+        return self._run_id
+
     def beat_once(self) -> int:
         with self._lock:
             self._seq += 1
@@ -198,9 +240,15 @@ class HeartbeatWriter:
 
 class PeerMonitor:
     """Track peers' heartbeat seqs; declare lost on no advance within
-    ``timeout_s`` of the LOCAL deadline_clock.  Lost declarations latch —
-    a host that resumes beating after the declaration stays lost for this
-    run (its range was already reassigned; let the next run readmit it)."""
+    ``timeout_s`` of the LOCAL deadline_clock.
+
+    Loss declarations latch PER EPOCH: within one membership epoch a
+    host that resumes beating after the declaration stays lost (its
+    range was already reassigned); :meth:`advance_epoch` opens the next
+    epoch, where the host may re-admit — but only by beating a NEW run
+    nonce.  The replay guard rejects resurrection by stale state: a
+    heartbeat file carrying an already-seen (rolled-back) nonce, or a
+    regressed seq under the current nonce, never counts as an advance."""
 
     def __init__(self, directory: str, n_processes: int, process_id: int,
                  timeout_s: float | None = None) -> None:
@@ -215,7 +263,13 @@ class PeerMonitor:
         # peer -> (last (run, seq) seen, deadline_clock() at last advance).
         # Absent files get the full grace window from monitor start.
         self._seen = {p: ((None, -1), now) for p in self.peers}
-        self._lost: set[int] = set()
+        # Replay guard: every nonce ever observed per peer.  A beat whose
+        # nonce is in this set but is not the peer's CURRENT nonce is a
+        # rollback (a stale file resurfacing), never an advance.
+        self._nonces: dict[int, set] = {p: set() for p in self.peers}
+        self.epoch = 0
+        self._lost: set[int] = set()          # current-epoch latch
+        self._lost_history: set[int] = set()  # prior epochs (observability)
 
     def _read_beat(self, peer: int):
         """(run nonce, seq) of the peer's last beat, or None."""
@@ -227,8 +281,21 @@ class PeerMonitor:
         except (OSError, ValueError, KeyError):
             return None
 
+    def _advanced(self, peer: int, beat) -> bool:
+        """The replay guard: does this beat prove the peer is alive?"""
+        if beat is None:
+            return False
+        run, seq = beat
+        last_run, last_seq = self._seen[peer][0]
+        if run == last_run:
+            return seq > last_seq  # a regressed seq is a stale file
+        # A nonce change is an advance only when the nonce is genuinely
+        # new — replaying a previously seen nonce (a restored backup, an
+        # NFS cache serving an old generation) must not resurrect a host.
+        return run not in self._nonces[peer]
+
     def poll(self) -> list:
-        """Refresh peer state; returns the (latched) lost list."""
+        """Refresh peer state; returns the (epoch-latched) lost list."""
         now = deadline_clock()
         with self._lock:
             for peer in self.peers:
@@ -236,25 +303,46 @@ class PeerMonitor:
                     continue
                 beat = self._read_beat(peer)
                 (last_run, last_seq), last_t = self._seen[peer]
-                advanced = beat is not None and (
-                    beat[0] != last_run or beat[1] > last_seq)
-                if advanced:
+                if self._advanced(peer, beat):
                     self._seen[peer] = (beat, now)
+                    if beat[0] is not None:
+                        self._nonces[peer].add(beat[0])
                 elif now - last_t > self.timeout_s:
                     self._lost.add(peer)
                     _loss_seen.set()
                     log.warning(
-                        "pod: host %d declared lost (no heartbeat advance "
-                        "in %.1fs, last seq %d)", peer, self.timeout_s,
-                        last_seq)
+                        "pod: host %d declared lost in epoch %d (no "
+                        "heartbeat advance in %.1fs, last seq %d)", peer,
+                        self.epoch, self.timeout_s, last_seq)
                     from ..observability import record_degradation
 
                     record_degradation(
                         "host_lost", site="coordinator",
                         detail={"process": int(peer),
+                                "epoch": int(self.epoch),
                                 "timeout_s": self.timeout_s,
                                 "last_seq": int(last_seq)})
             return sorted(self._lost)
+
+    def advance_epoch(self, epoch: int | None = None) -> int:
+        """Open the next membership epoch: current-epoch loss latches
+        clear (a host lost in epoch N may be alive in epoch N+1) and
+        every peer gets a fresh grace window.  The replay guard's nonce
+        memory persists across epochs — readmission requires a beat
+        under a genuinely new nonce, never a stale file."""
+        with self._lock:
+            self.epoch = int(epoch) if epoch is not None else self.epoch + 1
+            self._lost_history |= self._lost
+            self._lost.clear()
+            now = deadline_clock()
+            for p in self.peers:
+                self._seen[p] = (self._seen[p][0], now)
+            return self.epoch
+
+    def ever_lost(self) -> list:
+        """Hosts declared lost in ANY epoch (observability, not latch)."""
+        with self._lock:
+            return sorted(self._lost_history | self._lost)
 
     def check(self, site: str = "") -> None:
         """Raise :class:`HostLostError` when any peer is lost."""
@@ -342,48 +430,64 @@ class PodSupervisor:
 # already requires one; see cluster/store.py) — novel-tail exchanges are
 # atomic files under a PER-RUN directory, because the pod dir outlives
 # runs and a slow host reading a previous run's exchange file would merge
-# stale signatures silently.  The per-run name comes from a nonce process
-# 0 publishes through the jax.distributed key-value service: that service
-# lives inside process 0's run and dies with it, so a nonce read from it
-# can never be a previous run's — staleness-free by construction.  (The
-# heartbeat plane deliberately does NOT ride the same service: when
-# process 0 dies, the KV store dies with it, and the survivors' monitor —
-# plain files — is what must keep working to declare the loss.)
+# stale signatures silently.  The per-run name is a nonce the leader
+# publishes as an atomic file stamped with its own heartbeat run id: a
+# peer accepts the nonce only when that stamp matches the leader's
+# CURRENT heartbeat nonce, so a previous run's nonce file (stamped with
+# a dead run's heartbeat id) is rejected and the peer keeps polling.
+# The plane deliberately does NOT ride the jax.distributed KV service:
+# the pod path never initializes the XLA coordination service at all —
+# that is what lets a survivor outlive the leader instead of being
+# LOG(FATAL)ed by the coordination client's error poll.
+
+_RUN_NONCE = "run_nonce.json"
 
 
-def _kv_client():
-    from jax._src import distributed  # run-scoped KV service
-
-    return distributed.global_state.client
-
-
-_NONCE_KEY = "tse1m/pod/run_nonce"
-
-
-def negotiate_run_nonce(supervisor: "PodSupervisor | None" = None) -> str:
+def negotiate_run_nonce(supervisor: "PodSupervisor | None" = None,
+                        pod_dir: str | None = None) -> str:
     """One hex nonce shared by every process of THIS run.
 
-    Process 0 generates and publishes it; peers block on the KV get in
-    short slices, polling the heartbeat monitor between them so a process
-    0 that dies pre-publish raises :class:`HostLostError` instead of a
-    bare timeout.  Single-process runs mint a local nonce."""
+    The leader (process 0) generates it and publishes it atomically under
+    the pod dir, stamped with its heartbeat run id; peers poll for a
+    nonce file whose stamp matches the leader's live heartbeat, checking
+    the monitor between polls so a leader that dies pre-publish raises
+    :class:`HostLostError` instead of a bare timeout.  Single-process
+    runs mint a local nonce."""
     if supervisor is None or supervisor.n_processes == 1:
         return os.urandom(8).hex()
+    pod_dir = pod_dir or supervisor.directory
+    path = os.path.join(pod_dir, _RUN_NONCE)
     if supervisor.process_id == 0:
         nonce = os.urandom(8).hex()
-        _kv_client().key_value_set(_NONCE_KEY, nonce)
+        with atomic_write(path) as f:
+            json.dump({"nonce": nonce,
+                       "leader_run": supervisor.writer.run_id}, f)
         return nonce
     deadline = deadline_clock() + supervisor.monitor.timeout_s * 2
     while True:
+        leader_run = None
         try:
-            return _kv_client().blocking_key_value_get(_NONCE_KEY, 1000)
-        except RuntimeError as e:  # XlaRuntimeError: deadline exceeded
-            supervisor.monitor.check(site="pod.nonce")
-            if deadline_clock() > deadline:
-                raise TimeoutError(
-                    "pod: no run nonce from process 0 within "
-                    f"{supervisor.monitor.timeout_s * 2:.0f}s (it is "
-                    "beating but has not announced a run)") from e
+            with open(heartbeat_path(pod_dir, 0), encoding="utf-8") as f:
+                leader_run = json.load(f).get("run")
+        except (OSError, ValueError):
+            pass
+        rec = None
+        try:
+            with open(path, encoding="utf-8") as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            pass
+        if (rec and leader_run is not None
+                and rec.get("leader_run") == leader_run
+                and rec.get("nonce")):
+            return str(rec["nonce"])
+        supervisor.monitor.check(site="pod.nonce")
+        if deadline_clock() > deadline:
+            raise TimeoutError(
+                "pod: no run nonce from process 0 within "
+                f"{supervisor.monitor.timeout_s * 2:.0f}s (it is beating "
+                "but has not announced a run)")
+        time.sleep(0.1)
 
 
 def exchange_dir(pod_dir: str, nonce: str,
@@ -400,8 +504,234 @@ def exchange_dir(pod_dir: str, nonce: str,
     return path
 
 
-__all__ = ["HeartbeatWriter", "HostLostError", "PeerMonitor",
-           "PodSupervisor", "exchange_dir", "hard_exit_if_host_lost",
+# -- epoch leases ------------------------------------------------------------
+#
+# One lease file per digest range, next to the range's directory under
+# the sharded store root.  A lease is {range, epoch, owner, nonce} —
+# monotonic epoch from the MembershipLedger plus the holding run's nonce;
+# deliberately NO timestamps of any kind (fencing is epoch comparison on
+# files every host can read, so wall-clock skew between hosts can neither
+# grant nor revoke a lease).  All mutations go through write_lease's
+# atomic tmp+rename (a reader never sees a torn lease), enforced by the
+# graftlint watchdog-clock/lease rule.
+
+_LEASE_FMT = "lease_{:04d}.json"
+
+
+def lease_path(root: str, range_id: int) -> str:
+    return os.path.join(root, _LEASE_FMT.format(int(range_id)))
+
+
+def read_lease(root: str, range_id: int) -> dict | None:
+    """The on-disk lease for a range, or None (absent/torn — a torn
+    lease reads as absent; the next acquire rewrites it)."""
+    try:
+        with open(lease_path(root, range_id), encoding="utf-8") as f:
+            d = json.load(f)
+        return {"range": int(d["range"]), "epoch": int(d["epoch"]),
+                "owner": int(d["owner"]), "nonce": str(d.get("nonce", ""))}
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
+
+def write_lease(root: str, range_id: int, epoch: int, owner: int,
+                nonce: str) -> dict:
+    """THE lease mutation seat: atomic tmp+rename only (graftlint
+    enforces that no lease write bypasses this helper)."""
+    rec = {"range": int(range_id), "epoch": int(epoch),
+           "owner": int(owner), "nonce": str(nonce)}
+    with atomic_write(lease_path(root, range_id)) as f:
+        json.dump(rec, f)
+    return rec
+
+
+def acquire_lease(root: str, range_id: int, epoch: int, owner: int,
+                  nonce: str) -> dict:
+    """Take (or re-take) the range's lease at ``epoch``.
+
+    Refuses — raises :class:`LeaseSupersededError` — when the on-disk
+    lease already carries a LATER epoch (this process is the zombie: the
+    pod moved on without it), or the same epoch under a different owner
+    (a deal bug two writers must never paper over).  A same-epoch
+    re-acquire by the same owner (a clean re-run under an unchanged
+    membership) refreshes the nonce."""
+    held = {"epoch": int(epoch), "owner": int(owner), "nonce": str(nonce)}
+    cur = read_lease(root, range_id)
+    if cur is not None:
+        if cur["epoch"] > int(epoch):
+            raise LeaseSupersededError(range_id, held, cur)
+        if cur["epoch"] == int(epoch) and cur["owner"] != int(owner):
+            raise LeaseSupersededError(range_id, held, cur)
+    return write_lease(root, range_id, epoch, owner, nonce)
+
+
+def verify_lease(root: str, range_id: int, epoch: int, owner: int,
+                 nonce: str) -> None:
+    """Prove this writer still holds the range's current-epoch lease
+    (called before every append).  Anything else — a later epoch, a
+    different owner, a different run's nonce, or a missing/torn lease —
+    raises :class:`LeaseSupersededError`: when tenure cannot be proven,
+    the writer must fence, never append."""
+    held = {"epoch": int(epoch), "owner": int(owner), "nonce": str(nonce)}
+    cur = read_lease(root, range_id)
+    if (cur is None or cur["epoch"] != int(epoch)
+            or cur["owner"] != int(owner)
+            or cur["nonce"] != str(nonce)):
+        raise LeaseSupersededError(range_id, held, cur)
+
+
+# -- membership ledger -------------------------------------------------------
+
+
+_MEMBERSHIP = "membership.json"
+
+
+class MembershipLedger:
+    """``membership.json`` under the pod dir: monotonic epoch, member
+    set, and the digest-range → owner deal.
+
+    Epochs advance on loss AND on recovery, and the re-deal is elastic:
+    a range keeps its owner whenever that owner is still a member and
+    not over the balanced target — only orphaned ranges (owner left) and
+    the minimal rebalance onto re-admitted members move, so labels and
+    warm state stay put for every unmoved range.  The file is written
+    atomically by exactly one process per advance (the leader at
+    bootstrap, the failover survivor mid-run); peers adopt it via
+    :meth:`wait_for`."""
+
+    def __init__(self, pod_dir: str, n_ranges: int) -> None:
+        self.pod_dir = pod_dir
+        self.n_ranges = int(n_ranges)
+        self.path = os.path.join(pod_dir, _MEMBERSHIP)
+        os.makedirs(pod_dir, exist_ok=True)
+
+    def load(self) -> dict | None:
+        """The current membership record, or None (absent/torn)."""
+        try:
+            with open(self.path, encoding="utf-8") as f:
+                d = json.load(f)
+            return {"epoch": int(d["epoch"]), "nonce": str(d.get("nonce", "")),
+                    "members": sorted(int(m) for m in d["members"]),
+                    "owners": {int(k): int(v)
+                               for k, v in d["owners"].items()},
+                    "moved": sorted(int(r) for r in d.get("moved", [])),
+                    "prev_members": sorted(
+                        int(m) for m in d.get("prev_members", []))}
+        except (OSError, ValueError, KeyError, TypeError):
+            return None
+
+    def _write(self, rec: dict) -> None:
+        with atomic_write(self.path) as f:
+            json.dump(rec, f)
+
+    @staticmethod
+    def _deal(prior_owners: dict | None, members: list,
+              n_ranges: int) -> tuple[dict, list]:
+        """Elastic re-deal: (owners, moved ranges).  Keeps every range
+        with its prior owner while that owner is a member under the
+        balanced target ceil(n_ranges / len(members)); orphaned and
+        overflow ranges go to the least-loaded member (ties to the
+        lowest pid).  Deterministic — every host computes the same deal."""
+        members = sorted(int(m) for m in members)
+        target = -(-int(n_ranges) // len(members))
+        counts = {m: 0 for m in members}
+        owners: dict = {}
+        pool = []
+        for r in range(int(n_ranges)):
+            o = (prior_owners or {}).get(r)
+            if o in counts and counts[o] < target:
+                owners[r] = o
+                counts[o] += 1
+            else:
+                pool.append(r)
+        moved = []
+        for r in pool:
+            m = min(members, key=lambda p: (counts[p], p))
+            owners[r] = m
+            counts[m] += 1
+            if (prior_owners or {}).get(r) != m:
+                moved.append(r)
+        return owners, moved
+
+    def bootstrap(self, members: list, nonce: str) -> dict:
+        """Open this run's membership: reuse the prior epoch and deal
+        when the member set is unchanged, otherwise advance (a member
+        set that grew is a recovery — the re-admitted host takes ranges
+        back at the epoch boundary via the elastic re-deal)."""
+        prior = self.load()
+        members = sorted(int(m) for m in members)
+        if prior is not None and prior["members"] == members:
+            rec = {**prior, "nonce": str(nonce), "moved": []}
+            self._write(rec)
+            return rec
+        if prior is None:
+            reason = "bootstrap"
+        elif set(members) - set(prior["members"]):
+            reason = "host_readmitted"
+        else:
+            reason = "membership_change"
+        return self._advance(prior, members, nonce, reason)
+
+    def advance(self, members: list, nonce: str, reason: str) -> dict:
+        """Force the next epoch (the failover survivor's seat)."""
+        return self._advance(self.load(), sorted(int(m) for m in members),
+                             nonce, reason)
+
+    def _advance(self, prior: dict | None, members: list, nonce: str,
+                 reason: str) -> dict:
+        epoch = int(prior["epoch"]) + 1 if prior is not None else 0
+        owners, moved = self._deal(
+            prior.get("owners") if prior is not None else None,
+            members, self.n_ranges)
+        if prior is None:
+            moved = []  # a fresh deal reassigns nothing
+        rec = {"epoch": epoch, "nonce": str(nonce), "members": members,
+               "owners": owners, "moved": sorted(moved),
+               "prev_members": (prior or {}).get("members", [])}
+        self._write(rec)
+        if prior is not None:
+            from ..observability import record_degradation
+
+            record_degradation(
+                "epoch_advance", site="coordinator.membership",
+                detail={"epoch": epoch, "reason": reason,
+                        "members": members, "moved": sorted(moved)})
+            for p in sorted(set(members) - set(prior["members"])):
+                record_degradation(
+                    "host_readmitted", site="coordinator.membership",
+                    detail={"process": int(p), "epoch": epoch})
+            log.warning("pod membership epoch %d (%s): members %s, "
+                        "moved ranges %s", epoch, reason, members,
+                        sorted(moved))
+        return rec
+
+    def wait_for(self, nonce: str, monitor: "PeerMonitor | None" = None,
+                 timeout_s: float | None = None) -> dict:
+        """Adopt the membership record the leader wrote for THIS run
+        (matched by nonce), polling the monitor so a leader death here
+        raises :class:`HostLostError` instead of hanging."""
+        budget = (timeout_s if timeout_s is not None
+                  else (monitor.timeout_s * 2 if monitor is not None
+                        else heartbeat_timeout_s() * 2))
+        deadline = deadline_clock() + budget
+        while True:
+            rec = self.load()
+            if rec is not None and rec["nonce"] == str(nonce):
+                return rec
+            if monitor is not None:
+                monitor.check(site="pod.membership")
+            if deadline_clock() > deadline:
+                raise TimeoutError(
+                    f"pod: no membership record for nonce {nonce} within "
+                    f"{budget:.0f}s (the leader is beating but has not "
+                    "published the epoch deal)")
+            time.sleep(0.1)
+
+
+__all__ = ["HeartbeatWriter", "HostLostError", "LeaseSupersededError",
+           "MembershipLedger", "PeerMonitor", "PodSupervisor",
+           "acquire_lease", "exchange_dir", "hard_exit_if_host_lost",
            "heartbeat_interval_s", "heartbeat_path", "heartbeat_timeout_s",
-           "negotiate_run_nonce", "resume_heartbeats", "saw_host_loss",
-           "suspend_heartbeats"]
+           "lease_path", "negotiate_run_nonce", "read_lease",
+           "resume_heartbeats", "saw_host_loss", "suspend_heartbeats",
+           "verify_lease", "write_lease"]

@@ -35,6 +35,14 @@ Fault kinds:
   a wedged host that is alive but silent.  Peers declare it lost through
   the production heartbeat monitor and fail its digest range over;
   ``kill`` covers the dead-process variant of the same failure.
+- ``zombie``: ``hostloss`` that WAKES UP — suspend heartbeats, sleep
+  until ``stall_s`` elapses or the file at ``wake_path`` appears (the
+  deterministic game-day trigger: the chaos harness touches it once the
+  survivor has re-dealt the wedged host's range), then RESUME
+  heartbeats and pass through.  The woken writer continues at a
+  production seat with its digest-range lease superseded — the failure
+  mode the epoch leases exist to fence (it must self-fence via
+  LeaseSupersededError, never double-write).
 """
 
 from __future__ import annotations
@@ -61,7 +69,7 @@ class InjectedConnectionDrop(ConnectionError, InjectedFault):
 
 
 _KINDS = ("raise", "connection_drop", "delay", "torn_write", "kill",
-          "stall", "hostloss")
+          "stall", "hostloss", "zombie")
 
 
 @dataclass
@@ -80,6 +88,7 @@ class FaultRule:
     delay_s: float = 0.05          # kind=delay
     stall_s: float = 30.0          # kind=stall (a hang, not a hiccup)
     truncate_fraction: float = 0.5  # kind=torn_write
+    wake_path: str | None = None   # kind=zombie: wake early on this file
     _seen: int = field(default=0, repr=False, compare=False)
     _fired: int = field(default=0, repr=False, compare=False)
 
@@ -160,6 +169,21 @@ class FaultPlan:
 
             suspend_heartbeats()
             time.sleep(rule.stall_s)
+            return
+        if rule.kind == "zombie":
+            from .coordinator import resume_heartbeats, suspend_heartbeats
+
+            suspend_heartbeats()
+            remaining = rule.stall_s
+            while remaining > 0:
+                if rule.wake_path and os.path.exists(rule.wake_path):
+                    break
+                slice_s = min(0.25, remaining)
+                time.sleep(slice_s)
+                remaining -= slice_s
+            resume_heartbeats()
+            log.warning("fault plane: zombie at %s woke after wedge "
+                        "(heartbeats resumed)", site)
             return
         if rule.kind == "kill":
             os.kill(os.getpid(), signal.SIGKILL)

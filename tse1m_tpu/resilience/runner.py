@@ -62,6 +62,10 @@ class StepRunner:
         self.policy = policy or RetryPolicy(max_attempts=1)
         self.steps: list[StepRecord] = []
         self.started_at = time.time()
+        # Extra top-level manifest fields (e.g. the pod path's membership
+        # "epoch" — observability/merge.py tags each fragment's steps
+        # with it so a mid-run membership change stays attributable).
+        self.meta: dict = {}
 
     def _record(self, rec: StepRecord) -> StepRecord:
         self.steps.append(rec)
@@ -118,6 +122,12 @@ class StepRunner:
         return self._record(StepRecord(name=name, status="missing",
                                        detail=detail))
 
+    def set_meta(self, **fields) -> None:
+        """Attach extra top-level manifest fields and rewrite the
+        manifest (e.g. the pod membership epoch, once known)."""
+        self.meta.update(fields)
+        self._write()
+
     def record_skipped(self, name: str, detail: str) -> StepRecord:
         return self._record(StepRecord(name=name, status="skipped",
                                        detail=detail))
@@ -151,6 +161,7 @@ class StepRunner:
             # kind -> count over every step: the one-glance answer to
             # "what did the supervision plane absorb this run".
             "degradation_counts": degradation_counts(events),
+            **self.meta,
             "steps": [asdict(s) for s in self.steps],
         }
         os.makedirs(os.path.dirname(self.manifest_path) or ".",

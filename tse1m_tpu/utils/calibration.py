@@ -114,10 +114,18 @@ def update_calibration(path: str | None, cost_per_row: dict | None = None,
                         payload[section][k]["ts"] = entry["ts"]
     except (OSError, ValueError):
         pass
+    # A None value DELETES the entry (e.g. the degradation ladder
+    # restoring full wire fidelity once the device heals).
     for k, v in (cost_per_row or {}).items():
-        payload["cost_per_row"][k] = {"value": float(v), "ts": now}
+        if v is None:
+            payload["cost_per_row"].pop(k, None)
+        else:
+            payload["cost_per_row"][k] = {"value": float(v), "ts": now}
     for k, v in (wire or {}).items():
-        payload["wire"][k] = {"value": v, "ts": now}
+        if v is None:
+            payload["wire"].pop(k, None)
+        else:
+            payload["wire"][k] = {"value": v, "ts": now}
     try:
         with atomic_write(path) as f:
             json.dump(payload, f, indent=2)
