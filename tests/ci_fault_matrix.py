@@ -43,6 +43,71 @@ BENCH_KEYS = ("degradation_events", "degradation_counts", "chunk_halvings",
               "store_scrub_shards", "store_scrub_corrupt",
               "store_scrub_quarantined", "store_scrub_state_ok")
 
+# The machine-checked seat inventory (graftlint ``fault-seat-drift``):
+# every ``fault_point(...)`` seat in production code must have an entry
+# here — naming the fault kinds exercised against it and the test that
+# covers it — and every entry must correspond to a live seat.  Adding a
+# seat without a matrix entry, leaving a dead entry behind, or listing a
+# kind resilience/faults.py does not implement fails lint (and the CI
+# fault-matrix job runs that rule before any seat).  Seats this driver
+# injects directly draw their site strings from this table via
+# ``plan_rule`` so the inventory cannot drift from the plans.
+PRODUCTION_SEATS = {
+    "http.fetch": {
+        "kinds": ("raise", "connection_drop", "delay"),
+        "covered_by": "tests/test_resilience.py (HttpFetcher retry/"
+                      "Retry-After under injected faults)"},
+    "db.connect": {
+        "kinds": ("raise", "connection_drop"),
+        "covered_by": "tests/test_db.py (reconnect-on-drop)"},
+    "db.execute": {
+        "kinds": ("raise", "connection_drop", "delay"),
+        "covered_by": "tests/test_db.py (statement retry / transaction "
+                      "units)"},
+    "pglib.exec": {
+        "kinds": ("connection_drop",),
+        "covered_by": "tests/test_pglib.py (disconnect classification)"},
+    "checkpoint.csv.flush": {
+        "kinds": ("torn_write", "kill"),
+        "covered_by": "tests/test_chaos.py (SIGKILL mid-batch resume)"},
+    "checkpoint.cluster.save": {
+        "kinds": ("torn_write", "kill"),
+        "covered_by": "tests/test_cluster_checkpoint.py + chaos drivers "
+                      "(torn-shard detection on resume)"},
+    "store.sig.save": {
+        "kinds": ("kill", "torn_write", "raise"),
+        "covered_by": "this matrix (seat `kill`) + "
+                      "tests/test_cluster_store.py"},
+    "store.compact.save": {
+        "kinds": ("kill",),
+        "covered_by": "tests/test_cluster_store.py (SIGKILL "
+                      "mid-compaction chaos)"},
+    "store.state.save": {
+        "kinds": ("kill", "torn_write"),
+        "covered_by": "tests/test_cluster_store.py (state-commit kill -> "
+                      "union fallback)"},
+    "pipeline.h2d": {
+        "kinds": ("stall", "raise", "hostloss", "zombie", "kill"),
+        "covered_by": "this matrix (seats `stall`, `oom`, `hostloss`, "
+                      "`zombie`, `heartbeat-timeout`)"},
+    "pipeline.compute": {
+        "kinds": ("stall",),
+        "covered_by": "tests/test_watchdog_degradation.py (compute-stall "
+                      "cancel+retry)"},
+    "backend.device.call": {
+        "kinds": ("raise", "stall"),
+        "covered_by": "tests/test_backend_auto.py (host-oracle re-run + "
+                      "device demotion)"},
+}
+
+
+def plan_rule(site: str, **kw) -> dict:
+    """A fault-plan rule whose site must be in PRODUCTION_SEATS — the
+    inventory is load-bearing for the matrix's own plans."""
+    assert site in PRODUCTION_SEATS, \
+        f"{site} missing from PRODUCTION_SEATS"
+    return {"site": site, **kw}
+
 
 def run_bench(store: str, plan: dict | None = None, env_extra: dict | None
               = None, expect_kill: bool = False) -> dict | None:
@@ -78,8 +143,8 @@ def run_bench(store: str, plan: dict | None = None, env_extra: dict | None
 
 
 def seat_stall(store: str) -> dict:
-    plan = {"rules": [{"site": "pipeline.h2d", "kind": "stall",
-                       "stall_s": 3.0, "times": 1}]}
+    plan = {"rules": [plan_rule("pipeline.h2d", kind="stall",
+                                stall_s=3.0, times=1)]}
     r = run_bench(store, plan,
                   env_extra={"TSE1M_WATCHDOG_MIN_BUDGET_S": "0.5"})
     assert r["degradation_counts"].get("stall_retry", 0) >= 1, r
@@ -88,9 +153,10 @@ def seat_stall(store: str) -> dict:
 
 
 def seat_oom(store: str) -> dict:
-    plan = {"rules": [{"site": "pipeline.h2d", "kind": "raise",
-                       "message": "RESOURCE_EXHAUSTED: injected 1GiB "
-                                  "allocation failure", "times": 1}]}
+    plan = {"rules": [plan_rule("pipeline.h2d", kind="raise",
+                                message="RESOURCE_EXHAUSTED: injected "
+                                        "1GiB allocation failure",
+                                times=1)]}
     r = run_bench(store, plan)
     assert r["chunk_halvings"] >= 1, r
     assert r["degradation_counts"].get("chunk_halving", 0) >= 1, r
@@ -98,7 +164,7 @@ def seat_oom(store: str) -> dict:
 
 
 def seat_kill(store: str) -> dict:
-    plan = {"rules": [{"site": "store.sig.save", "kind": "kill"}]}
+    plan = {"rules": [plan_rule("store.sig.save", kind="kill")]}
     run_bench(store, plan, expect_kill=True)
     # the kill stranded torn temp shards; the rerun must sweep them,
     # recompute, and recover full parity (bench's internal warm assert)

@@ -1,0 +1,9 @@
+"""Fixture stand-in for resilience/coordinator.py's lease plane."""
+
+
+class LeaseSupersededError(RuntimeError):
+    pass
+
+
+def verify_lease(root, range_id):
+    raise LeaseSupersededError(range_id)

@@ -1,0 +1,11 @@
+"""BAD: membership.json mutated directly instead of through the
+MembershipLedger (a torn or non-monotonic write breaks epoch fencing)."""
+
+import json
+import os
+
+
+def rewrite_membership(pod_dir, members):
+    with open(os.path.join(pod_dir, "membership.json"), "w") as f:
+        json.dump({"members": members}, f)
+    os.replace("unused", "unused2")  # keep the per-file rule quiet

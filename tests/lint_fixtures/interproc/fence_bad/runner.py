@@ -1,0 +1,12 @@
+"""BAD: a supervision path whose broad except absorbs the fence signal
+(LeaseSupersededError raised three calls down)."""
+
+from .store import ShardedSignatureStore
+
+
+def supervise(rows):
+    st = ShardedSignatureStore("/tmp/x")
+    try:
+        return st.append_fenced(rows)
+    except Exception:
+        return None  # BAD: the zombie fence signal dies here

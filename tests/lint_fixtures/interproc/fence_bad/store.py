@@ -1,0 +1,29 @@
+"""BAD: a per-range append reached without any lease fence."""
+
+from .coordinator import verify_lease
+
+
+class SignatureStore:
+    def __init__(self, root):
+        self.root = root
+
+    def append(self, rows):
+        return len(rows)
+
+
+class ShardedSignatureStore:
+    def __init__(self, root):
+        self.root = root
+
+    def range_store(self, r):
+        store = SignatureStore(self.root)
+        return store
+
+    def append_unfenced(self, rows):
+        # BAD: nothing dominates this per-range append — a superseded
+        # writer would double-write its re-dealt range.
+        return self.range_store(0).append(rows)
+
+    def append_fenced(self, rows):
+        verify_lease(self.root, 0)
+        return self.range_store(0).append(rows)

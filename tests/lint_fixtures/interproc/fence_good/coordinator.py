@@ -1,0 +1,33 @@
+"""GOOD twin: the lease plane with the blessed mutation seats."""
+
+import json
+import os
+
+
+class LeaseSupersededError(RuntimeError):
+    pass
+
+
+def verify_lease(root, range_id):
+    raise LeaseSupersededError(range_id)
+
+
+def atomic_write(path):
+    return open(path + ".tmp", "w")
+
+
+def write_lease(root, range_id, epoch):
+    with atomic_write(os.path.join(root, f"lease_{range_id}.json")) as f:
+        json.dump({"range": range_id, "epoch": epoch}, f)
+
+
+class MembershipLedger:
+    def __init__(self, pod_dir):
+        self.path = os.path.join(pod_dir, "membership.json")
+
+    def _write(self, rec):
+        with atomic_write(self.path) as f:
+            json.dump(rec, f)
+
+    def advance(self, members):
+        self._write({"members": members})

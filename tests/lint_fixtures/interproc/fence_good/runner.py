@@ -1,0 +1,22 @@
+"""GOOD twin: the supervision path lets the fence signal escape — a
+bare ``raise`` relays it, and an explicit LeaseSupersededError handler
+is deliberate handling, not absorption."""
+
+from .coordinator import LeaseSupersededError
+from .store import ShardedSignatureStore
+
+
+def supervise(rows):
+    st = ShardedSignatureStore("/tmp/x")
+    try:
+        return st.append(rows)
+    except Exception:
+        raise  # the fence signal propagates verbatim
+
+
+def supervise_handled(rows):
+    st = ShardedSignatureStore("/tmp/x")
+    try:
+        return st.append(rows)
+    except LeaseSupersededError:
+        return None  # deliberate: demoted to read-only upstream

@@ -1,0 +1,27 @@
+"""GOOD twin: every per-range append is dominated by a lease check."""
+
+from .coordinator import verify_lease
+
+
+class SignatureStore:
+    def __init__(self, root):
+        self.root = root
+
+    def append(self, rows):
+        return len(rows)
+
+
+class ShardedSignatureStore:
+    def __init__(self, root):
+        self.root = root
+
+    def _check_lease(self, r):
+        verify_lease(self.root, r)
+
+    def range_store(self, r):
+        store = SignatureStore(self.root)
+        return store
+
+    def append(self, rows):
+        self._check_lease(0)
+        return self.range_store(0).append(rows)

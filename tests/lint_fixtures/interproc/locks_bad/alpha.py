@@ -1,0 +1,22 @@
+"""BAD: takes alpha's lock, then calls into beta which takes beta's
+lock — while beta.flush does the reverse.  Cross-module cycle."""
+
+import threading
+
+from . import beta
+
+
+class Recorder:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def add(self):
+        with self._lock:
+            m = beta.Monitor()
+            m.poll()
+
+    def relock(self):
+        # BAD on its own: non-reentrant Lock re-acquired under itself.
+        with self._lock:
+            with self._lock:
+                pass

@@ -1,0 +1,19 @@
+"""BAD: the reverse acquisition order of alpha.py (cycle closes)."""
+
+import threading
+
+from . import alpha
+
+
+class Monitor:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def poll(self):
+        with self._lock:
+            pass
+
+    def flush(self):
+        with self._lock:
+            r = alpha.Recorder()
+            r.add()

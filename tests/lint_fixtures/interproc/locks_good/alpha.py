@@ -1,0 +1,16 @@
+"""GOOD twin: both modules take the locks in one global order
+(alpha._lock before beta._lock, never the reverse)."""
+
+import threading
+
+from . import beta
+
+
+class Recorder:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def add(self):
+        with self._lock:
+            m = beta.Monitor()
+            m.poll()

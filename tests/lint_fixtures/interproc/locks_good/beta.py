@@ -1,0 +1,29 @@
+"""GOOD twin: beta's flush releases its lock before calling back into
+alpha (no hold-and-acquire in the reverse order), and the re-entrant
+path uses an RLock."""
+
+import threading
+
+from . import alpha
+
+
+class Monitor:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rent = threading.RLock()
+
+    def poll(self):
+        with self._lock:
+            pass
+
+    def flush(self):
+        with self._lock:
+            pending = True
+        if pending:
+            r = alpha.Recorder()
+            r.add()
+
+    def reenter(self):
+        with self._rent:
+            with self._rent:
+                pass

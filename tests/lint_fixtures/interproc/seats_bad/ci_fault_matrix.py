@@ -1,0 +1,8 @@
+"""Fixture matrix inventory with every drift class: a live seat is
+covered, one entry is dead, and one lists an unknown fault kind."""
+
+PRODUCTION_SEATS = {
+    "store.sig.save": {"kinds": ("kill",), "covered_by": "seat kill"},
+    "store.gone.save": {"kinds": ("kill",), "covered_by": "nothing"},
+    "store.meteor.save": {"kinds": ("meteor",), "covered_by": "nothing"},
+}

@@ -1,0 +1,14 @@
+"""BAD: production seats that drifted from the matrix inventory —
+``store.extra.save`` has no PRODUCTION_SEATS entry."""
+
+
+def fault_point(site, path=None):  # stand-in for resilience.faults
+    pass
+
+
+def save_shard(path):
+    fault_point("store.sig.save", path=path)
+
+
+def save_extra(path):
+    fault_point("store.extra.save", path=path)

@@ -1,0 +1,7 @@
+"""Fixture matrix inventory matching seats_good/prod.py exactly
+(including a seat name resolved through a parameter default)."""
+
+PRODUCTION_SEATS = {
+    "store.sig.save": {"kinds": ("kill",), "covered_by": "seat kill"},
+    "http.fetch": {"kinds": ("raise", "stall"), "covered_by": "tests"},
+}

@@ -1,0 +1,3 @@
+"""Fixture stand-in for resilience/faults.py's kind registry."""
+
+_KINDS = ("raise", "kill", "stall")

@@ -1,0 +1,14 @@
+"""GOOD twin: every declared seat has a matrix entry and vice versa."""
+
+
+def fault_point(site, path=None):  # stand-in for resilience.faults
+    pass
+
+
+def save_shard(path):
+    fault_point("store.sig.save", path=path)
+
+
+def fetch(url, site="http.fetch"):
+    fault_point(site)
+    return url

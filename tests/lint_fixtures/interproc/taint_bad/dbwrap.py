@@ -1,0 +1,15 @@
+"""BAD: a helper that launders a raw cursor past the per-file rule.
+
+The parameter is named ``c`` (not ``cur``/``cursor``), so the per-file
+``retry-bypass`` heuristic cannot see the raw seat; only the
+interprocedural cursor-capability pass can — the caller passes a real
+``conn.cursor()`` in.  ``sql`` makes this function a SQL sink: whatever
+string arrives here is executed verbatim."""
+
+
+def run_stmt(c, sql):
+    c.execute(sql)
+
+
+def run_many(c, sql, rows):
+    c.executemany(sql, rows)

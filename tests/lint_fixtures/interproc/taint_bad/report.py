@@ -1,0 +1,13 @@
+"""BAD: interpolated SQL built two calls away from the raw execute.
+
+The f-string itself contains no ``execute`` call, and ``run_stmt``'s
+file never interpolates — only the cross-file taint pass connects the
+two (sql-interp at the call below, retry-bypass at dbwrap's seat)."""
+
+from .dbwrap import run_stmt
+
+
+def daily_report(conn, table):
+    cur = conn.cursor()
+    run_stmt(cur, f"SELECT * FROM {table}")
+    return cur

@@ -1,0 +1,6 @@
+"""GOOD twin of taint_bad/dbwrap.py: the helper takes the blessed DB
+wrapper (opaque object, no raw cursor capability flows in)."""
+
+
+def run_stmt(db, sql, params=()):
+    return db.execute(sql, params)

@@ -1,0 +1,15 @@
+"""GOOD twin of taint_bad/report.py: identifiers route through the
+db/ident.py helpers and values bind as parameters, so the SQL text
+reaching the sink is blessed at every hop."""
+
+from .dbwrap import run_stmt
+
+
+def quote_ident(name):
+    return '"' + str(name).replace('"', '""') + '"'
+
+
+def daily_report(db, table, day):
+    run_stmt(db, f"SELECT * FROM {quote_ident(table)} WHERE day = ?",
+             (day,))
+    run_stmt(db, "SELECT COUNT(*) FROM builds")

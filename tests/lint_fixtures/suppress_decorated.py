@@ -1,0 +1,26 @@
+"""Regression fixture: a standalone suppression directly above a
+DECORATED def must cover the whole decorator span (including multi-line
+decorator continuation lines) and the ``def`` line itself."""
+
+import functools
+
+import jax
+
+
+def probe(const):
+    def deco(fn):
+        return fn
+    return deco
+
+
+# graftlint: disable=wire-layer -- fixture: pinned probe constant rides the decorator
+@probe(
+    jax.device_put([1]))
+def suppressed(x):
+    return x
+
+
+@probe(
+    jax.device_put([2]))
+def unsuppressed(x):
+    return x

@@ -1,0 +1,307 @@
+"""graftlint v2: the whole-program layer.
+
+Covers the four interprocedural passes over good/bad fixture
+mini-projects (tests/lint_fixtures/interproc/), witness chains +
+``--why``, the digest cache (hits, invalidation, warm==cold findings),
+the reverse-dependency closure, and the decorated-def suppression
+regression."""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import shutil
+
+import pytest
+
+from tse1m_tpu.lint import engine as lint_engine
+from tse1m_tpu.lint.engine import lint_project, main
+from tse1m_tpu.lint.graph import build_graph, content_digest
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "lint_fixtures")
+INTERPROC = os.path.join(FIXTURES, "interproc")
+
+
+def fixture_paths(subdir: str) -> list:
+    return sorted(glob.glob(os.path.join(INTERPROC, subdir, "*.py")))
+
+
+def run_fixture(subdir: str, rule: str | None = None):
+    """Interprocedural findings over one fixture mini-project (per-file
+    rules excluded so each pass is pinned in isolation)."""
+    paths = fixture_paths(subdir)
+    assert paths, f"no fixture files under {subdir}"
+    findings, stats, graph = lint_project(
+        paths, paths, rules={}, root=FIXTURES, use_cache=False)
+    out = [f for f in findings if not f.suppressed]
+    if rule is not None:
+        out = [f for f in out if f.rule == rule]
+    return out
+
+
+# -- each pass: bad fires, good twin is silent -------------------------------
+
+@pytest.mark.parametrize("rule,bad,good", [
+    ("sql-interp", "taint_bad", "taint_good"),
+    ("retry-bypass", "taint_bad", "taint_good"),
+    ("lease-fence", "fence_bad", "fence_good"),
+    ("lock-order", "locks_bad", "locks_good"),
+    ("fault-seat-drift", "seats_bad", "seats_good"),
+])
+def test_pass_bad_fires_good_silent(rule, bad, good):
+    assert run_fixture(bad, rule), f"{rule} missed {bad}"
+    assert not run_fixture(good, rule), f"{rule} flagged {good}"
+
+
+def test_taint_findings_anchor_and_witness():
+    sql = run_fixture("taint_bad", "sql-interp")
+    assert len(sql) == 1
+    f = sql[0]
+    # flagged where the interpolated SQL enters the chain ...
+    assert f.path.endswith("taint_bad/report.py")
+    assert "run_stmt" in " ".join(f.witness)
+    # ... with the raw execution seat at the end of the witness chain
+    assert any("raw SQL execution" in w for w in f.witness)
+    raw = run_fixture("taint_bad", "retry-bypass")
+    # the laundered cursor seat is flagged at the real seat (dbwrap)
+    assert any(f.path.endswith("taint_bad/dbwrap.py") for f in raw)
+
+
+def test_lease_fence_finding_classes():
+    found = run_fixture("fence_bad", "lease-fence")
+    msgs = {f.path.rsplit("/", 1)[-1]: f.message for f in found}
+    assert "store.py" in msgs  # unfenced per-range append
+    assert "not dominated" in msgs["store.py"]
+    assert "runner.py" in msgs  # swallowed LeaseSupersededError
+    assert "absorb LeaseSupersededError" in msgs["runner.py"]
+    assert "members.py" in msgs  # ledger-bypassing membership write
+    assert "membership" in msgs["members.py"]
+    # the swallow finding's witness walks down to the raise site
+    swallow = [f for f in found if f.path.endswith("runner.py")][0]
+    assert any("raises LeaseSupersededError" in w for w in swallow.witness)
+
+
+def test_lock_order_cycle_and_self_deadlock():
+    found = run_fixture("locks_bad", "lock-order")
+    msgs = " | ".join(f.message for f in found)
+    assert "cycle" in msgs
+    assert "re-acquired" in msgs
+    # the cycle names both modules' locks
+    cyc = [f for f in found if "cycle" in f.message][0]
+    assert "alpha.Recorder._lock" in cyc.message
+    assert "beta.Monitor._lock" in cyc.message
+
+
+def test_fault_seat_drift_classes():
+    found = run_fixture("seats_bad", "fault-seat-drift")
+    msgs = " | ".join(f.message for f in found)
+    assert "store.extra.save" in msgs       # seat without matrix entry
+    assert "store.gone.save" in msgs        # dead matrix entry
+    assert "meteor" in msgs                 # unknown fault kind
+    missing = [f for f in found if "store.extra.save" in f.message][0]
+    assert missing.path.endswith("seats_bad/prod.py")
+    dead = [f for f in found if "store.gone.save" in f.message][0]
+    assert dead.path.endswith("seats_bad/ci_fault_matrix.py")
+
+
+# -- --why witness chains through the CLI ------------------------------------
+
+def test_why_prints_witness_chain(capsys):
+    paths = fixture_paths("fence_bad")
+    found = run_fixture("fence_bad", "lease-fence")
+    target = [f for f in found if f.path.endswith("runner.py")][0]
+    # main() anchors paths at the REPO root, not the fixture root
+    repo_rel = f"tests/lint_fixtures/{target.path}"
+    rc = main(paths + ["--no-cache", "--why",
+                       f"lease-fence:{repo_rel}:{target.line}"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "lease-fence" in out
+    assert "raises LeaseSupersededError" in out
+
+
+@pytest.mark.parametrize("rule,subdir,expect", [
+    ("sql-interp", "taint_bad", "raw SQL execution"),
+    ("retry-bypass", "taint_bad", "dbwrap"),
+    ("lease-fence", "fence_bad", "LeaseSupersededError"),
+    ("lock-order", "locks_bad", "_lock"),
+    ("fault-seat-drift", "seats_bad", "fault_point"),
+])
+def test_why_works_for_every_pass(capsys, rule, subdir, expect):
+    """Acceptance: each seeded bad fixture is detected AND its --why
+    witness chain prints through the CLI."""
+    paths = fixture_paths(subdir)
+    found = [f for f in run_fixture(subdir, rule) if f.witness]
+    assert found
+    outputs = []
+    for target in found:
+        repo_rel = f"tests/lint_fixtures/{target.path}"
+        rc = main(paths + ["--no-cache", "--why",
+                           f"{rule}:{repo_rel}:{target.line}"])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert rule in out
+        outputs.append(out)
+    assert any(expect in out for out in outputs)
+
+
+def test_why_unknown_location_errors(capsys):
+    paths = fixture_paths("fence_good")
+    rc = main(paths + ["--no-cache", "--why", "lease-fence:nope.py:1"])
+    assert rc == 2
+
+
+def test_graph_mode_prints_edges(capsys):
+    paths = fixture_paths("taint_bad")
+    rc = main(paths + ["--no-cache", "--graph"])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["functions"] >= 3
+    assert any("daily_report" in e and e.endswith("dbwrap.run_stmt")
+               for e in report["edges"])
+
+
+# -- digest cache: hits, invalidation, warm == cold --------------------------
+
+def _copy_fixture(tmp_path, subdir):
+    dst = tmp_path / subdir
+    shutil.copytree(os.path.join(INTERPROC, subdir), dst)
+    return sorted(str(p) for p in dst.glob("*.py"))
+
+
+def test_digest_cache_hits_and_invalidation(tmp_path):
+    paths = _copy_fixture(tmp_path, "fence_bad")
+    root = str(tmp_path)
+    g1 = build_graph(paths, root=root, use_cache=True)
+    assert g1.cache_hits == 0
+    assert len(g1.extracted) == len(paths)
+    g2 = build_graph(paths, root=root, use_cache=True)
+    assert g2.cache_hits == g2.cache_files == len(paths)
+    assert g2.extracted == []
+    # edit ONE file -> only that file re-extracts
+    store = [p for p in paths if p.endswith("store.py")][0]
+    with open(store, "a") as f:
+        f.write("\n# touched\n")
+    g3 = build_graph(paths, root=root, use_cache=True)
+    assert [p.rsplit("/", 1)[-1] for p in g3.extracted] == ["store.py"]
+    assert g3.cache_hits == len(paths) - 1
+
+
+def test_warm_findings_equal_cold(tmp_path):
+    paths = _copy_fixture(tmp_path, "fence_bad")
+    root = str(tmp_path)
+
+    def run():
+        findings, _, _ = lint_project(paths, paths, rules={}, root=root,
+                                      use_cache=True)
+        return [(f.rule, f.path, f.line, f.message)
+                for f in findings if not f.suppressed]
+
+    cold = run()
+    warm = run()  # second run: all facts from the digest cache
+    assert cold and warm == cold
+
+
+def test_reverse_dependency_closure(tmp_path):
+    paths = _copy_fixture(tmp_path, "taint_bad")
+    root = str(tmp_path)
+    g = build_graph(paths, root=root, use_cache=False)
+    wrap = "taint_bad/dbwrap.py"
+    closure = g.reverse_closure({wrap})
+    # report.py imports dbwrap.py, so editing dbwrap re-lints report too
+    assert closure == {wrap, "taint_bad/report.py"}
+
+
+def test_content_digest_stability():
+    assert content_digest(b"x") == content_digest(b"x")
+    assert content_digest(b"x") != content_digest(b"y")
+
+
+# -- matrix inventory vs the real tree ---------------------------------------
+
+def test_real_tree_fault_seats_match_matrix():
+    """The acceptance gate for fault-seat-drift: the real tree's seats
+    and tests/ci_fault_matrix.py's PRODUCTION_SEATS agree, and every
+    matrix plan site is a declared production seat."""
+    from tse1m_tpu.lint.engine import default_targets, repo_root
+    from tse1m_tpu.lint.interproc import fault_seat_drift_pass
+
+    root = repo_root()
+    graph = build_graph(default_targets(root), root=root, use_cache=False)
+    findings = fault_seat_drift_pass(graph)
+    assert findings == [], [f.message for f in findings]
+
+    import sys
+    sys.path.insert(0, os.path.dirname(__file__))
+    import ci_fault_matrix as m
+
+    from tse1m_tpu.resilience.faults import _KINDS
+    for seat, rec in m.PRODUCTION_SEATS.items():
+        assert set(rec["kinds"]) <= set(_KINDS), (seat, rec["kinds"])
+        assert rec["covered_by"]
+    # the matrix's own plan builder refuses undeclared sites
+    with pytest.raises(AssertionError):
+        m.plan_rule("store.not.a.seat", kind="kill")
+
+
+# -- suppression attaches across decorated defs (ride-along bugfix) ----------
+
+def test_suppression_covers_decorated_def():
+    path = os.path.join(FIXTURES, "suppress_decorated.py")
+    src = lint_engine.load_source(path, "suppress_decorated.py")
+    from tse1m_tpu.lint.rules import RULES
+
+    findings = []
+    for f in RULES["wire-layer"](src):
+        f.rule = "wire-layer"
+        disabled = src.line_disables.get(f.line, set())
+        if "wire-layer" not in disabled:
+            findings.append(f)
+    # the suppressed decorator's device_put is covered (multi-line
+    # decorator continuation), the control one still fires
+    assert len(findings) == 1
+    assert src.lines[findings[0].line - 1].strip().startswith(
+        "jax.device_put([2])")
+
+
+def test_suppression_covers_def_line(tmp_path):
+    p = tmp_path / "s.py"
+    p.write_text(
+        "def deco(fn):\n    return fn\n\n"
+        "# graftlint: disable=broad-except -- fixture\n"
+        "@deco\n"
+        "def f():\n"
+        "    try:\n        pass\n"
+        "    except Exception:\n        pass\n")
+    src = lint_engine.load_source(str(p), "s.py")
+    # the disable set spread from the decorator line to the def line
+    assert "broad-except" in src.line_disables.get(5, set())
+    assert "broad-except" in src.line_disables.get(6, set())
+
+
+# -- incremental mode --------------------------------------------------------
+
+def test_changed_closure_with_git(tmp_path):
+    import subprocess
+
+    def git(*a):
+        subprocess.run(["git", *a], cwd=tmp_path, check=True,
+                       capture_output=True)
+
+    paths = _copy_fixture(tmp_path, "taint_good")
+    git("init", "-q")
+    git("add", "-A")
+    git("-c", "user.email=t@t", "-c", "user.name=t", "commit", "-qm", "x")
+    wrap = [p for p in paths if p.endswith("dbwrap.py")][0]
+    with open(wrap, "a") as f:
+        f.write("\n# edited\n")
+    from tse1m_tpu.lint.engine import changed_closure
+
+    report, info = changed_closure(str(tmp_path), "HEAD", paths)
+    assert info["changed"] == ["taint_good/dbwrap.py"]
+    # the closure pulls in the importer of the edited file
+    assert info["closure"] == ["taint_good/dbwrap.py",
+                               "taint_good/report.py"]
+    assert sorted(os.path.basename(p) for p in report) == \
+        ["dbwrap.py", "report.py"]

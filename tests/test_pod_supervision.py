@@ -120,6 +120,32 @@ def test_supervisor_guarded_passes_result_through(tmp_path):
     assert sup.guarded(lambda: 41 + 1, site="unit.ok") == 42
 
 
+def test_supervisor_guarded_relays_fence_signal_verbatim(tmp_path):
+    """A LeaseSupersededError from the guarded phase must NOT be
+    reclassified as HostLostError even when the peers look dead (the
+    zombie's peers finished and exited, so their heartbeats stopped):
+    wrapping the fence signal would send the fenced writer down the
+    failover path to re-execute — the exact double-write the epoch
+    leases exist to prevent (graftlint lease-fence semantics: `raise X
+    from e` converts the signal away)."""
+    from tse1m_tpu.resilience.coordinator import LeaseSupersededError
+
+    sup = PodSupervisor(str(tmp_path), n_processes=2, process_id=0,
+                        interval_s=0.05, timeout_s=0.2)
+    # peer 1 never beats -> the monitor would declare it lost
+
+    def fenced():
+        raise LeaseSupersededError(
+            0, {"epoch": 1, "owner": 0, "nonce": "a"},
+            {"epoch": 2, "owner": 1, "nonce": "b"})
+
+    t0 = time.monotonic()
+    with pytest.raises(LeaseSupersededError):
+        sup.guarded(fenced, site="unit.fence")
+    # verbatim relay is also immediate: no peer-death confirmation wait
+    assert time.monotonic() - t0 < 2.0
+
+
 # -- run nonce / exchange dir -----------------------------------------------
 
 

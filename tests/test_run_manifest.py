@@ -175,6 +175,13 @@ def test_cli_all_runs_survivors_and_reports_failures(tmp_path, stub_rqs,
     assert lint["status"] == "ok"
     assert lint["result"]["new_findings"] == 0
     assert lint["result"]["runtime"]["sanitizer_available"] is True
+    # graftlint v2: the manifest records the whole-program run's shape —
+    # per-rule finding totals (proof the rules ran) plus the digest
+    # cache's hit rate and the graph/wall numbers.
+    assert "cache_hit_rate" in lint["result"]
+    assert lint["result"]["graph_functions"] > 100
+    assert lint["result"]["wall_s"] > 0
+    assert "by_rule_total" in lint["result"]
     assert by_name["rq3"]["status"] == "failed"
     assert "permanent rq fault" in by_name["rq3"]["error"]
     assert "permanent rq fault" in by_name["rq3"]["traceback"]

@@ -233,6 +233,12 @@ def _cmd_lint(args) -> int:
         argv.append("--no-baseline")
     if args.rules:
         argv += ["--rules", args.rules]
+    if args.changed:
+        argv += ["--changed", args.changed]
+    if args.why:
+        argv += ["--why", args.why]
+    if args.graph:
+        argv.append("--graph")
     return lint_main(argv)
 
 
@@ -763,6 +769,13 @@ def main(argv=None) -> int:
     p.add_argument("--write-baseline", action="store_true")
     p.add_argument("--no-baseline", action="store_true")
     p.add_argument("--rules", default=None)
+    p.add_argument("--changed", metavar="REF", default=None,
+                   help="incremental: lint files differing from REF plus "
+                        "their reverse-dependency closure")
+    p.add_argument("--why", metavar="RULE:PATH:LINE", default=None,
+                   help="print the witness call chain for one finding")
+    p.add_argument("--graph", action="store_true",
+                   help="print the import/call-graph summary")
     p.set_defaults(fn=_cmd_lint)
 
     p = sub.add_parser("scrub",

@@ -53,7 +53,7 @@ class SeleniumIssueClient:
     def close(self) -> None:
         try:
             self.driver.quit()
-        except Exception:
+        except Exception:  # graftlint: disable=broad-except -- best-effort driver teardown; no fault seat fires inside quit()
             pass
 
     # -- helpers ------------------------------------------------------------
@@ -123,7 +123,7 @@ class SeleniumIssueClient:
             page.hotlists = [el.text for el in self.driver.find_elements(
                 By.CSS_SELECTOR, "b-hotlist-chip-smart span.name a")
                 if el.text]
-        except Exception:
+        except Exception:  # graftlint: disable=broad-except -- optional hotlist-chip scrape; the driver raises arbitrary exceptions and no fault seat fires inside
             pass
 
         try:
@@ -142,7 +142,7 @@ class SeleniumIssueClient:
         except TimeoutException:
             log.info("no description container for %s", page.final_id)
 
-        time.sleep(random.uniform(*self.page_delay))
+        time.sleep(random.uniform(*self.page_delay))  # graftlint: disable=nondeterminism -- human-like page pacing against the live tracker; scrape cadence is deliberately not replayable
         return page
 
     def _scrape_metadata(self) -> dict:

@@ -12,8 +12,16 @@ b-bit-minwise argument applied to correctness tooling):
 
 - :mod:`engine` — AST rule engine: per-rule suppression comments
   (``# graftlint: disable=RULE -- reason``), a committed baseline for
-  grandfathered findings, machine-readable JSON output.
-- :mod:`rules` — the rule catalog (see LINTING.md for rationale).
+  grandfathered findings, machine-readable JSON output, and the v2
+  whole-program driver (``--why`` witness chains, ``--changed``
+  incremental mode, ``--graph``).
+- :mod:`rules` — the per-file rule catalog (see LINTING.md).
+- :mod:`graph` — the project import/call graph: per-file fact
+  extraction, cross-module symbol resolution, digest-cached facts.
+- :mod:`interproc` — the whole-program passes: cross-file
+  sql-interp/retry-bypass taint, ``lease-fence`` protocol dominance +
+  LeaseSupersededError exception flow, ``lock-order`` cycle detection,
+  ``fault-seat-drift`` matrix cross-check.
 - :mod:`runtime` — the runtime half: ``jax.transfer_guard`` wiring and a
   jit compile counter, asserting the cluster hot loop performs zero
   implicit host->device transfers within a bounded compile budget.
@@ -24,10 +32,10 @@ or baselined.
 """
 
 from .engine import (BASELINE_DEFAULT, Baseline, Finding, LintError,
-                     lint_paths, load_source, main, repo_root,
-                     run_repo_lint)
+                     lint_paths, lint_project, load_source, main,
+                     repo_root, run_repo_lint)
 from .rules import RULES
 
 __all__ = ["BASELINE_DEFAULT", "Baseline", "Finding", "LintError", "RULES",
-           "lint_paths", "load_source", "main", "repo_root",
-           "run_repo_lint"]
+           "lint_paths", "lint_project", "load_source", "main",
+           "repo_root", "run_repo_lint"]

@@ -33,6 +33,7 @@ import json
 import os
 import re
 import sys
+import time
 from dataclasses import asdict, dataclass, field
 
 BASELINE_DEFAULT = os.path.join(os.path.dirname(__file__), "baseline.json")
@@ -62,6 +63,10 @@ class Finding:
     text: str = ""     # stripped source line (baseline matching key)
     baselined: bool = False
     suppressed: bool = False
+    # Interprocedural findings carry the call chain that proves them
+    # (``--why RULE:path:line`` prints it); per-file findings leave it
+    # empty.  Not part of the baseline key.
+    witness: list = field(default_factory=list)
 
     def key(self) -> tuple:
         return (self.rule, self.path, self.text)
@@ -109,6 +114,21 @@ def load_source(abspath: str, relpath: str) -> FileSource:
         src.suppress_reasons.append(
             {"line": i, "scope": m.group(1), "rules": sorted(rules),
              "reason": reason})
+    # A standalone suppression directly above a DECORATED def targets
+    # the first decorator line; findings may anchor anywhere in the
+    # decorator stack (multi-line decorators) or on the `def` line
+    # itself, so the disable set spreads across the whole span.
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)) and node.decorator_list:
+            first = min(d.lineno for d in node.decorator_list)
+            covered = src.line_disables.get(first)
+            if not covered:
+                continue
+            last = max([getattr(d, "end_lineno", d.lineno)
+                        for d in node.decorator_list] + [node.lineno])
+            for ln in range(first, last + 1):
+                src.line_disables.setdefault(ln, set()).update(covered)
     return src
 
 
@@ -254,33 +274,165 @@ def lint_paths(paths: list[str], rules: dict | None = None,
     return findings
 
 
+def _resolve_flags(findings: list[Finding], sources: dict,
+                   root: str) -> None:
+    """Fill text + suppression flags for findings whose file wasn't in
+    the per-file loop (interprocedural findings can anchor anywhere,
+    including tests/ci_fault_matrix.py)."""
+    for f in findings:
+        src = sources.get(f.path)
+        if src is None:
+            try:
+                src = load_source(os.path.join(root, f.path), f.path)
+            except (OSError, SyntaxError):
+                continue
+            sources[f.path] = src
+        if not f.text and 1 <= f.line <= len(src.lines):
+            f.text = src.lines[f.line - 1].strip()
+        disabled = src.line_disables.get(f.line, set())
+        if (f.rule in src.file_disables or f.rule in disabled
+                or "*" in disabled):
+            f.suppressed = True
+
+
+def lint_project(report_paths: list[str], graph_paths: list[str],
+                 rules: dict | None = None,
+                 project_rules: set | None = None,
+                 root: str | None = None,
+                 baseline: "Baseline | None" = None,
+                 use_cache: bool = True,
+                 matrix_path: str | None = None):
+    """The whole-program lint driver.
+
+    Per-file rules run over ``report_paths``; the interprocedural
+    passes (lint/interproc.py) run over the ProjectGraph built from
+    ``graph_paths`` (a superset — unchanged files come from the digest
+    cache).  Returns ``(findings, stats)`` where stats carries the
+    graph/cache/wall numbers the run manifest records."""
+    from .graph import build_graph
+    from .interproc import run_project_passes
+
+    t0 = time.perf_counter()
+    root = root or repo_root()
+    findings = lint_paths(report_paths, rules=rules, root=root,
+                          baseline=None)
+    sources: dict = {}
+    prebuilt: dict = {}
+    for abspath in report_paths:
+        rel = os.path.relpath(os.path.abspath(abspath), root)
+        rel = rel.replace(os.sep, "/")
+        try:
+            src = load_source(abspath, rel)
+        except (OSError, SyntaxError):
+            continue
+        sources[rel] = src
+        prebuilt[os.path.abspath(abspath)] = (rel, src.text, src.tree)
+    graph = build_graph(graph_paths, root=root, sources=prebuilt,
+                        use_cache=use_cache)
+    if matrix_path is None:
+        # Fixture runs carry their own seat inventory: a linted file
+        # named ci_fault_matrix.py overrides tests/ci_fault_matrix.py.
+        for abspath in report_paths:
+            if os.path.basename(abspath) == "ci_fault_matrix.py":
+                matrix_path = os.path.abspath(abspath)
+                break
+    project = run_project_passes(graph, wanted_rules=project_rules,
+                                 matrix_path=matrix_path)
+    _resolve_flags(project, sources, root)
+    findings += project
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    if baseline is not None:
+        for f in findings:
+            if not f.suppressed:
+                f.baselined = baseline.absorb(f)
+    stats = {
+        "wall_s": round(time.perf_counter() - t0, 3),
+        "graph_files": len(graph.facts),
+        "graph_functions": len(graph.functions),
+        "graph_call_edges": sum(len(v) for v in graph.calls.values()),
+        "cache_files": graph.cache_files,
+        "cache_hits": graph.cache_hits,
+        "cache_hit_rate": round(
+            graph.cache_hits / graph.cache_files, 4)
+        if graph.cache_files else 0.0,
+    }
+    return findings, stats, graph
+
+
+def _git_changed(root: str, ref: str) -> set:
+    """Repo-relative paths of files that differ from ``ref`` (committed
+    diff + working tree + untracked)."""
+    import subprocess
+
+    out: set = set()
+    for cmd in (["git", "diff", "--name-only", ref, "--"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            proc = subprocess.run(cmd, cwd=root, capture_output=True,
+                                  text=True, timeout=30, check=True)
+        except (OSError, subprocess.SubprocessError) as e:
+            raise RuntimeError(
+                f"--changed {ref}: {' '.join(cmd)} failed: {e}") from e
+        out |= {ln.strip() for ln in proc.stdout.splitlines()
+                if ln.strip().endswith(".py")}
+    return out
+
+
+def changed_closure(root: str, ref: str, targets: list[str]):
+    """(report_paths, info) for ``--changed REF``: files whose content
+    digest differs from the cache/ref plus their reverse-dependency
+    closure from the import graph."""
+    from .graph import build_graph
+
+    changed = _git_changed(root, ref)
+    rel_targets = {os.path.relpath(os.path.abspath(p), root)
+                   .replace(os.sep, "/"): p for p in targets}
+    graph = build_graph(targets, root=root, use_cache=True)
+    seed = {rel for rel in changed if rel in rel_targets}
+    closure = graph.reverse_closure(seed) & set(rel_targets)
+    report = [rel_targets[rel] for rel in sorted(closure)]
+    info = {"ref": ref, "changed": sorted(seed),
+            "closure": sorted(closure)}
+    return report, info
+
+
 def summarize(findings: list[Finding],
               stale: list[dict] | None = None) -> dict:
     new = [f for f in findings if not f.suppressed and not f.baselined]
     by_rule: dict[str, int] = {}
     for f in new:
         by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    # Every finding per rule (suppressed/baselined included): the run
+    # manifest's evidence that a rule RAN, not just that it was clean.
+    by_rule_total: dict[str, int] = {}
+    for f in findings:
+        by_rule_total[f.rule] = by_rule_total.get(f.rule, 0) + 1
     return {
         "ok": not new,
         "new_findings": len(new),
         "baselined": sum(1 for f in findings if f.baselined),
         "suppressed": sum(1 for f in findings if f.suppressed),
         "by_rule": dict(sorted(by_rule.items())),
+        "by_rule_total": dict(sorted(by_rule_total.items())),
         "stale_baseline_entries": len(stale or []),
     }
 
 
 def run_repo_lint(baseline_path: str = BASELINE_DEFAULT,
                   root: str | None = None) -> dict:
-    """Programmatic whole-repo lint (the ``cli all`` manifest step).
+    """Programmatic whole-repo lint (the ``cli all`` manifest step):
+    per-file rules plus the interprocedural passes, with the graph's
+    wall time / cache hit rate / rule counts in the summary.
 
     Returns the JSON summary when clean; raises :class:`LintError`
     carrying the summary when there are non-baselined findings."""
     root = root or repo_root()
     baseline = Baseline.load(baseline_path)
-    findings = lint_paths(default_targets(root), root=root,
-                          baseline=baseline)
+    targets = default_targets(root)
+    findings, stats, _ = lint_project(targets, targets,
+                                      baseline=baseline, root=root)
     summary = summarize(findings, baseline.stale_entries())
+    summary.update(stats)
     if not summary["ok"]:
         new = [f for f in findings if not f.suppressed and not f.baselined]
         detail = "; ".join(f"{f.location()} {f.rule}" for f in new[:5])
@@ -288,6 +440,14 @@ def run_repo_lint(baseline_path: str = BASELINE_DEFAULT,
             f"graftlint: {len(new)} non-baselined finding(s): {detail}",
             step_result=summary)
     return summary
+
+
+def _parse_why(spec: str):
+    """'RULE:path:line' -> (rule, path, line) or None."""
+    parts = spec.rsplit(":", 2)
+    if len(parts) != 3 or not parts[2].isdigit():
+        return None
+    return parts[0], parts[1].replace(os.sep, "/"), int(parts[2])
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -308,26 +468,100 @@ def main(argv: list[str] | None = None) -> int:
                          "(keeps reasons of entries that still match)")
     ap.add_argument("--rules", default=None,
                     help="comma-separated subset of rules to run")
+    ap.add_argument("--changed", metavar="REF", default=None,
+                    help="incremental mode: lint only files whose content "
+                         "differs from REF plus their reverse-dependency "
+                         "closure (interprocedural passes still see the "
+                         "whole graph, via the digest cache)")
+    ap.add_argument("--why", metavar="RULE:PATH:LINE", default=None,
+                    help="explain one finding: print the interprocedural "
+                         "witness chain that proves it")
+    ap.add_argument("--graph", action="store_true",
+                    help="print the project import/call-graph summary "
+                         "(with per-file edges for explicit paths)")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="ignore and do not write the digest cache")
     args = ap.parse_args(argv)
 
+    from .interproc import PROJECT_RULES
     from .rules import RULES
 
+    t0 = time.perf_counter()
+    all_rules = set(RULES) | set(PROJECT_RULES)
     rules = RULES
+    project_rules: set | None = None
     if args.rules:
         wanted = {r.strip() for r in args.rules.split(",") if r.strip()}
-        unknown = wanted - set(RULES)
+        unknown = wanted - all_rules
         if unknown:
             print(f"unknown rule(s): {', '.join(sorted(unknown))}; "
-                  f"available: {', '.join(sorted(RULES))}", file=sys.stderr)
+                  f"available: {', '.join(sorted(all_rules))}",
+                  file=sys.stderr)
             return 2
         rules = {k: v for k, v in RULES.items() if k in wanted}
+        project_rules = wanted & set(PROJECT_RULES)
 
     root = repo_root()
-    paths = ([os.path.abspath(p) for p in args.paths] if args.paths
-             else default_targets(root))
+    targets = default_targets(root)
+    changed_info = None
+    if args.changed and args.paths:
+        print("--changed and explicit paths are mutually exclusive",
+              file=sys.stderr)
+        return 2
+    if args.changed:
+        try:
+            report_paths, changed_info = changed_closure(
+                root, args.changed, targets)
+        except RuntimeError as e:
+            print(str(e), file=sys.stderr)
+            return 2
+        graph_paths = targets
+    elif args.paths:
+        report_paths = [os.path.abspath(p) for p in args.paths]
+        graph_paths = report_paths
+    else:
+        report_paths = graph_paths = targets
     old = Baseline.load(args.baseline)
     baseline = None if (args.no_baseline or args.write_baseline) else old
-    findings = lint_paths(paths, rules=rules, root=root, baseline=baseline)
+    findings, stats, graph = lint_project(
+        report_paths, graph_paths, rules=rules,
+        project_rules=project_rules, root=root, baseline=baseline,
+        use_cache=not args.no_cache)
+
+    if args.graph:
+        report = {"files": stats["graph_files"],
+                  "functions": stats["graph_functions"],
+                  "call_edges": stats["graph_call_edges"],
+                  "cache_hit_rate": stats["cache_hit_rate"]}
+        if args.paths:
+            rels = {os.path.relpath(os.path.abspath(p), root)
+                    .replace(os.sep, "/") for p in args.paths}
+            report["edges"] = [
+                f"{q} -> {t}"
+                for q, edges in sorted(graph.calls.items())
+                if graph.fn_file.get(q) in rels
+                for t, _ in edges]
+        print(json.dumps(report, indent=2))
+        return 0
+
+    if args.why:
+        spec = _parse_why(args.why)
+        if spec is None:
+            print("--why wants RULE:path:line", file=sys.stderr)
+            return 2
+        rule, path, line = spec
+        hits = [f for f in findings
+                if f.rule == rule and f.path == path and f.line == line]
+        if not hits:
+            print(f"no {rule} finding at {path}:{line} (run without "
+                  "--why to list findings)", file=sys.stderr)
+            return 2
+        for f in hits:
+            print(f"{f.location()}: {f.rule}: {f.message}")
+            for step in (f.witness or ["(single-file finding — no "
+                                       "interprocedural chain)"]):
+                print(f"    {step}")
+        return 0
 
     if args.write_baseline:
         n = Baseline.write(args.baseline, findings, old=old)
@@ -336,10 +570,15 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     # Stale-entry detection only makes sense against the full target set:
-    # an explicit-path run never visits most baselined files.
+    # an explicit-path or --changed run never visits most baselined files.
+    full_run = not args.paths and not args.changed
     stale = (baseline.stale_entries()
-             if baseline is not None and not args.paths else [])
+             if baseline is not None and full_run else [])
     summary = summarize(findings, stale)
+    summary.update(stats)
+    summary["wall_s"] = round(time.perf_counter() - t0, 3)
+    if changed_info is not None:
+        summary["changed"] = changed_info
     new = [f for f in findings if not f.suppressed and not f.baselined]
     if args.json:
         report = dict(summary)
@@ -353,9 +592,14 @@ def main(argv: list[str] | None = None) -> int:
             print(f"note: stale baseline entry ({e['rule']} at {e['path']}: "
                   f"{e['text'][:60]!r}) — finding fixed, entry can be "
                   "removed", file=sys.stderr)
+        scope = (f"{len(changed_info['closure'])} file(s) in the changed "
+                 f"closure of {changed_info['ref']}, "
+                 if changed_info is not None else "")
         print(f"graftlint: {summary['new_findings']} new, "
               f"{summary['baselined']} baselined, "
               f"{summary['suppressed']} suppressed"
-              + (f", {len(stale)} stale baseline entries" if stale else ""),
+              + (f", {len(stale)} stale baseline entries" if stale else "")
+              + f" ({scope}wall {summary['wall_s']}s, cache hit rate "
+              f"{summary['cache_hit_rate']})",
               file=sys.stderr)
     return 1 if new else 0

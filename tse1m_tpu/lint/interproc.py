@@ -1,0 +1,755 @@
+"""graftlint's interprocedural passes: the pod-protocol verifier.
+
+Four whole-program analyses over the :mod:`graph` ProjectGraph, each a
+fixed-point dataflow over the resolved call graph.  Every finding
+carries a **witness chain** — the call path that proves it — surfaced
+by ``python -m tse1m_tpu.lint --why RULE:path:line``.
+
+- **taint** (extends ``sql-interp`` / ``retry-bypass``): SQL text and
+  cursor/HTTP capability follow calls across files.  A parameter that
+  flows into an ``execute``-family sink (in any callee, any file) makes
+  its function a SQL sink too, so an f-string built two calls away from
+  ``cursor.execute`` is flagged at the point the taint enters the
+  chain.  A cursor passed into a helper makes the helper's
+  ``p.execute(...)`` a raw seat even when the parameter isn't named
+  ``cur``; internals of the blessed DB/transport files reached from
+  outside their public wrappers (``DB.*`` / ``HttpFetcher.*``) are
+  retry bypasses.
+- **lease-fence**: every call path that reaches a per-range
+  ``SignatureStore.append`` / ``_append_rows`` under a sharded root
+  (receiver obtained via ``range_store``) must be dominated by a
+  ``verify_lease`` / ``acquire_lease``-providing call; every
+  ``membership.json`` / ``lease_*`` / ``hb_*`` mutation must go through
+  ``MembershipLedger._write`` / ``write_lease`` /
+  ``HeartbeatWriter.beat_once``; and ``LeaseSupersededError`` must
+  PROPAGATE — a broad handler over a may-raise body absorbs the fence
+  signal unless the original exception provably escapes (bare ``raise``
+  or ``raise e``; ``raise X(...) from e`` converts the signal away and
+  does not count).
+- **lock-order**: the global lock-acquisition graph (``with self._lock``
+  sites, canonicalized per class/module, closed over resolved calls)
+  must be acyclic, and a non-reentrant Lock must never be re-acquired
+  under itself.
+- **fault-seat-drift**: the ``fault_point(...)`` seats declared in
+  production code, the fault kinds in ``resilience/faults.py``, and the
+  ``PRODUCTION_SEATS`` inventory in ``tests/ci_fault_matrix.py`` must
+  agree — a new seat without a matrix entry, a dead matrix entry, or an
+  unknown fault kind fails lint.
+
+Dynamic calls (``fn()`` on a bare callable parameter) stay opaque: the
+passes never guess, so a finding here is a real protocol hole, not a
+resolution artifact.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from .engine import Finding
+from .graph import ProjectGraph
+
+MATRIX_BASENAME = "ci_fault_matrix.py"
+MATRIX_DEFAULT = os.path.join("tests", MATRIX_BASENAME)
+
+# Blessed wrapper classes: calls INTO their methods are the sanctioned
+# way to perform HTTP / DB I/O, so capability propagation stops there.
+_BOUNDARY_CLASSES = ("DB", "HttpFetcher")
+_BLESSED_IO_FILES = ("tse1m_tpu/collect/transport.py",
+                     "tse1m_tpu/db/connection.py",
+                     "tse1m_tpu/db/pglib.py")
+_DB_LAYER = ("tse1m_tpu/db/connection.py", "tse1m_tpu/db/pglib.py")
+
+# The only functions allowed to mutate the pod's protocol files.
+_PROTOCOL_MUTATORS = ("write_lease", "MembershipLedger._write",
+                      "HeartbeatWriter.beat_once")
+
+_FENCE_LEAVES = ("verify_lease", "acquire_lease")
+_SINK_LEAVES = ("append", "_append_rows")
+
+
+def _leaf(qual: str) -> str:
+    return qual.rsplit(".", 1)[-1]
+
+
+def _cls_leaf(qual: str) -> str:
+    """'pkg.mod.Cls.meth' -> 'Cls.meth' (best effort)."""
+    parts = qual.split(".")
+    return ".".join(parts[-2:]) if len(parts) >= 2 else qual
+
+
+def _fmt_edge(graph: ProjectGraph, caller: str, call: dict,
+              callee: str) -> str:
+    return f"{graph.site(caller, call)} {_cls_leaf(caller)} -> " \
+        f"{_cls_leaf(callee)}"
+
+
+def _chain_witness(graph: ProjectGraph, chain: list) -> list:
+    return [_fmt_edge(graph, q, c, t) for q, c, t in chain]
+
+
+def _finding(graph: ProjectGraph, rule: str, qual: str, line: int,
+             col: int, message: str, witness: list | None = None
+             ) -> Finding:
+    f = Finding(rule=rule, path=graph.fn_file.get(qual, "?"), line=line,
+                col=col, message=message)
+    f.witness = list(witness or [])
+    return f
+
+
+def _effective_params(fn: dict) -> list:
+    params = list(fn["params"])
+    if params and params[0] == "self" and (fn.get("cls")
+                                           or "." in fn["qual"]):
+        return params[1:]
+    return params
+
+
+def _arg_for_param(fn_callee: dict, call: dict, param: str):
+    """The arg fact bound to ``param`` at this call site, or None."""
+    kw = call.get("kwargs", {})
+    if param in kw:
+        return kw[param]
+    params = _effective_params(fn_callee)
+    args = call.get("args", [])
+    try:
+        i = params.index(param)
+    except ValueError:
+        return None
+    return args[i] if i < len(args) else None
+
+
+# -- taint: sql-interp + retry-bypass across calls ---------------------------
+
+
+def _is_cursor_expr(fact: dict) -> bool:
+    if fact.get("kind") == "call":
+        return fact.get("callee", "").rsplit(".", 1)[-1] == "cursor"
+    if fact.get("kind") == "var":
+        return fact.get("type", "").rsplit(".", 1)[-1] == "cursor"
+    return False
+
+
+def _is_boundary(graph: ProjectGraph, qual: str) -> bool:
+    """A blessed wrapper entry: DB.* / HttpFetcher.* methods (including
+    their nested closures)."""
+    fn = graph.functions.get(qual)
+    while fn is not None:
+        if fn.get("cls") in _BOUNDARY_CLASSES:
+            return True
+        parent = fn.get("parent")
+        fn = graph.functions.get(parent) if parent else None
+    return False
+
+
+def taint_pass(graph: ProjectGraph) -> list:
+    findings: list[Finding] = []
+
+    # ---- SQL-text parameter summaries (backward fixed point) ----
+    # sql_params[qual][param] = (sink description, next hop) for witness
+    sql_params: dict[str, dict] = {}
+    for qual, fn in graph.functions.items():
+        for call in fn["calls"]:
+            if "exec_recv" not in call:
+                continue
+            args = call.get("args", [])
+            if args and args[0].get("kind") == "param":
+                sql_params.setdefault(qual, {})[args[0]["name"]] = {
+                    "line": call["line"], "next": None,
+                    "seat": f"{graph.site(qual, call)} "
+                            f"{call['callee']}(...)"}
+    changed = True
+    while changed:
+        changed = False
+        for qual, fn in graph.functions.items():
+            for target, call in graph.calls.get(qual, ()):
+                tparams = sql_params.get(target)
+                if not tparams:
+                    continue
+                callee_fn = graph.functions.get(target)
+                if callee_fn is None:
+                    continue
+                for tparam in list(tparams):
+                    fact = _arg_for_param(callee_fn, call, tparam)
+                    if fact and fact.get("kind") == "param":
+                        mine = sql_params.setdefault(qual, {})
+                        if fact["name"] not in mine:
+                            mine[fact["name"]] = {
+                                "line": call["line"],
+                                "next": (target, tparam),
+                                "seat": None}
+                            changed = True
+
+    def sql_witness(start_qual: str, param: str) -> list:
+        out = []
+        qual, p = start_qual, param
+        for _ in range(12):
+            info = sql_params.get(qual, {}).get(p)
+            if info is None:
+                break
+            if info["next"] is None:
+                out.append(f"{info['seat']}  [raw SQL execution]")
+                break
+            nq, np_ = info["next"]
+            out.append(f"{graph.fn_file.get(qual, '?')}:{info['line']} "
+                       f"{_cls_leaf(qual)} passes `{p}` -> "
+                       f"{_cls_leaf(nq)}(`{np_}`)")
+            qual, p = nq, np_
+        return out
+
+    # Tainted SQL entering a cross-function sink: flag at the entry.
+    for qual, fn in graph.functions.items():
+        for target, call in graph.calls.get(qual, ()):
+            tparams = sql_params.get(target)
+            if not tparams:
+                continue
+            callee_fn = graph.functions.get(target)
+            if callee_fn is None:
+                continue
+            for tparam in tparams:
+                fact = _arg_for_param(callee_fn, call, tparam)
+                if fact and fact.get("kind") == "tainted-sql":
+                    wit = [f"{graph.site(qual, call)} {_cls_leaf(qual)} "
+                           f"passes interpolated SQL -> "
+                           f"{_cls_leaf(target)}(`{tparam}`)"]
+                    wit += sql_witness(target, tparam)
+                    findings.append(_finding(
+                        graph, "sql-interp", qual, call["line"],
+                        call["col"],
+                        "interpolated SQL flows into "
+                        f"`{_cls_leaf(target)}({tparam}=...)`, which "
+                        "executes it "
+                        f"{len(wit) - 1} call(s) away — route "
+                        "identifiers through db/ident.py or bind values "
+                        "as parameters (--why shows the chain)",
+                        witness=wit))
+
+    # ---- cursor capability (forward fixed point) ----
+    cursor_params: dict[str, set] = {}
+    changed = True
+    while changed:
+        changed = False
+        for qual, fn in graph.functions.items():
+            for target, call in graph.calls.get(qual, ()):
+                callee_fn = graph.functions.get(target)
+                if callee_fn is None:
+                    continue
+                for param in _effective_params(callee_fn):
+                    fact = _arg_for_param(callee_fn, call, param)
+                    if fact is None:
+                        continue
+                    is_cur = _is_cursor_expr(fact) or (
+                        fact.get("kind") == "param"
+                        and fact["name"] in cursor_params.get(qual, ()))
+                    if is_cur and param not in cursor_params.setdefault(
+                            target, set()):
+                        cursor_params[target].add(param)
+                        changed = True
+    for qual, params in cursor_params.items():
+        fn = graph.functions[qual]
+        path = graph.fn_file[qual]
+        if path in _DB_LAYER:
+            continue
+        for call in fn["calls"]:
+            if call.get("exec_recv") in params:
+                rev = graph.rev_calls.get(qual, [])
+                wit = [_fmt_edge(graph, cq, cc, qual)
+                       for cq, cc in rev[:3]]
+                findings.append(_finding(
+                    graph, "retry-bypass", qual, call["line"],
+                    call["col"],
+                    f"laundered raw cursor execute: `{call['exec_recv']}`"
+                    " is a DB cursor passed in by a caller — this "
+                    "bypasses the DB retry/reconnect engine; use "
+                    "DB.execute/query/run_transaction",
+                    witness=wit))
+
+    # ---- raw-I/O internals of blessed files reached from outside ----
+    raw: set = set()
+    raw_seat: dict[str, str] = {}
+    for qual, fn in graph.functions.items():
+        path = graph.fn_file[qual]
+        for call in fn["calls"]:
+            callee = call["callee"]
+            leaf = _leaf(callee)
+            head = callee.split(".", 1)[0]
+            seat = None
+            if head == "requests" and path != _BLESSED_IO_FILES[0]:
+                seat = f"requests.{leaf}"
+            elif leaf == "urlopen":
+                seat = "urlopen"
+            elif "exec_recv" in call and path in _DB_LAYER:
+                seat = f"{call['exec_recv']}.{leaf}"
+            if seat is not None:
+                raw.add(qual)
+                raw_seat.setdefault(
+                    qual, f"{graph.site(qual, call)} {seat}(...)")
+    changed = True
+    while changed:
+        changed = False
+        for qual in list(graph.functions):
+            if qual in raw or _is_boundary(graph, qual):
+                continue
+            for target, call in graph.calls.get(qual, ()):
+                if target in raw and not _is_boundary(graph, target):
+                    raw.add(qual)
+                    raw_seat[qual] = raw_seat.get(target, "?")
+                    changed = True
+                    break
+    for qual, fn in graph.functions.items():
+        path = graph.fn_file[qual]
+        if path in _BLESSED_IO_FILES:
+            continue
+        for target, call in graph.calls.get(qual, ()):
+            if graph.fn_file.get(target) in _BLESSED_IO_FILES \
+                    and target in raw and not _is_boundary(graph, target):
+                findings.append(_finding(
+                    graph, "retry-bypass", qual, call["line"],
+                    call["col"],
+                    f"`{_cls_leaf(target)}` is a raw-I/O internal of "
+                    f"{graph.fn_file.get(target)} — calling it directly "
+                    "bypasses the retry engine's public wrappers "
+                    "(DB.* / HttpFetcher.*)",
+                    witness=[_fmt_edge(graph, qual, call, target),
+                             raw_seat.get(target, "?")]))
+    return findings
+
+
+# -- lease-fence: protocol dominance + exception flow ------------------------
+
+
+def _fence_providers(graph: ProjectGraph) -> set:
+    providers = {q for q in graph.functions if _leaf(q) in _FENCE_LEAVES}
+    changed = True
+    while changed:
+        changed = False
+        for qual in graph.functions:
+            if qual in providers:
+                continue
+            for target, _ in graph.calls.get(qual, ()):
+                if target in providers:
+                    providers.add(qual)
+                    changed = True
+                    break
+    return providers
+
+
+def _range_store_sinks(graph: ProjectGraph, fn: dict) -> list:
+    """Call sites in ``fn`` that append to a per-range store of a
+    sharded root: ``self.range_store(r).append`` (one-level receiver
+    call) or ``st.append`` where ``st`` was assigned from a
+    ``range_store`` call."""
+    sinks = []
+    for call in fn["calls"]:
+        callee = call["callee"]
+        leaf = _leaf(callee)
+        if leaf not in _SINK_LEAVES:
+            continue
+        if callee.startswith("<call:"):
+            inner = callee[6:].partition(">.")[0]
+            if _leaf(inner) == "range_store":
+                sinks.append(call)
+            continue
+        head = callee.split(".", 1)[0]
+        vt = fn["var_types"].get(head, "")
+        if _leaf(vt) == "range_store":
+            sinks.append(call)
+    return sinks
+
+
+def _locally_fenced(fn: dict, graph: ProjectGraph, providers: set,
+                    sink_call: dict) -> bool:
+    for call in fn["calls"]:
+        if call["idx"] >= sink_call["idx"]:
+            continue
+        target = call.get("resolved")
+        if target in providers or _leaf(call["callee"]) in _FENCE_LEAVES:
+            return True
+    return False
+
+
+def _callers_fenced(graph: ProjectGraph, providers: set, qual: str,
+                    seen: set, trail: list) -> list | None:
+    """None when every caller path is fenced before calling into
+    ``qual``; otherwise one unfenced witness path (list of edges)."""
+    if qual in seen:
+        return None  # cycle: treat as fenced (some acyclic path decides)
+    seen = seen | {qual}
+    rev = graph.rev_calls.get(qual, [])
+    if not rev:
+        return list(trail)  # an entry point reached with no fence
+    for caller, call in rev:
+        fenced_here = False
+        cfn = graph.functions[caller]
+        for c in cfn["calls"]:
+            if c["idx"] < call["idx"] and (
+                    c.get("resolved") in providers
+                    or _leaf(c["callee"]) in _FENCE_LEAVES):
+                fenced_here = True
+                break
+        if fenced_here:
+            continue
+        bad = _callers_fenced(graph, providers, caller, seen,
+                              [(caller, call, qual)] + trail)
+        if bad is not None:
+            return bad
+    return None
+
+
+def lease_fence_pass(graph: ProjectGraph) -> list:
+    findings: list[Finding] = []
+    providers = _fence_providers(graph)
+
+    # (a) unfenced per-range appends
+    for qual, fn in graph.functions.items():
+        for sink in _range_store_sinks(graph, fn):
+            if _locally_fenced(fn, graph, providers, sink):
+                continue
+            bad = _callers_fenced(graph, providers, qual, set(), [])
+            wit = _chain_witness(graph, bad or [])
+            wit.append(f"{graph.site(qual, sink)} {_cls_leaf(qual)} "
+                       f"appends via `{sink['callee']}` UNFENCED")
+            findings.append(_finding(
+                graph, "lease-fence", qual, sink["line"], sink["col"],
+                "per-range store append not dominated by verify_lease/"
+                "acquire_lease — a superseded writer could double-write "
+                "its re-dealt range; verify tenure first "
+                "(store._check_lease idiom)", witness=wit))
+
+    # (b) protocol-file mutations outside the blessed seats
+    for qual, fn in graph.functions.items():
+        blessed = any(qual.endswith(m) for m in _PROTOCOL_MUTATORS)
+        if blessed:
+            continue
+        for call in fn["calls"]:
+            toks = call.get("path_tokens")
+            if not toks:
+                continue
+            writes = call.get("open_write") or \
+                _leaf(call["callee"]) == "atomic_write"
+            if not writes:
+                continue
+            findings.append(_finding(
+                graph, "lease-fence", qual, call["line"], call["col"],
+                f"direct mutation of pod protocol file(s) {toks} — "
+                "membership/lease/heartbeat state must route through "
+                "MembershipLedger / write_lease / "
+                "HeartbeatWriter.beat_once so epochs stay monotonic and "
+                "writes atomic",
+                witness=[f"{graph.site(qual, call)} "
+                         f"{_cls_leaf(qual)} writes {sorted(toks)}"]))
+
+    # (c) LeaseSupersededError must escape broad handlers
+    may_raise: dict[str, list] = {}
+    for qual, fn in graph.functions.items():
+        for r in fn["raises"]:
+            if r["name"] == "LeaseSupersededError":
+                may_raise[qual] = [
+                    f"{graph.fn_file[qual]}:{r['line']} "
+                    f"{_cls_leaf(qual)} raises LeaseSupersededError"]
+    flagged: set = set()
+    changed = True
+    while changed:
+        changed = False
+        for qual, fn in graph.functions.items():
+            for target, call in graph.calls.get(qual, ()):
+                if target not in may_raise:
+                    continue
+                handlers = [fn["broad_handlers"][h]
+                            for h in call.get("handlers", ())]
+                if any(h.get("explicit_lse") for h in handlers):
+                    continue  # deliberately handled in place
+                absorbing = [h for h in handlers
+                             if not h.get("lse_escapes")]
+                if absorbing:
+                    h = absorbing[0]
+                    key = (qual, h["line"])
+                    if key not in flagged:
+                        flagged.add(key)
+                        wit = [_fmt_edge(graph, qual, call, target)] + \
+                            may_raise[target]
+                        findings.append(_finding(
+                            graph, "lease-fence", qual, h["line"], 0,
+                            "broad except can absorb LeaseSupersededError"
+                            " raised inside its try body — the zombie "
+                            "fence signal must propagate (bare `raise` / "
+                            "`raise e`; `raise X from e` converts it "
+                            "away), or narrow the handler",
+                            witness=wit))
+                    continue
+                if qual not in may_raise:
+                    may_raise[qual] = [
+                        _fmt_edge(graph, qual, call, target)
+                    ] + may_raise[target][:4]
+                    changed = True
+    return findings
+
+
+# -- lock-order --------------------------------------------------------------
+
+
+def lock_order_pass(graph: ProjectGraph) -> list:
+    findings: list[Finding] = []
+    # transitive lock-acquisition summaries
+    acquires: dict[str, set] = {}
+    for qual, fn in graph.functions.items():
+        acquires[qual] = {s["token"] for s in fn["lock_sites"]}
+    changed = True
+    while changed:
+        changed = False
+        for qual in graph.functions:
+            for target, _ in graph.calls.get(qual, ()):
+                extra = acquires.get(target, set()) - acquires[qual]
+                if extra:
+                    acquires[qual] |= extra
+                    changed = True
+    # edges held -> acquired (with a witness site per edge)
+    edges: dict[tuple, str] = {}
+    for qual, fn in graph.functions.items():
+        for site in fn["lock_sites"]:
+            for held in site["held"]:
+                edges.setdefault(
+                    (held, site["token"]),
+                    f"{graph.fn_file[qual]}:{site['line']} "
+                    f"{_cls_leaf(qual)} takes {site['token']} while "
+                    f"holding {held}")
+        for target, call in graph.calls.get(qual, ()):
+            if not call["locks"]:
+                continue
+            for acq in acquires.get(target, ()):
+                for held in call["locks"]:
+                    edges.setdefault(
+                        (held, acq),
+                        f"{graph.site(qual, call)} {_cls_leaf(qual)} "
+                        f"holds {held} and calls {_cls_leaf(target)} "
+                        f"which acquires {acq}")
+    # self-deadlock: re-acquiring a non-reentrant Lock under itself
+    kinds: dict[str, str] = {}
+    for cls_qual, crec in graph.classes.items():
+        for attr in crec.get("locks", []):
+            kinds[f"{cls_qual}.{attr}"] = \
+                crec.get("lock_kinds", {}).get(attr, "Lock")
+    for (a, b), site in sorted(edges.items()):
+        if a == b and kinds.get(a, "Lock") != "RLock":
+            findings.append(_lock_finding(
+                graph, site, f"non-reentrant lock {a} re-acquired while "
+                f"already held — guaranteed deadlock", [site]))
+    # cycle detection among distinct locks
+    adj: dict[str, list] = {}
+    for (a, b), site in edges.items():
+        if a != b:
+            adj.setdefault(a, []).append((b, site))
+    seen_cycles: set = set()
+    for start in sorted(adj):
+        stack = [(start, [start], [])]
+        while stack:
+            node, path, sites = stack.pop()
+            for nxt, site in adj.get(node, ()):
+                if nxt == start:
+                    cyc = tuple(sorted(path))
+                    if cyc in seen_cycles:
+                        continue
+                    seen_cycles.add(cyc)
+                    findings.append(_lock_finding(
+                        graph, sites[0] if sites else site,
+                        "lock-order cycle: " + " -> ".join(
+                            path + [start]) + " — two threads taking "
+                        "these locks in opposite orders deadlock; pick "
+                        "one global order", sites + [site]))
+                elif nxt not in path and len(path) < 6:
+                    stack.append((nxt, path + [nxt], sites + [site]))
+    return findings
+
+
+def _lock_finding(graph: ProjectGraph, anchor_site: str, message: str,
+                  witness: list) -> Finding:
+    path, _, line = anchor_site.split(" ", 1)[0].rpartition(":")
+    f = Finding(rule="lock-order", path=path, line=int(line or 1), col=0,
+                message=message)
+    f.witness = witness
+    return f
+
+
+# -- fault-seat-drift --------------------------------------------------------
+
+
+def _matrix_inventory(matrix_abspath: str):
+    """(seats dict name -> {kinds, line}, parse error or None)."""
+    try:
+        with open(matrix_abspath, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=matrix_abspath)
+    except (OSError, SyntaxError) as e:
+        return None, str(e)
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "PRODUCTION_SEATS"
+                and isinstance(node.value, ast.Dict)):
+            continue
+        seats = {}
+        for k, v in zip(node.value.keys, node.value.values):
+            if not (isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)):
+                continue
+            kinds: list = []
+            if isinstance(v, ast.Dict):
+                for vk, vv in zip(v.keys, v.values):
+                    if (isinstance(vk, ast.Constant)
+                            and vk.value == "kinds"
+                            and isinstance(vv, (ast.Tuple, ast.List))):
+                        kinds = [e.value for e in vv.elts
+                                 if isinstance(e, ast.Constant)]
+            seats[k.value] = {"kinds": kinds, "line": k.lineno}
+        return seats, None
+    return None, "no PRODUCTION_SEATS dict"
+
+
+def _declared_kinds(graph: ProjectGraph) -> set:
+    """The fault kinds ``resilience/faults.py`` (or a fixture ``faults``
+    module) declares in its ``_KINDS`` tuple."""
+    for path, facts in graph.facts.items():
+        if facts["module"].split(".")[-1] != "faults":
+            continue
+        kinds = facts["constants"].get("_KINDS")
+        if isinstance(kinds, list):
+            return set(kinds)
+    return set()
+
+
+def _production_sites(graph: ProjectGraph):
+    """site -> (qual, call) for every fault_point seat; plus findings
+    for seats whose name cannot be resolved to literals."""
+    sites: dict[str, tuple] = {}
+    findings: list[Finding] = []
+    for qual, fn in graph.functions.items():
+        for call in fn["calls"]:
+            if "fault_site" in call:
+                sites.setdefault(call["fault_site"], (qual, call))
+            elif "fault_site_param" in call:
+                param = call["fault_site_param"]
+                values = set()
+                # The seat name may be a parameter of an ENCLOSING
+                # function (the retry-closure idiom: fault_point(site)
+                # inside attempt() inside _statement(site=...)).
+                owner, ofn = qual, fn
+                while ofn is not None and param not in ofn["params"]:
+                    owner = ofn.get("parent")
+                    ofn = graph.functions.get(owner) if owner else None
+                if ofn is not None:
+                    default = ofn["param_defaults"].get(param)
+                    if isinstance(default, str):
+                        values.add(default)
+                    for caller, ccall in graph.rev_calls.get(owner, ()):
+                        fact = _arg_for_param(ofn, ccall, param)
+                        if fact is None:
+                            continue  # caller uses the default
+                        if fact.get("kind") == "const" \
+                                and isinstance(fact.get("value"), str):
+                            values.add(fact["value"])
+                        else:
+                            values.add("<dynamic>")
+                if not values or "<dynamic>" in values:
+                    findings.append(_finding(
+                        graph, "fault-seat-drift", qual, call["line"],
+                        call["col"],
+                        f"fault_point seat `{param}` does not resolve "
+                        "to string literals — seats must be statically "
+                        "enumerable for the fault matrix"))
+                for v in values - {"<dynamic>"}:
+                    sites.setdefault(v, (qual, call))
+    return sites, findings
+
+
+def fault_seat_drift_pass(graph: ProjectGraph,
+                          matrix_path: str | None = None) -> list:
+    matrix_rel = matrix_path or MATRIX_DEFAULT
+    matrix_abs = matrix_rel if os.path.isabs(matrix_rel) \
+        else os.path.join(graph.root, matrix_rel)
+    matrix_disp = os.path.relpath(matrix_abs, graph.root).replace(
+        os.sep, "/")
+    sites, findings = _production_sites(graph)
+    if not sites:
+        return findings  # nothing to check (fixture set without seats)
+    seats, err = _matrix_inventory(matrix_abs)
+    if seats is None:
+        f = Finding(rule="fault-seat-drift", path=matrix_disp, line=1,
+                    col=0,
+                    message="PRODUCTION_SEATS inventory missing from "
+                            f"{matrix_disp} ({err}) — the fault matrix "
+                            "has no machine-checked seat list")
+        f.witness = []
+        return findings + [f]
+    kinds = _declared_kinds(graph)
+    for site, (qual, call) in sorted(sites.items()):
+        if site not in seats:
+            findings.append(_finding(
+                graph, "fault-seat-drift", qual, call["line"],
+                call["col"],
+                f"fault_point seat `{site}` has no PRODUCTION_SEATS "
+                f"entry in {matrix_disp} — add the seat with its fault "
+                "kinds and covering test so the matrix stays the source "
+                "of truth",
+                witness=[f"{graph.site(qual, call)} fault_point"
+                         f"(\"{site}\")"]))
+    for seat, rec in sorted(seats.items()):
+        if seat not in sites:
+            f = Finding(rule="fault-seat-drift", path=matrix_disp,
+                        line=rec["line"], col=0,
+                        message=f"dead matrix seat `{seat}`: no "
+                                "fault_point in production code declares "
+                                "it — remove the entry or restore the "
+                                "seat")
+            f.witness = []
+            findings.append(f)
+        bad_kinds = [k for k in rec["kinds"] if kinds and k not in kinds]
+        if bad_kinds:
+            f = Finding(rule="fault-seat-drift", path=matrix_disp,
+                        line=rec["line"], col=0,
+                        message=f"matrix seat `{seat}` lists unknown "
+                                f"fault kind(s) {bad_kinds} — not in "
+                                "resilience/faults.py _KINDS")
+            f.witness = []
+            findings.append(f)
+    return findings
+
+
+# -- registry ----------------------------------------------------------------
+
+# pass name -> (rules it emits, callable(graph, matrix_path) -> findings)
+PROJECT_PASSES = {
+    "taint": (("sql-interp", "retry-bypass"),
+              lambda graph, matrix_path=None: taint_pass(graph)),
+    "lease-fence": (("lease-fence",),
+                    lambda graph, matrix_path=None:
+                    lease_fence_pass(graph)),
+    "lock-order": (("lock-order",),
+                   lambda graph, matrix_path=None:
+                   lock_order_pass(graph)),
+    "fault-seat-drift": (("fault-seat-drift",),
+                         fault_seat_drift_pass),
+}
+
+PROJECT_RULES = ("sql-interp", "retry-bypass", "lease-fence",
+                 "lock-order", "fault-seat-drift")
+
+
+def run_project_passes(graph: ProjectGraph,
+                       wanted_rules: set | None = None,
+                       matrix_path: str | None = None) -> list:
+    """Run every project pass whose emitted rules intersect
+    ``wanted_rules`` (all of them when None)."""
+    findings: list[Finding] = []
+    for _name, (emits, fn) in PROJECT_PASSES.items():
+        if wanted_rules is not None and not (set(emits) & wanted_rules):
+            continue
+        out = fn(graph, matrix_path=matrix_path)
+        if wanted_rules is not None:
+            out = [f for f in out if f.rule in wanted_rules]
+        findings.extend(out)
+    return findings
+
+
+__all__ = ["MATRIX_DEFAULT", "PROJECT_PASSES", "PROJECT_RULES",
+           "fault_seat_drift_pass", "lease_fence_pass", "lock_order_pass",
+           "run_project_passes", "taint_pass"]

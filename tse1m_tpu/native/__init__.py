@@ -79,6 +79,9 @@ def _build_and_load(name: str, src: str, so: str, stds: tuple,
         log.info("native %s loaded (%s)", name, so)
         return mod
     except Exception as e:  # no g++, sandboxed exec, import failure, ...
+        from ..resilience import reraise_if_fault
+
+        reraise_if_fault(e)  # the pandas fallback is the designed path
         log.info("native %s unavailable (%s); %s", name, e, fallback_note)
         return None
 

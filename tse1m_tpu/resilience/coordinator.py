@@ -410,6 +410,17 @@ class PodSupervisor:
             self.monitor.check(site=site)
         if "error" in box:
             err = box["error"]
+            # Fence signals relay VERBATIM, before any peer-death
+            # reclassification: a worker whose lease was superseded is
+            # the zombie, and when the survivors finished and exited
+            # their heartbeats stop too — wrapping the
+            # LeaseSupersededError into HostLostError here would send
+            # the fenced writer down the failover path to re-execute
+            # (the exact double-write the epoch leases exist to
+            # prevent).  HostLostError likewise carries its own loss
+            # evidence already.
+            if isinstance(err, (LeaseSupersededError, HostLostError)):
+                raise err
             # Confirm (or clear) peer death before relaying: give the
             # monitor one full timeout window to observe stalled beats.
             deadline = deadline_clock() + self.monitor.timeout_s

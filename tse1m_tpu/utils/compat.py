@@ -71,6 +71,9 @@ def enable_persistent_compilation_cache(path: str) -> bool:
                 pass  # older jax: dir knob alone still caches big kernels
         return True
     except Exception as e:
+        from ..resilience import reraise_if_fault
+
+        reraise_if_fault(e)  # cache stays off on any real failure
         get_logger("compat").warning(
             "persistent compilation cache unavailable (%s: %s)",
             type(e).__name__, e)
