@@ -41,7 +41,9 @@ import tempfile
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH_KEYS = ("degradation_events", "degradation_counts", "chunk_halvings",
               "store_scrub_shards", "store_scrub_corrupt",
-              "store_scrub_quarantined", "store_scrub_state_ok")
+              "store_scrub_quarantined", "store_scrub_state_ok",
+              "wire_v3_saved_mb", "prefilter_hit_rate",
+              "prefilter_recall", "stage_entropy_s")
 
 # The machine-checked seat inventory (graftlint ``fault-seat-drift``):
 # every ``fault_point(...)`` seat in production code must have an entry
@@ -153,13 +155,24 @@ def seat_stall(store: str) -> dict:
 
 
 def seat_oom(store: str) -> dict:
+    # Three RESOURCE_EXHAUSTED hits walk the whole ladder (quant 10 ->
+    # quant 8 -> chunk halving) with BOTH wire-v3 levers forced: the
+    # prefilter's raw-space keep mask must survive the width drops, and
+    # the rANS codec must re-encode every re-packed chunk at the
+    # surviving width — the bench's internal parity asserts (ARI gate +
+    # warm-vs-cold elementwise) prove labels held through all of it.
     plan = {"rules": [plan_rule("pipeline.h2d", kind="raise",
                                 message="RESOURCE_EXHAUSTED: injected "
                                         "1GiB allocation failure",
-                                times=1)]}
-    r = run_bench(store, plan)
+                                times=3)]}
+    r = run_bench(store, plan, env_extra={"BENCH_PREFILTER": "on",
+                                          "BENCH_ENTROPY": "force"})
     assert r["chunk_halvings"] >= 1, r
     assert r["degradation_counts"].get("chunk_halving", 0) >= 1, r
+    assert r["degradation_counts"].get("quant_drop", 0) >= 1, r
+    assert r["prefilter_rows_dropped"] > 0, r
+    assert r["prefilter_recall"] == 1.0, r
+    assert r["stage_entropy_s"] > 0, r
     return r
 
 

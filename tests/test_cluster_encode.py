@@ -137,9 +137,13 @@ def test_auto_policy_skips_small_inputs(dup_items):
 
 
 def test_auto_policy_engages_on_large_compressible(dup_items, monkeypatch):
+    # prefilter pinned off: this test isolates the ENCODING auto policy
+    # (with the size gate lowered, wire-v3's prefilter would also engage
+    # and shrink the lane split below N — covered by test_prefilter.py).
     monkeypatch.setattr(pipeline_mod, "_AUTO_MIN_BYTES", 1024)
     cluster_sessions(dup_items,
-                     ClusterParams(use_pallas="never", encoding="auto"))
+                     ClusterParams(use_pallas="never", encoding="auto",
+                                   prefilter="off"))
     info = pipeline_mod.last_run_info
     assert info["encoding"] == "delta"
     assert info["n_full"] + info["n_delta"] == N
@@ -343,15 +347,15 @@ def test_pack_delta_meta_roundtrip(dup_items):
     back to the DeltaEncoding exactly — and they are strictly smaller
     than the fixed-width lanes they replaced."""
     enc = encode_delta(dup_items, use_native=False)
-    meta = pack_delta_meta(enc)
+    meta = pack_delta_meta(enc)  # entropy='off': the pure bit-pack form
     np.testing.assert_array_equal(
-        unpack_bits_host(meta.rep, enc.n_delta, meta.rep_bits),
+        unpack_bits_host(meta.rep.packed, enc.n_delta, meta.rep.bits),
         enc.rep_in_full.astype(np.uint32))
     np.testing.assert_array_equal(
-        unpack_bits_host(meta.counts, enc.n_delta, meta.counts_bits),
+        unpack_bits_host(meta.counts.packed, enc.n_delta, meta.counts.bits),
         enc.counts.astype(np.uint32))
     np.testing.assert_array_equal(
-        unpack_bits_host(meta.pos, len(enc.pos_flat), meta.pos_bits),
+        unpack_bits_host(meta.pos.packed, len(enc.pos_flat), meta.pos.bits),
         enc.pos_flat.astype(np.uint32))
     np.testing.assert_array_equal(unpack_chunk_host(meta.val), enc.val_flat)
     fixed = (enc.rep_in_full.nbytes + enc.counts.nbytes + enc.pos_flat.nbytes

@@ -68,6 +68,16 @@ def test_wire_layer_path_scoped():
                               "tse1m_tpu/cluster/pipeline.py")
 
 
+def test_wire_layer_admits_wire_v3_seats():
+    # Wire v3 (entropy codec + host prefilter) extends the blessed plane
+    # by exactly these two modules — and nothing else grew a pass.
+    for seat in ("tse1m_tpu/cluster/entropy.py",
+                 "tse1m_tpu/cluster/prefilter.py"):
+        assert not _rule_findings("wire-layer", "bad_wire_layer.py", seat)
+    assert _rule_findings("wire-layer", "bad_wire_layer.py",
+                          "tse1m_tpu/cluster/kernels/rans.py")
+
+
 def test_nondeterminism_scoped_to_replay_planes():
     # outside resilience/collect/db/cluster the rule stays silent
     assert not _rule_findings("nondeterminism", "bad_nondeterminism.py",

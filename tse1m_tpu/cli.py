@@ -564,7 +564,9 @@ def _run_cluster_step(args, sig_store: str | None,
     from .parallel import multihost
 
     items, truth = synth_session_sets(args.n, seed=args.seed)
-    params = ClusterParams(seed=args.seed, sig_store=sig_store)
+    params = ClusterParams(seed=args.seed, sig_store=sig_store,
+                           prefilter=getattr(args, "prefilter", "auto"),
+                           entropy=getattr(args, "entropy", "auto"))
     pod_report: dict = {}
     if pod_route:
         # Pod path: per-host digest-range sharded store + supervision,
@@ -624,6 +626,12 @@ def _run_cluster_step(args, sig_store: str | None,
 
     report["chunk_halvings"] = int(_lri.get("chunk_halvings", 0))
     report["degradation_events"] = len(peek_degradation_events())
+    # Wire-v3 telemetry (storeless single-host runs): what the prefilter
+    # and the entropy codec saved this run.
+    for key in ("wire_version", "prefilter_hit_rate",
+                "prefilter_rows_dropped", "wire_v3_saved_mb"):
+        if key in _lri:
+            report[key] = _lri[key]
     if k > 0:
         from dataclasses import replace
 
@@ -820,6 +828,19 @@ def main(argv=None) -> int:
                         "rows; accreted re-runs merge labels on host. "
                         "Also settable via TSE1M_SIG_STORE / the INI's "
                         "sig_store; recorded in run_manifest.json")
+    p.add_argument("--prefilter", default="auto",
+                   choices=("off", "auto", "on"),
+                   help="wire v3 host-side LSH prefilter "
+                        "(cluster/prefilter.py): rows bucketed singleton "
+                        "in every host band skip the device and the wire "
+                        "entirely; labels stay elementwise-equal to the "
+                        "unfiltered run (storeless single-host only)")
+    p.add_argument("--entropy", default="auto",
+                   choices=("off", "auto", "force"),
+                   help="wire v3 rANS lane coding (cluster/entropy.py): "
+                        "'auto' entropy-codes wire lanes that beat their "
+                        "bit-packed form, per chunk/lane; 'force' codes "
+                        "everything (testing)")
     p.set_defaults(fn=_cmd_cluster)
 
     args = ap.parse_args(argv)

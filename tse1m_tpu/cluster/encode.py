@@ -208,7 +208,8 @@ def decode_host(enc: DeltaEncoding) -> np.ndarray:
 # bit-packs the delta lanes' positions (6 bits for 64-element sets),
 # counts, and base references, which the fixed scheme shipped at full
 # uint8/int32 width.  Devices decode with pipeline._unpack_bits /
-# minhash_pallas' fused byte unpack, so decoded bytes never cross the
+# minhash_pallas' fused byte unpack (or, for wire-v3 entropy-coded
+# lanes, cluster/kernels/rans.py), so decoded bytes never cross the
 # link; `unpack_bits_host` below is the decoders' numpy oracle.
 
 # Lossy id quantization (b-bit minwise hashing, arXiv:1205.2958: MinHash
@@ -297,17 +298,41 @@ class ChunkWire:
     """One chunk's wire form: a packed uint8 payload + the header the
     device needs to decode it (bits, offset bias, logical shape).  The
     header never rides the link per-value — it travels as static decode
-    arguments / one batched metadata transfer."""
+    arguments / one batched metadata transfer.
 
-    payload: np.ndarray      # uint8 bit/byte stream
+    Wire v3: when the chunk's values are skewed enough that a static
+    entropy table beats the fixed width (cluster/entropy.py's measured
+    win threshold), ``ent`` holds the rANS frame and ``payload`` is
+    empty — the chunk ships the frame's arrays instead.  ``bits`` and
+    ``offset`` keep their meaning (the coded symbols are the
+    offset-subtracted values), so decode is entropy-decode + offset."""
+
+    payload: np.ndarray      # uint8 bit/byte stream (empty when ent)
     n_values: int            # logical value count (rows * set_size)
     bits: int                # wire width per value
     offset: int              # subtracted min; device adds it back
     shape: tuple             # logical decoded shape
+    ent: "object | None" = None  # entropy.EntropyLane when rANS-coded
 
     @property
     def nbytes(self) -> int:
+        if self.ent is not None:
+            return int(self.ent.nbytes)
         return int(self.payload.nbytes)
+
+    def wire_arrays(self) -> list:
+        """The exact host arrays this chunk puts on the wire (the
+        transfer-probe / drift-guard inventory)."""
+        if self.ent is not None:
+            return self.ent.wire_arrays()
+        return [self.payload]
+
+    def device_payload(self):
+        """What the pipeline device_puts for this chunk: the packed
+        stream, or the entropy frame's array tuple."""
+        if self.ent is not None:
+            return tuple(self.ent.wire_arrays())
+        return self.payload
 
 
 def chunk_wire_bits(chunk: np.ndarray, pack_limit: int = 1 << 24,
@@ -333,13 +358,56 @@ def chunk_wire_bits(chunk: np.ndarray, pack_limit: int = 1 << 24,
     return bits, offset
 
 
-def pack_chunk(chunk: np.ndarray, pack_limit: int = 1 << 24) -> ChunkWire:
-    """Adaptive-width wire form of a uint32 chunk (any shape)."""
+def pack_chunk(chunk: np.ndarray, pack_limit: int = 1 << 24,
+               entropy: str = "off",
+               stats: dict | None = None) -> ChunkWire:
+    """Adaptive-width wire form of a uint32 chunk (any shape).
+
+    ``entropy``: 'off' ships the bit-packed stream (the v2 format);
+    'auto' additionally offers the chunk to the rANS codec and ships
+    whichever is smaller (the per-chunk win threshold — quantized/uniform
+    chunks always fall back to the plain pack); 'force' entropy-codes
+    regardless (tests/CI).  ``stats`` (mutable dict) accrues the codec's
+    encode seconds / bytes saved for StageRecorder."""
     bits, offset = chunk_wire_bits(chunk, pack_limit)
     vals = chunk if offset == 0 else chunk - np.uint32(offset)
+    ent = _try_entropy(vals, bits, entropy, stats)
+    if ent is not None:
+        return ChunkWire(payload=np.zeros(0, np.uint8),
+                         n_values=int(chunk.size), bits=bits,
+                         offset=offset, shape=tuple(chunk.shape), ent=ent)
     return ChunkWire(payload=pack_bits_host(vals, bits),
                      n_values=int(chunk.size), bits=bits, offset=offset,
                      shape=tuple(chunk.shape))
+
+
+def _try_entropy(vals: np.ndarray, bits: int, entropy: str,
+                 stats: dict | None):
+    """The per-lane codec gate: an EntropyLane when it wins (or is
+    forced), else None; accounting lands in ``stats``."""
+    if entropy not in ("off", "auto", "force"):
+        raise ValueError(f"unknown entropy mode {entropy!r}; "
+                         "expected off | auto | force")
+    if entropy == "off":
+        return None
+    import time
+
+    from . import entropy as ent_mod
+
+    t0 = time.perf_counter()
+    lane = ent_mod.encode_lane(vals, bits, force=(entropy == "force"))
+    if stats is not None:
+        stats["entropy_s"] = (stats.get("entropy_s", 0.0)
+                              + time.perf_counter() - t0)
+        if lane is not None:
+            stats["entropy_lanes"] = stats.get("entropy_lanes", 0) + 1
+            stats["entropy_coded_bytes"] = (
+                stats.get("entropy_coded_bytes", 0) + lane.nbytes)
+            stats["entropy_saved_bytes"] = (
+                stats.get("entropy_saved_bytes", 0)
+                + ent_mod.packed_nbytes(int(vals.size), bits)
+                - lane.nbytes)
+    return lane
 
 
 def unpack_chunk_host(wire: ChunkWire) -> np.ndarray:
@@ -351,22 +419,59 @@ def unpack_chunk_host(wire: ChunkWire) -> np.ndarray:
 
 
 @dataclass(frozen=True)
+class LaneWire:
+    """One metadata lane's wire form: a minimal-width bit stream, or —
+    wire v3 — a rANS frame when the lane's skew beats the fixed width
+    (cluster/entropy.py's measured win threshold)."""
+
+    n: int                   # value count
+    bits: int                # logical value width
+    packed: np.ndarray | None = None   # uint8 bit stream
+    ent: "object | None" = None        # entropy.EntropyLane
+
+    @property
+    def nbytes(self) -> int:
+        if self.ent is not None:
+            return int(self.ent.nbytes)
+        return int(self.packed.nbytes)
+
+    def wire_arrays(self) -> list:
+        if self.ent is not None:
+            return self.ent.wire_arrays()
+        return [self.packed]
+
+    def device_payload(self):
+        if self.ent is not None:
+            return tuple(self.ent.wire_arrays())
+        return self.packed
+
+
+def pack_lane(vals: np.ndarray, bits: int, entropy: str = "off",
+              stats: dict | None = None) -> LaneWire:
+    """Wire form of one metadata lane under the v3 per-lane choice."""
+    ent = _try_entropy(vals, bits, entropy, stats)
+    if ent is not None:
+        return LaneWire(n=int(vals.size), bits=bits, ent=ent)
+    return LaneWire(n=int(vals.size), bits=bits,
+                    packed=pack_bits_host(vals, bits))
+
+
+@dataclass(frozen=True)
 class DeltaMetaWire:
-    """Bit-packed wire form of a DeltaEncoding's metadata lanes.
+    """Wire form of a DeltaEncoding's metadata lanes.
 
     The fixed layout shipped rep at int32, counts at uint8 and positions
     at uint8 regardless of content; here each lane packs at its minimal
     width — 6-bit positions for 64-element sets, ~5-bit counts, ~19-bit
-    base references at 1M rows — and the value lane reuses the adaptive
-    chunk packer.  The whole object ships as ONE pytree device_put
-    (pipeline._put_delta_meta)."""
+    base references at 1M rows — and, under wire v3, any lane whose skew
+    beats its fixed width ships a static-table rANS frame instead
+    (per-lane choice, plain pack fallback).  The value lane reuses the
+    adaptive chunk packer.  The whole object ships as ONE pytree
+    device_put (pipeline._put_delta_meta)."""
 
-    rep: np.ndarray          # uint8 bit stream
-    rep_bits: int
-    counts: np.ndarray       # uint8 bit stream
-    counts_bits: int
-    pos: np.ndarray          # uint8 bit stream
-    pos_bits: int
+    rep: LaneWire
+    counts: LaneWire
+    pos: LaneWire
     val: ChunkWire
 
     @property
@@ -374,16 +479,26 @@ class DeltaMetaWire:
         return int(self.rep.nbytes + self.counts.nbytes + self.pos.nbytes
                    + self.val.nbytes)
 
+    def lanes(self) -> tuple:
+        return (self.rep, self.counts, self.pos)
 
-def pack_delta_meta(enc: DeltaEncoding,
-                    pack_limit: int = 1 << 24) -> DeltaMetaWire:
+    def wire_arrays(self) -> list:
+        out: list = []
+        for lane in self.lanes():
+            out += lane.wire_arrays()
+        out += self.val.wire_arrays()
+        return out
+
+
+def pack_delta_meta(enc: DeltaEncoding, pack_limit: int = 1 << 24,
+                    entropy: str = "off",
+                    stats: dict | None = None) -> DeltaMetaWire:
     """Pack a DeltaEncoding's rep/counts/pos/val lanes for the wire."""
     rep_bits = width_bits(max(enc.n_full - 1, 1))
     counts_bits = width_bits(int(enc.counts.max()) if enc.n_delta else 1)
     pos_bits = width_bits(max(enc.set_size - 1, 1))
     return DeltaMetaWire(
-        rep=pack_bits_host(enc.rep_in_full, rep_bits), rep_bits=rep_bits,
-        counts=pack_bits_host(enc.counts, counts_bits),
-        counts_bits=counts_bits,
-        pos=pack_bits_host(enc.pos_flat, pos_bits), pos_bits=pos_bits,
-        val=pack_chunk(enc.val_flat, pack_limit))
+        rep=pack_lane(enc.rep_in_full, rep_bits, entropy, stats),
+        counts=pack_lane(enc.counts, counts_bits, entropy, stats),
+        pos=pack_lane(enc.pos_flat, pos_bits, entropy, stats),
+        val=pack_chunk(enc.val_flat, pack_limit, entropy, stats))

@@ -1,0 +1,22 @@
+"""Device kernels for the cluster pipeline's wire plane.
+
+The fused MinHash/band-key kernels live in ``cluster/minhash_pallas.py``
+(re-exported here so callers can treat this package as the kernel
+namespace); ``rans.py`` adds the wire-v3 entropy decoders — a jnp
+``fori_loop`` reference and a pallas variant — fused into the pipeline's
+packed-unpack path.  Kernels never open their own transfers: every
+device_put stays in the blessed wire layer (cluster/encode.py,
+cluster/entropy.py, cluster/prefilter.py, cluster/pipeline.py — the
+graftlint ``wire-layer`` rule).
+"""
+
+from ..minhash_pallas import (minhash_and_keys, minhash_and_keys_packed,
+                              minhash_and_keys_pallas)
+from .rans import decode_lane_device
+
+__all__ = [
+    "minhash_and_keys",
+    "minhash_and_keys_packed",
+    "minhash_and_keys_pallas",
+    "decode_lane_device",
+]

@@ -1,0 +1,186 @@
+"""On-device interleaved-rANS decode (wire v3's entropy-coded lanes).
+
+The host codec (cluster/entropy.py) deals symbols round-robin across
+``N_STREAMS`` independent rANS states and interleaves the
+renormalization words in exact decode order, so the device decoder is a
+data-parallel loop: every step advances all streams with one table
+gather, and the variable word consumption collapses to a cumsum over the
+stream axis (each stream consumes 0 or 1 sixteen-bit word per step — the
+12-bit-frequency / 16-bit-renorm invariant).
+
+Two implementations, dispatched like minhash_pallas: a jnp ``fori_loop``
+(the reference — runs everywhere, is the CPU path) and a pallas kernel
+that keeps the state vector, tables, and word stream VMEM-resident for
+the whole lane.  The pallas variant uses dynamic row stores that not
+every Mosaic generation lowers; the one-shot breaker falls back to the
+bit-identical jnp decoder, mirroring minhash_pallas._FUSED_UNPACK_OK.
+
+Decode tables (slot->symbol, frequency, cumulative) are BUILT ON DEVICE
+from the shipped frequency array inside the jit — the wire carries only
+the 2-byte-per-entry freqs, not the 2^12-entry slot table.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..entropy import _M, N_STREAMS, PROB_BITS, RANS_L, EntropyLane, \
+    _DIRECT_BITS_MAX
+
+
+def _decode_tables(freqs):
+    """freqs [A] -> (slot_sym [2^12] int32, cum_excl [A] uint32)."""
+    cumi = jnp.cumsum(freqs.astype(jnp.uint32))
+    cume = jnp.concatenate([jnp.zeros(1, jnp.uint32), cumi[:-1]])
+    slot_sym = jnp.searchsorted(cumi, jnp.arange(_M, dtype=jnp.uint32),
+                                side="right").astype(jnp.int32)
+    return slot_sym, cume
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def _rans_decode_jnp(words, x0, freqs, n: int):
+    """[W] uint16 words + [K] uint32 states + [A] uint16 freqs -> [n]
+    uint32 symbols.  Oracle: entropy.rans_decode_host."""
+    k = N_STREAMS
+    steps = -(-n // k)
+    slot_sym, cume = _decode_tables(freqs)
+    fr = freqs.astype(jnp.uint32)
+    ks = jnp.arange(k, dtype=jnp.int32)
+    # One pad word so the clamped gather of an exhausted pointer stays
+    # in-bounds (those lanes' reads are masked out by `need`).
+    wpad = jnp.concatenate([words.astype(jnp.uint32),
+                            jnp.zeros(1, jnp.uint32)])
+    wlim = wpad.shape[0] - 1
+
+    def body(t, carry):
+        x, ptr, out = carry
+        act = (t * k + ks) < n
+        slot = (x & jnp.uint32(_M - 1)).astype(jnp.int32)
+        s = slot_sym[slot]
+        xn = fr[s] * (x >> jnp.uint32(PROB_BITS)) \
+            + slot.astype(jnp.uint32) - cume[s]
+        x = jnp.where(act, xn, x)
+        need = act & (x < jnp.uint32(RANS_L))
+        off = jnp.cumsum(need.astype(jnp.int32)) - need.astype(jnp.int32)
+        w = wpad[jnp.clip(ptr + off, 0, wlim)]
+        x = jnp.where(need, (x << jnp.uint32(16)) | w, x)
+        ptr = ptr + jnp.sum(need.astype(jnp.int32))
+        out = out.at[t].set(s.astype(jnp.uint32))
+        return x, ptr, out
+
+    out = jnp.zeros((steps, k), jnp.uint32)
+    _, _, out = jax.lax.fori_loop(
+        0, steps, body, (x0.astype(jnp.uint32), jnp.int32(0), out))
+    return out.reshape(-1)[:n]
+
+
+def _rans_kernel(words_ref, x0_ref, slot_ref, fr_ref, cume_ref, out_ref, *,
+                 n: int):
+    """Pallas body: the same loop with every operand VMEM-resident."""
+    k = N_STREAMS
+    steps = -(-n // k)
+    wpad = words_ref[...].astype(jnp.uint32)
+    wlim = wpad.shape[0] - 1
+    slot_sym = slot_ref[...]
+    fr = fr_ref[...]
+    cume = cume_ref[...]
+    ks = jax.lax.broadcasted_iota(jnp.int32, (k,), 0)
+
+    def body(t, carry):
+        x, ptr = carry
+        act = (t * k + ks) < n
+        slot = (x & jnp.uint32(_M - 1)).astype(jnp.int32)
+        s = slot_sym[slot]
+        xn = fr[s] * (x >> jnp.uint32(PROB_BITS)) \
+            + slot.astype(jnp.uint32) - cume[s]
+        x = jnp.where(act, xn, x)
+        need = act & (x < jnp.uint32(RANS_L))
+        off = jnp.cumsum(need.astype(jnp.int32)) - need.astype(jnp.int32)
+        w = wpad[jnp.clip(ptr + off, 0, wlim)]
+        x = jnp.where(need, (x << jnp.uint32(16)) | w, x)
+        ptr = ptr + jnp.sum(need.astype(jnp.int32))
+        out_ref[t, :] = s.astype(jnp.uint32)
+        return x, ptr
+
+    jax.lax.fori_loop(0, steps, body,
+                      (x0_ref[...].astype(jnp.uint32), jnp.int32(0)))
+
+
+@functools.partial(jax.jit, static_argnames=("n", "interpret"))
+def _rans_decode_pallas(words, x0, freqs, n: int, interpret: bool):
+    from jax.experimental import pallas as pl
+
+    k = N_STREAMS
+    steps = -(-n // k)
+    slot_sym, cume = _decode_tables(freqs)
+    fr = freqs.astype(jnp.uint32)
+    wpad = jnp.concatenate([words.astype(jnp.uint16),
+                            jnp.zeros(1, jnp.uint16)])
+    out = pl.pallas_call(
+        functools.partial(_rans_kernel, n=n),
+        out_shape=jax.ShapeDtypeStruct((steps, k), jnp.uint32),
+        interpret=interpret,
+    )(wpad, x0.astype(jnp.uint32), slot_sym, fr, cume)
+    return out.reshape(-1)[:n]
+
+
+# One-shot breaker (minhash_pallas._FUSED_UNPACK_OK idiom): a Mosaic
+# generation that rejects the dynamic-store loop falls back to the
+# bit-identical jnp decoder for the rest of the process.
+_RANS_PALLAS_OK = True
+
+
+def _decode_plane(words_d, x0_d, freqs_d, n: int, use_pallas: str):
+    global _RANS_PALLAS_OK
+    if n == 0:
+        # fori_loop traces its body even for a zero trip count, and the
+        # body scatters into a zero-row output — short-circuit instead.
+        return jnp.zeros(0, jnp.uint32)
+    if use_pallas == "auto":
+        use_pallas = "force" if jax.default_backend() == "tpu" else "never"
+    if use_pallas in ("force", "interpret") and n and _RANS_PALLAS_OK:
+        try:
+            return _rans_decode_pallas(words_d, x0_d, freqs_d, n,
+                                       use_pallas == "interpret")
+        except Exception as e:  # Mosaic lowering gap: unfuse, don't fail  # graftlint: disable=broad-except -- compiler rejections are arbitrary; fallback is bit-identical
+            _RANS_PALLAS_OK = False
+            from ...utils.logging import get_logger
+
+            get_logger("cluster.rans").warning(
+                "pallas rANS decoder unavailable (%s: %s); falling back "
+                "to the jnp decoder", type(e).__name__, e)
+    return _rans_decode_jnp(words_d, x0_d, freqs_d, n)
+
+
+@functools.partial(jax.jit, static_argnames=("shift",))
+def _combine_plane(out, plane, shift: int):
+    """Fold one byte plane in; jitted so the shift embeds as a
+    compile-time constant instead of staging eagerly per call (the
+    runtime sanitizer's no-implicit-transfers class)."""
+    return out | (plane << jnp.uint32(shift))
+
+
+def decode_lane_device(lane: EntropyLane, arrays_d, *,
+                       use_pallas: str = "auto"):
+    """Decode an entropy-coded lane on device -> [n] uint32.
+
+    ``arrays_d``: the device-resident counterparts of
+    ``lane.wire_arrays()`` (same order — (words, x0, freqs) per plane),
+    device_put by the pipeline's wire layer."""
+    arrays_d = list(arrays_d)
+    assert len(arrays_d) == 3 * len(lane.planes), \
+        (len(arrays_d), len(lane.planes))
+    out = None
+    for p in range(len(lane.planes)):
+        words_d, x0_d, freqs_d = arrays_d[3 * p:3 * p + 3]
+        plane = _decode_plane(words_d, x0_d, freqs_d, lane.n, use_pallas)
+        if out is None:  # plane 0 always sits at shift 0
+            out = plane
+        else:
+            out = _combine_plane(out, plane,
+                                 8 * p if lane.bits > _DIRECT_BITS_MAX
+                                 else 0)
+    return out

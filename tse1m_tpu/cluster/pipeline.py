@@ -41,9 +41,9 @@ from ..resilience import (StageWatchdog, fault_point, is_device_loss,
                           watchdog_enabled)
 from ..utils.logging import get_logger
 from .encode import (_AUTO_MIN_BYTES, _AUTO_MIN_DELTA_FRACTION,
-                     _AUTO_QUANT_BITS, ChunkWire, encode_delta,
-                     pack_bits_host, pack_chunk, pack_delta_meta,
-                     quantize_ids, width_bits)
+                     _AUTO_QUANT_BITS, ChunkWire, chunk_wire_bits,
+                     encode_delta, pack_bits_host, pack_chunk,
+                     pack_delta_meta, quantize_ids, width_bits)
 from .lsh import bucket_representatives, estimated_jaccard, propagate_labels
 from .minhash import band_keys, make_hash_params, minhash_signatures
 from .minhash_pallas import minhash_and_keys, minhash_and_keys_packed
@@ -98,6 +98,23 @@ class ClusterParams:
     # the store still reuses cached signatures but re-runs banded LSH +
     # propagation on device over the full union.
     merge_max_novel: float = 0.05
+    # Wire v3, lever 1 — host-side one-permutation LSH prefilter
+    # (cluster/prefilter.py): bucket rows by cheap b-bit band keys on
+    # host and drop rows bucketed singleton in every band (they gain no
+    # verified edge on device and label themselves).  'auto' engages on
+    # large storeless runs with a positive threshold; 'on' forces it
+    # (still storeless-only — it refuses under a mesh or a sig_store);
+    # 'off' never.  Labels are CI-asserted elementwise-equal to the
+    # unfiltered path.
+    prefilter: str = "auto"
+    # Wire v3, lever 2 — static-table rANS entropy coding of the wire
+    # lanes (cluster/entropy.py): 'auto' codes any lane/chunk whose
+    # measured frame beats its bit-packed form (uniform lanes fall back
+    # to the plain pack, so v3 never regresses v2); 'force' codes every
+    # lane regardless of the win threshold (tests/CI); 'off' ships the
+    # v2 bit-packed format.  Choice is per chunk/lane and label-
+    # invariant either way.
+    entropy: str = "auto"
 
 
 # Observability surface for bench.py: stats of the last single-host
@@ -171,6 +188,23 @@ def _validate_encoding(params: ClusterParams) -> None:
     if params.encoding not in ("auto", "delta", "pack24"):
         raise ValueError(f"unknown encoding {params.encoding!r}; "
                          "expected auto | delta | pack24")
+    if params.entropy not in ("auto", "off", "force"):
+        raise ValueError(f"unknown entropy mode {params.entropy!r}; "
+                         "expected auto | off | force")
+    if params.prefilter not in ("auto", "off", "on"):
+        raise ValueError(f"unknown prefilter mode {params.prefilter!r}; "
+                         "expected auto | off | on")
+    if params.prefilter == "on" and params.sig_store:
+        raise ValueError(
+            "ClusterParams.prefilter='on' is storeless-only: the store "
+            "must cache a signature for every row, and prefiltered rows "
+            "never compute one. Use prefilter='auto' (which disables "
+            "itself under a sig_store) or drop the store.")
+    if params.prefilter == "on" and params.threshold <= 0:
+        raise ValueError(
+            "ClusterParams.prefilter='on' needs threshold > 0: with no "
+            "signature verification every proposed edge is accepted, so "
+            "bucket isolation proves nothing about labels.")
 
 
 def _quant_bits(items: np.ndarray, params: ClusterParams) -> int:
@@ -209,8 +243,15 @@ def _maybe_quantize(items: np.ndarray,
     return (quantize_ids(items, b) if b else items), b
 
 
-def _plan_wire(items: np.ndarray, params: ClusterParams):
+def _plan_wire(items: np.ndarray, params: ClusterParams,
+               qbits_override: int | None = None):
     """(items, enc, qbits): the single-host wire plan.
+
+    ``qbits_override``: the prefiltered paths pass the quantization
+    decision made over the FULL row set — the kept subset must ship in
+    exactly the universe the unfiltered run would have used, or label
+    parity breaks through the auto thresholds re-resolving on the
+    smaller input.
 
     Order matters: the delta sketch groups on RAW ids — a quantized
     universe collapses its (min, max) hash keys into a few hundred
@@ -223,7 +264,8 @@ def _plan_wire(items: np.ndarray, params: ClusterParams):
     from dataclasses import replace
 
     enc = _maybe_encode(items, params)
-    qbits = _quant_bits(items, params)
+    qbits = (qbits_override if qbits_override is not None
+             else _quant_bits(items, params))
     if qbits:
         if enc is not None:
             enc = replace(enc,
@@ -486,13 +528,23 @@ def _unpack_bits(packed, n: int, bits: int, offset):
     return val + offset
 
 
-def _decode_wire(payload_d, wire: ChunkWire):
+def _decode_wire(payload_d, wire: ChunkWire, use_pallas: str = "auto"):
     """Device payload + header -> decoded uint32 array of wire.shape.
 
+    Wire-v3 entropy chunks route through the fused rANS decoders
+    (cluster/kernels/rans.py); bit-packed chunks through _unpack_bits.
     The offset ships as an EXPLICIT scalar conversion: handed to the jit
     as a raw np.uint32 it would be staged implicitly per call — the
     regression class lint/runtime.no_implicit_transfers exists to catch.
     """
+    if wire.ent is not None:
+        from .kernels.rans import decode_lane_device
+
+        flat = decode_lane_device(wire.ent, payload_d,
+                                  use_pallas=use_pallas)
+        if wire.offset:
+            flat = flat + jax.device_put(np.uint32(wire.offset))
+        return flat.reshape(wire.shape)
     flat = _unpack_bits(payload_d, wire.n_values, wire.bits,
                         jax.device_put(np.uint32(wire.offset)))
     return flat.reshape(wire.shape)
@@ -500,7 +552,8 @@ def _decode_wire(payload_d, wire: ChunkWire):
 
 def _produce_chunk(chunk: np.ndarray, rec: StageRecorder,
                    wd: StageWatchdog | None = None,
-                   sup: "_DeviceSupervisor | None" = None):
+                   sup: "_DeviceSupervisor | None" = None,
+                   entropy: str = "off"):
     """Host half of one chunk: adaptive pack (encode stage) + device_put
     with a completion wait (h2d stage).  Runs on the producer thread when
     overlap is on, so both stages hide behind the main thread's compute.
@@ -516,15 +569,28 @@ def _produce_chunk(chunk: np.ndarray, rec: StageRecorder,
     exactly once per committed chunk, so stall recovery cannot skew the
     wire-accounting drift guard."""
     t0 = time.perf_counter()
-    wire = pack_chunk(chunk, _PACK_LIMIT)
+    stats: dict = {}
+    wire = pack_chunk(chunk, _PACK_LIMIT, entropy=entropy, stats=stats)
+    if wire.ent is not None:
+        # CRC frame check right before the arrays ship (store-shard
+        # semantics for the wire: corruption between the producer
+        # thread's encode and the put must refuse, not decode garbage).
+        from .entropy import verify_frame
+
+        verify_frame(wire.ent)
     rec.add("encode", time.perf_counter() - t0, wire.nbytes)
+    if stats.get("entropy_s"):
+        # The `entropy` stage's bytes column counts bytes SAVED vs the
+        # bit-packed alternative (stage_entropy_mb in the bench JSON).
+        rec.add("entropy", stats["entropy_s"],
+                stats.get("entropy_saved_bytes", 0))
 
     def put():
         fault_point("pipeline.h2d")
         with (sup.device_ctx() if sup is not None
               else contextlib.nullcontext()):
-            d = jax.device_put(wire.payload)
-            d.block_until_ready()
+            d = jax.device_put(wire.device_payload())
+            jax.block_until_ready(d)
         return d
 
     t0 = time.perf_counter()
@@ -537,7 +603,8 @@ def _produce_chunk(chunk: np.ndarray, rec: StageRecorder,
 
 def _iter_streamed(chunks: list, rec: StageRecorder, overlap: bool,
                    wd: StageWatchdog | None = None,
-                   sup: "_DeviceSupervisor | None" = None):
+                   sup: "_DeviceSupervisor | None" = None,
+                   entropy: str = "off"):
     """Yield (device payload, ChunkWire) per chunk, double-buffered: with
     overlap on (and >1 chunk), chunk k+1's pack + device_put run on a
     single producer thread while the caller computes on chunk k.  JAX
@@ -545,17 +612,18 @@ def _iter_streamed(chunks: list, rec: StageRecorder, overlap: bool,
     during compute k even on backends whose device_put returns early."""
     if not overlap or len(chunks) <= 1:
         for c in chunks:
-            yield _produce_chunk(c, rec, wd, sup)
+            yield _produce_chunk(c, rec, wd, sup, entropy)
         return
     from concurrent.futures import ThreadPoolExecutor
 
     ex = ThreadPoolExecutor(max_workers=1, thread_name_prefix="tse1m-h2d")
     try:
-        fut = ex.submit(_produce_chunk, chunks[0], rec, wd, sup)
+        fut = ex.submit(_produce_chunk, chunks[0], rec, wd, sup, entropy)
         for k in range(len(chunks)):
             cur = fut.result()
             if k + 1 < len(chunks):
-                fut = ex.submit(_produce_chunk, chunks[k + 1], rec, wd, sup)
+                fut = ex.submit(_produce_chunk, chunks[k + 1], rec, wd,
+                                sup, entropy)
             yield cur
     finally:
         ex.shutdown(wait=False, cancel_futures=True)
@@ -576,8 +644,8 @@ def _chunk_minhash(payload_d, wire: ChunkWire, a, b, params: ClusterParams,
     with rec.stage("compute"), (sup.device_ctx() if sup is not None
                                 else contextlib.nullcontext()):
         decoded = None
-        if want_decoded or wire.bits % 8 != 0:
-            decoded = _decode_wire(payload_d, wire)
+        if wire.ent is not None or want_decoded or wire.bits % 8 != 0:
+            decoded = _decode_wire(payload_d, wire, params.use_pallas)
             sig, keys = minhash_and_keys(decoded, a, b, params.n_bands, **kw)
         else:
             sig, keys = minhash_and_keys_packed(
@@ -625,7 +693,8 @@ def _stream_minhash_degraded(rows: np.ndarray, a, b, params: ClusterParams,
         done = 0
         try:
             for payload_d, wire in _iter_streamed(chunks, rec,
-                                                  params.overlap, wd, sup):
+                                                  params.overlap, wd, sup,
+                                                  params.entropy):
                 sig, keys, cd = _chunk_minhash(payload_d, wire, a, b, params,
                                                rec, want_decoded=want_decoded,
                                                sup=sup)
@@ -713,7 +782,8 @@ def _checkpointed_chunks(pending: list, a, b, params: ClusterParams,
         done = 0
         try:
             stream = _iter_streamed([c for _, c in remaining], rec,
-                                    params.overlap, wd, sup)
+                                    params.overlap, wd, sup,
+                                    params.entropy)
             for (idx, _), (payload_d, wire) in zip(remaining, stream):
                 sig, keys, cd = _chunk_minhash(
                     payload_d, wire, a, b, params, rec,
@@ -760,37 +830,63 @@ def _checkpointed_chunks(pending: list, a, b, params: ClusterParams,
         break
 
 
-def _put_delta_meta(enc, rec: StageRecorder):
+def _put_delta_meta(enc, rec: StageRecorder, entropy: str = "off"):
     """Pack the delta lanes (encode stage) and ship mask + rep + counts +
     pos + val as ONE pytree device_put (h2d stage) — one dispatch instead
     of the five sequential puts the previous layout paid (each put costs a
     link round-trip over tunneled PJRT).  The mask bits count toward the
     h2d bytes: they ride this put, and the recorded wire must equal the
-    `wire_payloads` inventory exactly (bench.py's drift guard)."""
+    `wire_payloads` inventory exactly (bench.py's drift guard) — under
+    wire v3 that inventory includes each rANS-coded lane's word stream,
+    frequency table and initial states."""
     t0 = time.perf_counter()
-    meta = pack_delta_meta(enc)
+    stats: dict = {}
+    meta = pack_delta_meta(enc, entropy=entropy, stats=stats)
+    for lane in meta.lanes():
+        if lane.ent is not None:
+            from .entropy import verify_frame
+
+            verify_frame(lane.ent)
+    if meta.val.ent is not None:
+        from .entropy import verify_frame
+
+        verify_frame(meta.val.ent)
     nbytes = meta.nbytes + enc.mask_bits.nbytes
     rec.add("encode", time.perf_counter() - t0, nbytes)
+    if stats.get("entropy_s"):
+        rec.add("entropy", stats["entropy_s"],
+                stats.get("entropy_saved_bytes", 0))
     t0 = time.perf_counter()
     mask_d, rep_d, counts_d, pos_d, val_d = jax.device_put(
-        (enc.mask_bits, meta.rep, meta.counts, meta.pos, meta.val.payload))
+        (enc.mask_bits, meta.rep.device_payload(),
+         meta.counts.device_payload(), meta.pos.device_payload(),
+         meta.val.device_payload()))
     jax.block_until_ready((mask_d, rep_d, counts_d, pos_d, val_d))
     rec.add("h2d", time.perf_counter() - t0, nbytes)
     return meta, mask_d, rep_d, counts_d, pos_d, val_d
 
 
-def _decode_delta_meta(meta, enc, full_d, rep_d, counts_d, pos_d, val_d):
-    """Unpack the bit-packed delta lanes on device and scatter-decode the
-    delta rows against the resident full lane.  Offsets convert
+def _decode_lane(lane, lane_d, use_pallas: str):
+    """One metadata lane's device decode: rANS frame or bit stream."""
+    if lane.ent is not None:
+        from .kernels.rans import decode_lane_device
+
+        return decode_lane_device(lane.ent, lane_d, use_pallas=use_pallas)
+    return _unpack_bits(lane_d, lane.n, lane.bits,
+                        jax.device_put(np.uint32(0)))
+
+
+def _decode_delta_meta(meta, enc, full_d, rep_d, counts_d, pos_d, val_d,
+                       use_pallas: str = "auto"):
+    """Unpack the delta lanes on device (bit streams via _unpack_bits,
+    entropy-coded lanes via the fused rANS decoders) and scatter-decode
+    the delta rows against the resident full lane.  Offsets convert
     explicitly (see _decode_wire) so the hot loop stays implicit-
     transfer-free under the runtime sanitizer."""
-    zero = jax.device_put(np.uint32(0))
-    rep = _unpack_bits(rep_d, enc.n_delta, meta.rep_bits, zero)
-    counts = _unpack_bits(counts_d, enc.n_delta, meta.counts_bits, zero)
-    pos = _unpack_bits(pos_d, int(enc.pos_flat.shape[0]), meta.pos_bits,
-                       zero)
-    vals = _unpack_bits(val_d, meta.val.n_values, meta.val.bits,
-                        jax.device_put(np.uint32(meta.val.offset)))
+    rep = _decode_lane(meta.rep, rep_d, use_pallas)
+    counts = _decode_lane(meta.counts, counts_d, use_pallas)
+    pos = _decode_lane(meta.pos, pos_d, use_pallas)
+    vals = _decode_wire(val_d, meta.val, use_pallas).reshape(-1)
     return _decode_delta_raw(full_d, rep, counts, pos, vals)
 
 
@@ -803,10 +899,11 @@ def _cluster_encoded(items: np.ndarray, enc, a, b, params: ClusterParams,
     parts, chunks_d, wire_bits = _stream_minhash_degraded(
         enc.full_rows, a, b, params, rec, want_decoded=True)
     full_d = chunks_d[0] if len(chunks_d) == 1 else jnp.concatenate(chunks_d)
-    meta, mask_d, rep_d, counts_d, pos_d, val_d = _put_delta_meta(enc, rec)
+    meta, mask_d, rep_d, counts_d, pos_d, val_d = _put_delta_meta(
+        enc, rec, params.entropy)
     with rec.stage("compute"):
         delta_items = _decode_delta_meta(meta, enc, full_d, rep_d, counts_d,
-                                         pos_d, val_d)
+                                         pos_d, val_d, params.use_pallas)
         dsig, dkeys = minhash_and_keys(delta_items, a, b, params.n_bands,
                                        use_pallas=params.use_pallas,
                                        block_n=params.block_n)
@@ -854,6 +951,13 @@ def cluster_sessions(items, params: ClusterParams | None = None,
     bucket-sort stage.
     """
     params = params or ClusterParams()
+    _validate_encoding(params)
+    if params.prefilter == "on" and mesh is not None:
+        raise ValueError(
+            "ClusterParams.prefilter='on' is a single-host wire lever: "
+            "the mesh feed has no per-host keep mask to apply. Drop "
+            "prefilter (auto disables itself under a mesh) or run "
+            "single-host.")
     if params.sig_store and mesh is not None:
         # Refuse loudly rather than silently dropping the store (the
         # pre-pod behavior): this entry point has no per-host row
@@ -950,14 +1054,111 @@ def cluster_sessions(items, params: ClusterParams | None = None,
         return out
 
     items = np.ascontiguousarray(items, dtype=np.uint32)
-    raw_items = items  # pre-quantization buffer (the quant-drop rung
-    #                    re-quantizes from here; _plan_wire never mutates)
     rec = StageRecorder()
     t_all = time.perf_counter()
     last_run_info.clear()
+    # Wire v3, lever 1: the host prefilter runs over the RAW ids before
+    # anything is planned; the quantization decision is made over the
+    # FULL row set and passed down so the kept subset ships in exactly
+    # the universe the unfiltered run would have used.
+    qbits_full = _quant_bits(items, params)
+    keep = _prefilter_keep(items, params, rec)
+    work = items if keep is None else items[keep]
+    out = _cluster_single_host(work, a, b, params, rec, qbits_full)
+    if keep is not None:
+        out = _scatter_prefiltered(items.shape[0], keep, out)
+    _record_wire(rec)
+    _record_wire_v3(items, params, qbits_full, keep, rec)
+    _finish_run(rec, t_all)
+    return out
 
+
+def _scatter_prefiltered(full_n: int, keep: np.ndarray,
+                         out: np.ndarray) -> np.ndarray:
+    """Map subset labels back to the full row set: dropped rows label
+    themselves (no verified edge can reach them), kept components'
+    minimum index maps back through the (sorted, order-preserving)
+    kept-index table — so the result equals the unfiltered run's
+    min-original-index labels elementwise."""
+    keep_idx = np.flatnonzero(keep)
+    full = np.arange(full_n, dtype=np.int32)
+    full[keep_idx] = keep_idx[out].astype(np.int32)
+    return full
+
+
+def _prefilter_mask(items: np.ndarray,
+                    params: ClusterParams) -> np.ndarray | None:
+    """THE prefilter engagement decision + mask, shared by the pipeline
+    and the bench probe (`wire_payloads`) so the two can never disagree
+    about what ships.  None = filter off (mode, store, threshold, or
+    auto size gate); else the boolean keep mask over the RAW rows.
+    Modes: 'off' never; 'auto' on large storeless runs with a verifying
+    threshold; 'on' forces (invalid combinations refused by
+    _validate_encoding)."""
+    if (params.prefilter == "off" or params.sig_store
+            or params.threshold <= 0):
+        return None
+    if params.prefilter == "auto" and items.nbytes < _AUTO_MIN_BYTES:
+        return None
+    from .prefilter import collide_mask
+
+    return collide_mask(items, params.seed)
+
+
+def _prefilter_keep(items: np.ndarray, params: ClusterParams,
+                    rec: StageRecorder) -> np.ndarray | None:
+    """`_prefilter_mask` + telemetry: a keep mask when the filter
+    engaged AND dropped something, else None.  Telemetry lands in
+    last_run_info either way so the bench keys always exist."""
+    last_run_info.update(prefilter_hit_rate=0.0, prefilter_rows_dropped=0)
     t0 = time.perf_counter()
-    items, enc, qbits = _plan_wire(items, params)
+    keep = _prefilter_mask(items, params)
+    if keep is None:
+        return None
+    from .prefilter import N_BANDS
+
+    rec.add("prefilter", time.perf_counter() - t0)
+    n = items.shape[0]
+    dropped = int(n - keep.sum())
+    last_run_info.update(
+        prefilter_hit_rate=round(dropped / max(n, 1), 4),
+        prefilter_rows_dropped=dropped, prefilter_bands=N_BANDS)
+    if dropped == 0:
+        return None
+    return keep
+
+
+def _record_wire_v3(items: np.ndarray, params: ClusterParams, qbits: int,
+                    keep: np.ndarray | None, rec: StageRecorder) -> None:
+    """Wire-v3 savings telemetry (`wire_v3_saved_mb` bench key): the
+    entropy column is measured (codec bytes vs the bit-packed
+    alternative, accrued on the `entropy` stage); the prefilter column
+    is an estimate — dropped rows costed at the run's packed width, the
+    lane they would most likely have shipped in."""
+    ent_saved = int(rec.nbytes.get("entropy", 0))
+    pf_saved = 0
+    if keep is not None and items.size:
+        w = qbits or chunk_wire_bits(items, _PACK_LIMIT)[0]
+        dropped = int(items.shape[0] - keep.sum())
+        pf_saved = dropped * int(items.shape[1]) * w // 8
+    last_run_info.update(
+        wire_version=3,
+        entropy_saved_mb=round(ent_saved / 2**20, 3),
+        prefilter_saved_mb=round(pf_saved / 2**20, 3),
+        wire_v3_saved_mb=round((ent_saved + pf_saved) / 2**20, 3))
+
+
+def _cluster_single_host(items: np.ndarray, a, b, params: ClusterParams,
+                         rec: StageRecorder,
+                         qbits_override: int | None = None) -> np.ndarray:
+    """The storeless single-host pipeline over (possibly prefiltered)
+    rows: plan the wire, stream + MinHash + cluster, return labels in
+    row order.  Wire/stage accounting accrues into ``rec``; the caller
+    owns _record_wire/_finish_run."""
+    raw_items = items  # pre-quantization buffer (the quant-drop rung
+    #                    re-quantizes from here; _plan_wire never mutates)
+    t0 = time.perf_counter()
+    items, enc, qbits = _plan_wire(items, params, qbits_override)
     rec.add("encode", time.perf_counter() - t0)
     last_run_info.update(wire_quant_bits=qbits)
     clamped = (params.sig_store is None and params.wire_quant_bits == 0
@@ -966,10 +1167,7 @@ def cluster_sessions(items, params: ClusterParams | None = None,
         last_run_info.update(
             encoding="delta", encode_s=round(time.perf_counter() - t0, 4),
             n_full=enc.n_full, n_delta=enc.n_delta)
-        out = _cluster_encoded(items, enc, a, b, params, rec)
-        _record_wire(rec)
-        _finish_run(rec, t_all)
-        return out
+        return _cluster_encoded(items, enc, a, b, params, rec)
 
     last_run_info.update(encoding="plain")
     # The quant-drop rung is storeless-only (a store's policy key pins
@@ -993,8 +1191,6 @@ def cluster_sessions(items, params: ClusterParams | None = None,
         record_degradation("quant_restore", site="pipeline.stream",
                            detail={"from_bits": int(qbits)})
         _restore_quant_bits()
-    _record_wire(rec)
-    _finish_run(rec, t_all)
     return out
 
 
@@ -1031,6 +1227,7 @@ def cluster_sessions_resumable(items, params: ClusterParams | None = None,
     process its own directory for its local row range.
     """
     params = params or ClusterParams()
+    _validate_encoding(params)
     if checkpoint_dir is None:
         return cluster_sessions(items, params)
     from .checkpoint import ClusterCheckpoint
@@ -1072,11 +1269,24 @@ def cluster_sessions_resumable(items, params: ClusterParams | None = None,
     rec = StageRecorder()
     t_all = time.perf_counter()
     last_run_info.clear()
+    # Wire v3 prefilter (storeless only — the store caches a signature
+    # per row): deterministic over (items, params), so a resume
+    # recomputes the same keep mask; the checkpoint fingerprints the
+    # SUBSET and carries the kept count, so a resume under a changed
+    # prefilter policy refuses instead of mixing shards.
+    full_items = items
+    qbits_full = _quant_bits(items, params)
+    keep = None
+    if digests is None:
+        keep = _prefilter_keep(items, params, rec)
+    if keep is not None:
+        items = items[keep]
+        n = items.shape[0]
     t0 = time.perf_counter()
     # Shards hold signatures of the QUANTIZED universe, so a resume under
     # a different quantization policy must read as a different run and
     # refuse — the manifest meta carries the effective bits.
-    items, enc, qbits = _plan_wire(items, params)
+    items, enc, qbits = _plan_wire(items, params, qbits_full)
     rec.add("encode", time.perf_counter() - t0)
     last_run_info.update(wire_quant_bits=qbits)
 
@@ -1086,9 +1296,13 @@ def cluster_sessions_resumable(items, params: ClusterParams | None = None,
         # The quant key appears only when quantization engaged: shard
         # contents are unchanged otherwise, and the symmetric manifest
         # comparison already refuses a quantized<->unquantized resume.
+        extra = {}
+        if qbits:
+            extra["wire_quant_bits"] = qbits
+        if keep is not None:
+            extra["prefilter_kept"] = int(n)
         ckpt = ClusterCheckpoint(checkpoint_dir, items, params, step,
-                                 extra=({"wire_quant_bits": qbits}
-                                        if qbits else None))
+                                 extra=extra or None)
         parts: dict = {}
         pending = []
         for idx, i in enumerate(range(0, n, step)):
@@ -1118,7 +1332,10 @@ def cluster_sessions_resumable(items, params: ClusterParams | None = None,
                                      None, rec)
         if cleanup:
             ckpt.cleanup()
+        if keep is not None:
+            out = _scatter_prefiltered(full_items.shape[0], keep, out)
         _record_wire(rec)
+        _record_wire_v3(full_items, params, qbits_full, keep, rec)
         _finish_run(rec, t_all)
         return out
 
@@ -1140,6 +1357,8 @@ def cluster_sessions_resumable(items, params: ClusterParams | None = None,
     extra = {"encoding": "delta", "lane_fingerprint": lane_fp}
     if qbits:
         extra["wire_quant_bits"] = qbits
+    if keep is not None:
+        extra["prefilter_kept"] = int(n)
     ckpt = ClusterCheckpoint(checkpoint_dir, items, params, step,
                              extra=extra, n_chunks=n_full_chunks + 1)
     parts = {}
@@ -1167,16 +1386,19 @@ def cluster_sessions_resumable(items, params: ClusterParams | None = None,
         # so put them now (raw rows only — their signatures are done).
         for idx, i in enumerate(range(0, full.shape[0], step)):
             if chunks_d[idx] is None:
-                payload_d, wire = _produce_chunk(full[i:i + step], rec)
+                payload_d, wire = _produce_chunk(full[i:i + step], rec,
+                                                 entropy=params.entropy)
                 with rec.stage("compute"):
-                    chunks_d[idx] = _decode_wire(payload_d, wire)
+                    chunks_d[idx] = _decode_wire(payload_d, wire,
+                                                 params.use_pallas)
         full_d = (chunks_d[0] if len(chunks_d) == 1
                   else jnp.concatenate(chunks_d))
-        meta, mask_d, rep_d, counts_d, pos_d, val_d = _put_delta_meta(enc,
-                                                                      rec)
+        meta, mask_d, rep_d, counts_d, pos_d, val_d = _put_delta_meta(
+            enc, rec, params.entropy)
         with rec.stage("compute"):
             delta_items = _decode_delta_meta(meta, enc, full_d, rep_d,
-                                             counts_d, pos_d, val_d)
+                                             counts_d, pos_d, val_d,
+                                             params.use_pallas)
             dsig, dkeys = minhash_and_keys(delta_items, a, b, params.n_bands,
                                            use_pallas=params.use_pallas,
                                            block_n=params.block_n)
@@ -1200,7 +1422,10 @@ def cluster_sessions_resumable(items, params: ClusterParams | None = None,
                                  enc, rec)
     if cleanup:
         ckpt.cleanup()
+    if keep is not None:
+        out = _scatter_prefiltered(full_items.shape[0], keep, out)
     _record_wire(rec)
+    _record_wire_v3(full_items, params, qbits_full, keep, rec)
     _finish_run(rec, t_all)
     return out
 
@@ -1238,26 +1463,39 @@ def wire_payloads(items, params: ClusterParams | None = None):
     params = params or ClusterParams()
     _validate_encoding(params)
     items = np.ascontiguousarray(items, dtype=np.uint32)
-    items, enc, qbits = _plan_wire(items, params)
+    # Mirror the pipeline's wire-v3 plan exactly: full-set quantization
+    # decision, prefilter keep mask, then the per-chunk/per-lane codec
+    # choice — so the probe's byte inventory equals the StageRecorder
+    # h2d bytes (bench's wire_drift_bytes == 0 guard).
+    full_n = items.shape[0]
+    qbits_full = _quant_bits(items, params)
+    keep = _prefilter_mask(items, params)
+    if keep is not None and keep.all():
+        keep = None
+    if keep is not None:
+        items = items[keep]
+    items, enc, qbits = _plan_wire(items, params, qbits_full)
     payloads, chunk_bits = [], []
     if enc is None:
         step = _stream_plan(items, params)
         for chunk in _row_chunks(items, step):
-            wire = pack_chunk(chunk, _PACK_LIMIT)
-            payloads.append(wire.payload)
+            wire = pack_chunk(chunk, _PACK_LIMIT, entropy=params.entropy)
+            payloads += wire.wire_arrays()
             chunk_bits.append(wire.bits)
         info = dict(encoding="plain")
     else:
         step = _stream_plan(enc.full_rows, params)
         for chunk in _row_chunks(enc.full_rows, step):
-            wire = pack_chunk(chunk, _PACK_LIMIT)
-            payloads.append(wire.payload)
+            wire = pack_chunk(chunk, _PACK_LIMIT, entropy=params.entropy)
+            payloads += wire.wire_arrays()
             chunk_bits.append(wire.bits)
-        meta = pack_delta_meta(enc)
-        payloads += [enc.mask_bits, meta.rep, meta.counts, meta.pos,
-                     meta.val.payload]
+        meta = pack_delta_meta(enc, entropy=params.entropy)
+        payloads += [enc.mask_bits] + meta.wire_arrays()
         info = dict(encoding="delta", n_full=enc.n_full, n_delta=enc.n_delta)
     info.update(wire_quant_bits=qbits, chunk_bits=chunk_bits,
+                wire_version=3,
+                prefilter_rows_dropped=(0 if keep is None
+                                        else int(full_n - keep.sum())),
                 wire_mb=round(sum(p.nbytes for p in payloads) / 2**20, 2))
     return payloads, info
 
@@ -1586,6 +1824,7 @@ def cluster_sessions_pod(local_items, n_rows: int,
     from .store import ShardedSignatureStore, row_digests
 
     params = params or ClusterParams()
+    _validate_encoding(params)
     if not params.sig_store:
         raise ValueError("cluster_sessions_pod requires params.sig_store "
                          "(the pod path IS the store path; use "

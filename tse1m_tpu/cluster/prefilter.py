@@ -1,0 +1,133 @@
+"""Host-side one-permutation b-bit LSH prefilter (wire v3's second lever).
+
+At the north-star operating point the cold run is link-bound 5:1: the
+wire, not MinHash, is the wall.  But ~40% of the planted workload (and
+the long tail of a real fuzzing corpus) is *isolated* — rows that share
+no near-duplicate with anything — and an isolated row provably labels
+itself under the pipeline's signature-agreement rule (its estimated
+Jaccard against every elected hub is below threshold, so it gains no
+verified edge and keeps its own index).  Rows we can prove-or-measure
+isolated never need to cross the link at full fidelity at all.
+
+Deciding "which rows can possibly collide" is much cheaper than MinHash
+proper: b-bit minwise hashing (arXiv:1205.2958) shows a few bits of
+hash remnant retain enough resemblance signal, and a C-MinHash-style
+one-permutation pass (arXiv:2109.03337) gets K minwise values from ONE
+element-hash pass plus K cheap multiplicative mixes instead of K full
+hash passes.  Here: hash every element once (the "permutation"), then
+for each of ``N_BANDS * HASHES_PER_BAND`` mixes take the LOWEST
+``KEY_BITS`` bits of the row minimum (the minimum concentrates near 0;
+its low bits are the uniform part — the 1205.2958 construction); a
+*band key* packs ``HASHES_PER_BAND`` adjacent remnants (32-bit keys —
+wide enough that chance collisions at 1M rows are a few hundred
+spuriously-kept rows, not a recall loss).  A row that shares no band
+key with any other row is bucketed singleton everywhere and is dropped
+from the device batch; everything else ships as before.
+
+The filter buckets the RAW ids even when the wire quantizes
+(encode.quantize_ids): in a 2^8..2^10 universe per-hash buckets are
+dense (nothing is singleton), while raw-space isolation still implies
+no verifiable device edge — quantization lifts a random pair's Jaccard
+to only ~set_size/2^b (~0.03..0.13), and a verified edge needs the
+128-hash estimate to reach the threshold, exponentially unlikely from
+there.  Raw-space bucketing also makes the mask independent of the
+quant-drop degradation rung: a mid-stream width drop never invalidates
+the kept set.
+
+Semantics contract (pipeline.ClusterParams.prefilter = off|auto|on):
+the filter is a *transfer* optimization — labels must equal the
+unfiltered run's elementwise.  A false KEEP only costs wire; a false
+DROP could split a cluster, so the defaults are sized for the regime
+the verifier actually accepts (est >= threshold ~ 0.5): a colliding
+pair at Jaccard J is missed with probability ~(1 - J^2)^20 — ~7e-11 at
+the planted J~0.83, ~2e-5 even at J=0.65 — decaying exponentially in
+band count.  CI asserts label parity elementwise and
+``prefilter_recall`` (below) self-checks against planted truth;
+threshold <= 0 disables the filter (with no verifier, every proposed
+edge is accepted and isolation proves nothing).
+
+Host-only by design: numpy, no jax import, no device — the wire layer
+(cluster/pipeline.py) stays the only plane that moves bytes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+N_BANDS = 20          # prefilter bands (keys per row)
+HASHES_PER_BAND = 2   # b-bit minwise values packed per band key
+KEY_BITS = 16         # b-bit minwise remnant per hash (arXiv:1205.2958)
+
+# The one-permutation pass: a fixed odd multiply-add bijection over
+# uint32 (the "permutation"), then per-hash odd multiplicative mixes.
+_PERM_MULT = np.uint32(0x9E3779B1)
+_PERM_ADD = np.uint32(0x7F4A7C15)
+_ROW_CHUNK = 1 << 16  # bound the [chunk, S] temporaries to cache-friendly
+
+
+def _mix_consts(seed: int, k: int) -> np.ndarray:
+    """k odd uint32 multipliers, deterministic per seed; offset from the
+    device family's stream so the two stay independent."""
+    rng = np.random.default_rng(seed ^ 0x5EEDB177)
+    return (rng.integers(1, 1 << 32, size=k, dtype=np.uint32)
+            | np.uint32(1))
+
+
+def band_keys_host(items: np.ndarray, seed: int = 0) -> np.ndarray:
+    """[N, S] uint32 feature sets -> [N, N_BANDS] uint32 band keys.
+
+    One element-hash pass + K multiplicative mixes; each mix's row
+    minimum contributes its lowest ``KEY_BITS`` bits, ``HASHES_PER_BAND``
+    of them packed into one 32-bit band key."""
+    items = np.ascontiguousarray(items, dtype=np.uint32)
+    n = items.shape[0]
+    k = N_BANDS * HASHES_PER_BAND
+    consts = _mix_consts(seed, k)
+    keys = np.zeros((n, N_BANDS), np.uint32)
+    # The b-bit remnant is the LOWEST b bits of the minimum (the minimum
+    # itself concentrates near 0 — its low bits are the uniform part,
+    # which is the 1205.2958 construction).
+    mask = np.uint32((1 << KEY_BITS) - 1)
+    with np.errstate(over="ignore"):
+        for lo in range(0, n, _ROW_CHUNK):
+            blk = items[lo:lo + _ROW_CHUNK]
+            perm = blk * _PERM_MULT + _PERM_ADD     # the one permutation
+            for j in range(N_BANDS):
+                key = np.zeros(blk.shape[0], np.uint32)
+                for t in range(HASHES_PER_BAND):
+                    c = consts[j * HASHES_PER_BAND + t]
+                    mins = (perm * c).min(axis=1)   # C-MinHash-style mix
+                    key = (key << np.uint32(KEY_BITS)) | (mins & mask)
+                keys[lo:lo + _ROW_CHUNK, j] = key
+    return keys
+
+
+def collide_mask(items: np.ndarray, seed: int = 0) -> np.ndarray:
+    """[N] bool: True for rows sharing at least one band bucket with
+    another row (the rows that can possibly collide on device).  Rows
+    with False are bucketed singleton in EVERY band and skip the wire."""
+    n = items.shape[0]
+    collide = np.zeros(n, bool)
+    if n < 2:
+        return collide
+    keys = band_keys_host(items, seed)
+    for j in range(N_BANDS):
+        k = keys[:, j]
+        uniq, counts = np.unique(k, return_counts=True)
+        collide |= counts[np.searchsorted(uniq, k)] > 1
+        if collide.all():
+            break
+    return collide
+
+
+def prefilter_recall(keep: np.ndarray, truth: np.ndarray) -> float:
+    """Self-check against planted truth: the fraction of rows belonging
+    to multi-member planted clusters that the filter KEPT.  1.0 means no
+    planted near-duplicate was dropped; bench asserts this."""
+    truth = np.asarray(truth)
+    uniq, counts = np.unique(truth, return_counts=True)
+    multi = counts[np.searchsorted(uniq, truth)] > 1
+    denom = int(multi.sum())
+    if denom == 0:
+        return 1.0
+    return float(np.asarray(keep, bool)[multi].sum() / denom)

@@ -404,8 +404,13 @@ def host_in_jit(src: FileSource) -> list[Finding]:
 # The blessed wire layer: the ONLY seats allowed to move bytes across the
 # host<->device link.  Everything else must feed through them so wire
 # accounting (StageRecorder h2d/d2h bytes) and the adaptive encoder can't
-# be bypassed.
-_WIRE_LAYER = ("tse1m_tpu/cluster/encode.py", "tse1m_tpu/cluster/pipeline.py")
+# be bypassed.  Wire v3 admits the entropy codec and the host prefilter
+# as the only new seats (their frames/masks ARE wire format; today both
+# stay host-side and route their puts through pipeline.py, but the
+# format modules are part of the plane they define).
+_WIRE_LAYER = ("tse1m_tpu/cluster/encode.py", "tse1m_tpu/cluster/pipeline.py",
+               "tse1m_tpu/cluster/entropy.py",
+               "tse1m_tpu/cluster/prefilter.py")
 
 
 def wire_layer(src: FileSource) -> list[Finding]:

@@ -25,7 +25,11 @@ Stage names are part of the bench-JSON contract (``stage_<name>_s`` /
 device dispatch+wait, ``d2h`` device->host result fetch — plus the
 signature-store warm path's ``probe`` (content hashing + store
 bulk-probe) and ``load`` (cached-signature mmap reads, bytes = gathered
-signature bytes), recorded by `cluster/pipeline.py`'s store paths.
+signature bytes), recorded by `cluster/pipeline.py`'s store paths, and
+wire v3's ``prefilter`` (the host one-permutation band-key pass) and
+``entropy`` (rANS lane coding; its *bytes* column counts bytes SAVED vs
+the bit-packed alternative, so ``stage_entropy_mb`` reads as the
+codec's win).
 """
 
 from __future__ import annotations
@@ -35,7 +39,8 @@ import threading
 import time
 from collections import defaultdict
 
-STAGES = ("encode", "h2d", "compute", "d2h", "probe", "load")
+STAGES = ("encode", "h2d", "compute", "d2h", "probe", "load",
+          "prefilter", "entropy")
 
 
 class StageRecorder:
