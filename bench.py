@@ -316,6 +316,23 @@ def main() -> int:
                         "form, 'force' codes everything — the CI lever "
                         "for proving degraded-width re-encode paths "
                         "(also BENCH_ENTROPY)")
+    p.add_argument("--serve", action="store_true",
+                   default=os.environ.get("BENCH_SERVE", "")
+                   not in ("", "0"),
+                   help="serving round (tse1m_tpu/serve): populate a "
+                        "store with the leading 90%% of the corpus, run "
+                        "the ingest daemon + TCP API, stream the last "
+                        "10%% in while query threads fire concurrently, "
+                        "then assert post-quiesce membership answers "
+                        "elementwise-equal to the cold batch labels — "
+                        "emits serve_p99_ms / serve_qps / "
+                        "ingest_backlog_max (also BENCH_SERVE=1)")
+    p.add_argument("--serve-query-threads", type=int,
+                   default=int(os.environ.get("BENCH_SERVE_QUERY_THREADS",
+                                              2)))
+    p.add_argument("--serve-batch", type=int,
+                   default=int(os.environ.get("BENCH_SERVE_BATCH", 1024)),
+                   help="ingest batch size for the serving round")
     p.add_argument("--sanitize", action="store_true",
                    default=os.environ.get("BENCH_SANITIZE", "")
                    not in ("", "0"),
@@ -647,6 +664,171 @@ def main() -> int:
             "cache_warm_sanitized": bool(args.sanitize),
         }
 
+    def bench_serve() -> dict:
+        """Serving round: sustained ingest QPS with concurrent query p99.
+
+        The leading 90% of the corpus populates the store through the
+        BATCH path (committing the LSH state the daemon adopts — the
+        production shape: yesterday's cron populated, today's sessions
+        stream in), then the daemon serves over TCP while one client
+        streams the remaining 10% in ingest batches and
+        ``--serve-query-threads`` clients fire single-vector membership
+        queries against already-acknowledged rows.  After quiesce, the
+        membership answer for EVERY session is asserted elementwise-
+        equal to the cold batch labels (cross-universe runs fall back to
+        the ARI gate, same as the warm round).  The query hot path runs
+        under the runtime sanitizer when --sanitize: it is host-only by
+        construction, so zero implicit transfers and zero compiles."""
+        import contextlib
+        import tempfile
+        import threading
+
+        import numpy as np
+
+        from dataclasses import replace
+
+        from tse1m_tpu.cluster.pipeline import last_run_info as lri
+        from tse1m_tpu.serve import (Backpressure, ServeClient, ServeDaemon,
+                                     ServeServer, SloPolicy)
+
+        store_dir = ((args.sig_store.rstrip("/") + "_serve")
+                     if args.sig_store else
+                     tempfile.mkdtemp(prefix="tse1m_serve_"))
+        split = max(1, int(args.n * 0.9))
+        base, tail = items[:split], items[split:]
+        populate_params = replace(params, sig_store=store_dir,
+                                  prefilter="off")
+        cluster_sessions(base, populate_params)
+        base_qb = int(lri.get("wire_quant_bits") or 0)
+        daemon = ServeDaemon(store_dir, params=params,
+                             slo=SloPolicy.from_env()).start()
+        server = ServeServer(daemon)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        acked = [split]  # rows queryable so far (daemon-order prefix)
+        ingest_walls = []
+        stop_queries = threading.Event()
+        errors: list = []
+
+        def ingest_client() -> None:
+            try:
+                with ServeClient(port=server.port) as c:
+                    for lo in range(0, tail.shape[0], args.serve_batch):
+                        batch = tail[lo:lo + args.serve_batch]
+                        t0 = time.perf_counter()
+                        while True:
+                            try:
+                                c.ingest(batch)
+                                break
+                            except Backpressure as e:
+                                time.sleep(e.retry_after_s)
+                        ingest_walls.append(time.perf_counter() - t0)
+                        acked[0] = split + lo + batch.shape[0]
+            except Exception as e:  # graftlint: disable=broad-except -- cross-thread relay: collected and re-raised on the main thread below
+                errors.append(e)
+            finally:
+                stop_queries.set()
+
+        client_walls: list = []
+
+        def query_client(seed: int) -> None:
+            rng = np.random.default_rng(seed)
+            walls = []
+            try:
+                with ServeClient(port=server.port) as c:
+                    while not stop_queries.is_set():
+                        i = int(rng.integers(0, acked[0]))
+                        t0 = time.perf_counter()
+                        resp = c.query(items[i:i + 1])
+                        walls.append(time.perf_counter() - t0)
+                        if not bool(resp["known"][0]):
+                            raise AssertionError(
+                                f"acked row {i} unknown to the daemon")
+            except Exception as e:  # graftlint: disable=broad-except -- cross-thread relay: collected and re-raised on the main thread below
+                errors.append(e)
+            finally:
+                client_walls.append(walls)
+
+        # Warm the query path (first-digest numpy warmup etc.), then
+        # measure a clean window.
+        daemon.query(items[:1])
+        daemon.lat_query.reset_window()
+        threads = [threading.Thread(target=ingest_client, daemon=True)]
+        threads += [threading.Thread(target=query_client, args=(7 + i,),
+                                     daemon=True)
+                    for i in range(max(1, args.serve_query_threads))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=1800)
+        if errors:
+            raise errors[0]
+        with ServeClient(port=server.port) as c:
+            c.quiesce(timeout_s=600)
+            status = c.status()
+        qstats = daemon.lat_query.snapshot()
+        # Post-quiesce parity: membership answers for the WHOLE corpus
+        # vs the cold batch labels — under the sanitizer when asked
+        # (the query path must stay host-only).
+        ctx = contextlib.nullcontext()
+        if args.sanitize:
+            from tse1m_tpu.lint.runtime import sanitized
+
+            ctx = sanitized(0)
+        serve_labels = np.empty(args.n, np.int64)
+        with ctx:
+            for lo in range(0, args.n, 65536):
+                resp = daemon.query(items[lo:lo + 65536])
+                if not bool(resp["known"].all()):
+                    raise AssertionError(
+                        "post-quiesce query misses ingested rows")
+                serve_labels[lo:lo + 65536] = resp["labels"]
+        cold_qb = int(cluster_info.get("wire_quant_bits") or 0)
+        if cold_qb == base_qb:
+            if not np.array_equal(serve_labels, labels):
+                raise AssertionError(
+                    "serving-plane membership answers differ from the "
+                    "cold batch run — the live index broke label parity")
+            parity = "elementwise"
+        else:
+            cross = adjusted_rand_index(serve_labels, labels)
+            if cross < 0.98:
+                raise AssertionError(
+                    f"serving labels diverged (ARI {cross:.4f}) from "
+                    f"the degraded cold run (cold 2^{cold_qb}, serve "
+                    f"2^{base_qb})")
+            parity = f"ari:{round(cross, 5)}"
+        with ServeClient(port=server.port) as c:
+            c.shutdown()
+        daemon.stop()
+        server.server_close()
+        tail_rows = int(tail.shape[0])
+        ingest_wall = sum(ingest_walls) or 1e-9
+        # Client-PERCEIVED latency (request to response over TCP, incl.
+        # any retried/timed-out attempts) alongside the daemon-side
+        # histogram: under heavy concurrent ingest the GIL convoy shows
+        # up here first, so the honest SLO number is this one.
+        all_walls = np.sort(np.concatenate(
+            [np.asarray(w) for w in client_walls if w] or [np.zeros(1)]))
+        cp = {q: round(float(np.percentile(all_walls, q)) * 1e3, 3)
+              for q in (50, 99)}
+        return {
+            "serve_rows": int(status["rows"]),
+            "serve_generation": int(status["generation"]),
+            "serve_client_p50_ms": cp[50],
+            "serve_client_p99_ms": cp[99],
+            "serve_p50_ms": qstats["p50_ms"],
+            "serve_p99_ms": qstats["p99_ms"],
+            "serve_qps": qstats["qps"],
+            "serve_query_count": qstats["count"],
+            "serve_ingest_rows_s": round(tail_rows / ingest_wall, 1),
+            "serve_ingest_batches": len(ingest_walls),
+            "ingest_backlog_max": int(status["ingest_backlog_max"]),
+            "serve_ingest_rejected": int(status["ingest_rejected"]),
+            "serve_slo_violations": int(status["query_slo_violations"]),
+            "serve_parity": parity,
+            "serve_sanitized": bool(args.sanitize),
+        }
+
     warm_stats = {}
     if args.sig_store:
         warm_stats = bench_warm_store()
@@ -665,6 +847,10 @@ def main() -> int:
         # the frame is inherited as "correct" and only this catches it.
         warm_stats.update(store.verify_signatures(items, sample=256,
                                                   seed=args.seed))
+
+    serve_stats = {}
+    if args.serve:
+        serve_stats = bench_serve()
 
     ari = adjusted_rand_index(labels, truth)
     ari_host = None
@@ -710,6 +896,7 @@ def main() -> int:
     if wire_drift is not None:
         result["wire_drift_bytes"] = wire_drift
     result.update(warm_stats)
+    result.update(serve_stats)
     if sanitizer is not None:
         # Runtime-sanitizer proof for this bench round: the timed window
         # ran under the transfer guard (zero implicit H2D transfers, or it

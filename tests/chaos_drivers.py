@@ -172,6 +172,30 @@ def run_compact(args) -> int:
     return 0
 
 
+def run_serve(args) -> int:
+    """Serving daemon subprocess (ingest loop + TCP API) for the serve
+    chaos tests: the parent ingests batches through a ServeClient while
+    a fault plan SIGKILLs this process at ``serve.ingest.commit`` —
+    mid-batch, BEFORE the store append commits — and then asserts a
+    restarted daemon still answers every previously-ACKNOWLEDGED row
+    (zero lost acked rows; the un-acked batch recomputes)."""
+    from tse1m_tpu.cluster import ClusterParams
+    from tse1m_tpu.serve import ServeDaemon, ServeServer, SloPolicy
+
+    params = ClusterParams(n_hashes=32, n_bands=4, use_pallas="never")
+    daemon = ServeDaemon(args.store_dir, params=params,
+                         slo=SloPolicy(max_backlog_batches=args.backlog),
+                         state_commit_every=args.state_every).start()
+    server = ServeServer(daemon, port=0)
+    try:
+        server.serve_until_shutdown(port_file=args.port_file)
+    finally:
+        server.server_close()
+        daemon.stop()
+    print("SERVE_OK", flush=True)
+    return 0 if daemon._ingest_error is None else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -212,6 +236,13 @@ def main(argv=None) -> int:
     p = sub.add_parser("compact")
     p.add_argument("--store-dir", required=True)
     p.set_defaults(fn=run_compact)
+
+    p = sub.add_parser("serve")
+    p.add_argument("--store-dir", required=True)
+    p.add_argument("--port-file", required=True)
+    p.add_argument("--state-every", type=int, default=2)
+    p.add_argument("--backlog", type=int, default=64)
+    p.set_defaults(fn=run_serve)
 
     args = ap.parse_args(argv)
     return args.fn(args)

@@ -96,6 +96,11 @@ PRODUCTION_SEATS = {
         "kinds": ("stall",),
         "covered_by": "tests/test_watchdog_degradation.py (compute-stall "
                       "cancel+retry)"},
+    "serve.ingest.commit": {
+        "kinds": ("kill", "raise"),
+        "covered_by": "this matrix (seat `serve-kill`) + "
+                      "tests/test_serve_chaos.py (SIGKILL mid-ingest: "
+                      "zero lost acknowledged rows)"},
     "backend.device.call": {
         "kinds": ("raise", "stall"),
         "covered_by": "tests/test_backend_auto.py (host-oracle re-run + "
@@ -356,11 +361,33 @@ def seat_leader_loss_promote(store: str) -> dict:
                 "store_scrub_corrupt": 0, "store_scrub_quarantined": 0}
 
 
+def seat_serve_kill(store: str) -> dict:
+    """Serving plane: SIGKILL the ingest daemon mid-batch at the
+    ``serve.ingest.commit`` production seat (before the store append
+    commits), then assert the durability contract — the restarted
+    daemon serves every ACKNOWLEDGED row (zero lost), the killed batch
+    recomputes on re-ingest, and post-quiesce membership answers equal
+    a cold batch run elementwise (tests/serve_harness.py)."""
+    plan_rule("serve.ingest.commit", kind="kill")  # inventory-checked
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    from serve_harness import serve_kill_round
+
+    with tempfile.TemporaryDirectory() as tmp:
+        r = serve_kill_round(tmp)
+    assert r["lost_acked"] == 0, r
+    return {"ari_vs_planted": 1.0, "degradation_events": 0,
+            "degradation_counts": {"serve_kill_acked":
+                                   r["acked_before_kill"]},
+            "chunk_halvings": 0, "store_scrub_corrupt": 0,
+            "store_scrub_quarantined": 0}
+
+
 SEATS = {"stall": seat_stall, "oom": seat_oom, "kill": seat_kill,
          "corrupt-shard": seat_corrupt_shard, "hostloss": seat_hostloss,
          "heartbeat-timeout": seat_heartbeat_timeout,
          "zombie": seat_zombie,
-         "leader-loss-promote": seat_leader_loss_promote}
+         "leader-loss-promote": seat_leader_loss_promote,
+         "serve-kill": seat_serve_kill}
 
 
 def main() -> int:

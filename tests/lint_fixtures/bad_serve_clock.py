@@ -1,0 +1,13 @@
+"""watchdog-clock fixture (BAD, serve plane): raw clocks in SLO /
+admission code fork the time base the query p99 is measured on, and a
+raw clock in ANY file under tse1m_tpu/serve/ is in scope."""
+import time
+
+
+def admission_window_open(depth):
+    # BAD: admission decisions must share the watchdog's monotonic base
+    return time.monotonic() if depth else 0.0
+
+
+def query_slo_wall():
+    return time.perf_counter()  # BAD: slo-marked name, raw clock

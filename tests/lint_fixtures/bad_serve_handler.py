@@ -1,0 +1,11 @@
+"""broad-except fixture (BAD, serve request handler): a handler that
+turns EVERY failure into an error response also swallows injected
+faults — a chaos run then sees a cosmetic error string instead of the
+real failure mode."""
+
+
+def handle_request(daemon, msg):
+    try:
+        return {"ok": True, "labels": daemon.query(msg["vectors"])}
+    except Exception as e:  # BAD: InjectedFault becomes a JSON string
+        return {"ok": False, "error": str(e)}

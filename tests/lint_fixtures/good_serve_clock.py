@@ -1,0 +1,17 @@
+"""watchdog-clock fixture (GOOD, serve plane): every SLO/admission
+timestamp reads through the plane's one monotonic clock."""
+from tse1m_tpu.resilience.watchdog import deadline_clock
+
+
+def admission_window_open(depth):
+    return deadline_clock() if depth else 0.0
+
+
+def query_slo_wall():
+    return deadline_clock()
+
+
+def format_request(payload):
+    # names without deadline/slo/admission markers are out of scope in
+    # ordinary files (the whole-file rule only binds inside the plane)
+    return dict(payload)

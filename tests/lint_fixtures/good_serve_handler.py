@@ -1,0 +1,13 @@
+"""broad-except fixture (GOOD, serve request handler): errors become
+structured responses, but injected faults re-raise through the handler
+(fault transparency — the serve plane's request handlers follow the
+same discipline as every other production seat)."""
+from tse1m_tpu.resilience import reraise_if_fault
+
+
+def handle_request(daemon, msg):
+    try:
+        return {"ok": True, "labels": daemon.query(msg["vectors"])}
+    except Exception as e:
+        reraise_if_fault(e)
+        return {"ok": False, "error": f"{type(e).__name__}: {e}"}

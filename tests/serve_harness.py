@@ -1,0 +1,135 @@
+"""Shared harness for the serving-plane chaos tests and the CI
+fault-matrix ``serve-kill`` seat: spawn the daemon subprocess
+(chaos_drivers ``serve``), wait for its port file, and run the
+SIGKILL-mid-ingest round asserting the durability contract — every
+ACKNOWLEDGED batch survives the kill; the in-flight unacked batch
+recomputes on re-ingest; post-quiesce labels equal a cold batch run
+elementwise."""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:  # the fault-matrix driver runs file-direct
+    sys.path.insert(0, REPO)
+
+# The driver's hash policy (chaos_drivers.run_serve) — the parent's cold
+# oracle must match it for elementwise parity.
+SERVE_PARAMS = dict(n_hashes=32, n_bands=4, use_pallas="never")
+
+
+def spawn_serve(store_dir: str, port_file: str,
+                plan_path: str | None = None,
+                state_every: int = 2,
+                timeout_s: float = 180.0) -> tuple:
+    """Start the daemon subprocess; returns (proc, port) once the port
+    file lands (the daemon is accepting)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("TSE1M_FAULT_PLAN", None)
+    if plan_path:
+        env["TSE1M_FAULT_PLAN"] = plan_path
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tests", "chaos_drivers.py"),
+         "serve", "--store-dir", store_dir, "--port-file", port_file,
+         "--state-every", str(state_every)],
+        env=env, cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if os.path.exists(port_file):
+            with open(port_file, encoding="utf-8") as f:
+                txt = f.read().strip()
+            if txt:
+                return proc, int(txt)
+        if proc.poll() is not None:
+            out, err = proc.communicate(timeout=10)
+            raise AssertionError(
+                f"serve driver died before binding (rc={proc.returncode})"
+                f"\n{err[-3000:]}")
+        time.sleep(0.05)
+    proc.kill()
+    raise AssertionError("serve driver never wrote its port file")
+
+
+def serve_kill_round(tmp: str, n: int = 900, batch: int = 100,
+                     kill_batch: int = 3, seed: int = 13) -> dict:
+    """The SIGKILL-mid-ingest game-day, shared by pytest and the CI
+    fault matrix.  Returns summary counters for the matrix report."""
+    from tse1m_tpu.cluster import ClusterParams, cluster_sessions
+    from tse1m_tpu.data.synth import synth_session_sets
+    from tse1m_tpu.serve import ServeClient
+
+    items, _ = synth_session_sets(n, set_size=64, seed=seed)
+    cold = cluster_sessions(items, ClusterParams(**SERVE_PARAMS))
+    store = os.path.join(tmp, "serve_store")
+    port_file = os.path.join(tmp, "port")
+    plan_path = os.path.join(tmp, "plan.json")
+    with open(plan_path, "w", encoding="utf-8") as f:
+        json.dump({"rules": [{"site": "serve.ingest.commit",
+                              "kind": "kill",
+                              "after_calls": kill_batch}]}, f)
+    # state_every=1: the state commit trails each acked batch, so the
+    # deterministic kill (before the NEXT batch's append) leaves state
+    # covering exactly the acked session sequence — recovery reproduces
+    # the row space and the final parity check can be ELEMENTWISE.  The
+    # state-lagging recovery shape (absorb acked rows from the store)
+    # is covered in-process by tests/test_serve.py.
+    proc, port = spawn_serve(store, port_file, plan_path=plan_path,
+                             state_every=1)
+    acked_rows = 0
+    killed_at = None
+    try:
+        with ServeClient(port=port) as c:
+            for i, lo in enumerate(range(0, n, batch)):
+                try:
+                    r = c.ingest(items[lo:lo + batch], timeout_s=120)
+                    assert r["ok"], r
+                    acked_rows = lo + batch
+                except Exception:  # noqa: BLE001 — the kill severs the socket; any transport error is the signal
+                    killed_at = i
+                    break
+    finally:
+        rc = proc.wait(timeout=120)
+    assert killed_at == kill_batch, \
+        f"kill fired at batch {killed_at}, planned {kill_batch} (rc={rc})"
+    assert rc == -signal.SIGKILL, f"driver rc={rc}, wanted SIGKILL"
+    assert acked_rows == kill_batch * batch
+    # Restart on the same store, NO fault plan: every acknowledged row
+    # must still be served (known=True) — zero lost acked rows.
+    os.remove(port_file)
+    proc2, port2 = spawn_serve(store, port_file)
+    try:
+        with ServeClient(port=port2) as c:
+            resp = c.query(items[:acked_rows])
+            lost = int((~resp["known"]).sum())
+            assert lost == 0, f"{lost} acknowledged rows lost to SIGKILL"
+            # Re-ingest from the first unacknowledged batch on (the
+            # killed batch recomputes; acked rows dedupe in the store)
+            # and assert full elementwise parity with the cold run.
+            for lo in range(acked_rows, n, batch):
+                c.ingest(items[lo:lo + batch], timeout_s=120)
+            c.quiesce(timeout_s=120)
+            final = c.query(items)
+            assert bool(final["known"].all())
+            assert np.array_equal(final["labels"], cold), \
+                "post-recovery serving labels diverged from cold batch"
+            status = c.status()
+            c.shutdown()
+    finally:
+        rc2 = proc2.wait(timeout=120)
+    assert rc2 == 0, rc2
+    return {"acked_before_kill": acked_rows, "lost_acked": 0,
+            "rows": int(status["rows"]),
+            "generation": int(status["generation"])}
+
+
+__all__ = ["SERVE_PARAMS", "serve_kill_round", "spawn_serve"]

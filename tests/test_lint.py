@@ -48,6 +48,16 @@ def _rule_findings(rule: str, filename: str, relpath: str | None = None):
      "tse1m_tpu/cluster/pipeline.py"),
     ("watchdog-clock", "bad_lease_write.py", "good_lease_write.py",
      "tse1m_tpu/cluster/store.py"),
+    # Serve plane (PR 10): slo/admission name markers bind anywhere...
+    ("watchdog-clock", "bad_serve_clock.py", "good_serve_clock.py",
+     "tse1m_tpu/cluster/fixture.py"),
+    # ...and the whole tse1m_tpu/serve/ tree is in-plane wholesale.
+    ("watchdog-clock", "bad_serve_clock.py", "good_serve_clock.py",
+     "tse1m_tpu/serve/fixture.py"),
+    # Request handlers stay fault-transparent: error responses are fine,
+    # swallowing an InjectedFault into a JSON string is not.
+    ("broad-except", "bad_serve_handler.py", "good_serve_handler.py",
+     None),
 ])
 def test_rule_bad_fires_good_silent(rule, bad, good, spoof):
     assert _rule_findings(rule, bad, spoof), f"{rule} missed {bad}"

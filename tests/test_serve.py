@@ -1,0 +1,436 @@
+"""Online serving plane (tse1m_tpu/serve): live index parity, the
+single-writer ingest daemon, lock-free query snapshots, store
+reader/writer concurrency (generation counter + refresh), the TCP
+transport, and the SLO/admission layer.
+
+The load-bearing claims:
+
+- post-quiesce membership answers are ELEMENTWISE equal to a cold batch
+  run over the same session sequence (the daemon and the batch warm
+  path share one LiveClusterIndex implementation);
+- queries during ingest are consistent: an acknowledged row is always
+  known, and its answer agrees with the final labels' partition;
+- a reader handle opened before an append either keeps a consistent
+  older generation or adopts the newer one with one cheap `refresh()`;
+- the query hot path is host-only (sanitizer: zero implicit transfers,
+  zero compiles).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from tse1m_tpu.cluster import ClusterParams, cluster_sessions, host_cluster
+from tse1m_tpu.cluster.host import host_band_keys, host_signatures
+from tse1m_tpu.cluster.incremental import LiveClusterIndex
+from tse1m_tpu.cluster.minhash import make_hash_params
+from tse1m_tpu.cluster.store import SignatureStore, row_digests
+from tse1m_tpu.data.synth import synth_session_sets
+from tse1m_tpu.serve import (IngestRejected, ServeClient, ServeDaemon,
+                             ServeServer, SloPolicy)
+
+PARAMS = ClusterParams(n_hashes=32, n_bands=4, use_pallas="never")
+POLICY = {"n_hashes": 32, "seed": 0, "quant_bits": 0}
+
+
+def _items(n=600, seed=3, set_size=64):
+    return synth_session_sets(n, set_size=set_size, seed=seed)[0]
+
+
+def _unique_items(n, seed=3):
+    """Content-distinct rows (no planted duplicates) — for store tests
+    that fabricate one signature per row; the content-addressed store
+    would collapse duplicate rows onto the first one's signature."""
+    return synth_session_sets(n, set_size=64, seed=seed,
+                              dup_fraction=0.0)[0]
+
+
+def _start_daemon(tmp_path, name="store", **kw):
+    return ServeDaemon(str(tmp_path / name), params=PARAMS, **kw).start()
+
+
+# -- LiveClusterIndex ---------------------------------------------------------
+
+def test_live_index_absorb_matches_batch_labels():
+    items = _items(500)
+    a, b = make_hash_params(PARAMS.n_hashes, PARAMS.seed)
+    sigs = host_signatures(items, a, b)
+    keys = host_band_keys(sigs, PARAMS.n_bands)
+    idx = LiveClusterIndex.empty(PARAMS.n_bands)
+    for lo in range(0, 500, 100):
+        blk = slice(lo, lo + 100)
+        idx = idx.absorb(
+            keys[blk], sigs[blk], lambda u: sigs[u],
+            PARAMS.n_hashes, PARAMS.threshold,
+            new_digests=row_digests(items[blk]))
+        assert idx.generation == lo // 100 + 1
+    cold = host_cluster(items, n_hashes=PARAMS.n_hashes,
+                        n_bands=PARAMS.n_bands, seed=PARAMS.seed)
+    assert np.array_equal(idx.labels, cold)
+    # digest membership: every ingested row resolves to itself-or-first
+    hit, row = idx.lookup_digests(row_digests(items))
+    assert hit.all()
+    assert np.array_equal(idx.labels[row], idx.labels)
+
+
+def test_live_index_snapshots_are_immutable_under_absorb():
+    items = _items(200)
+    a, b = make_hash_params(PARAMS.n_hashes, PARAMS.seed)
+    sigs = host_signatures(items, a, b)
+    keys = host_band_keys(sigs, PARAMS.n_bands)
+    idx0 = LiveClusterIndex.empty(PARAMS.n_bands)
+    idx1 = idx0.absorb(keys[:100], sigs[:100], lambda u: sigs[u],
+                       PARAMS.n_hashes, PARAMS.threshold,
+                       new_digests=row_digests(items[:100]))
+    labels1 = idx1.labels.copy()
+    tables1 = [k.copy() for k in idx1.band_keys_sorted]
+    idx2 = idx1.absorb(keys[100:], sigs[100:], lambda u: sigs[u],
+                       PARAMS.n_hashes, PARAMS.threshold,
+                       new_digests=row_digests(items[100:]))
+    assert idx2.generation == idx1.generation + 1
+    assert np.array_equal(idx1.labels, labels1)
+    for k, want in zip(idx1.band_keys_sorted, tables1):
+        assert np.array_equal(k, want)
+
+
+def test_live_index_query_semantics():
+    items = _items(300)
+    a, b = make_hash_params(PARAMS.n_hashes, PARAMS.seed)
+    sigs = host_signatures(items, a, b)
+    keys = host_band_keys(sigs, PARAMS.n_bands)
+    idx = LiveClusterIndex.empty(PARAMS.n_bands).absorb(
+        keys, sigs, lambda u: sigs[u], PARAMS.n_hashes, PARAMS.threshold,
+        new_digests=row_digests(items))
+    # a copy of row 7 with one element flipped lands in row 7's cluster
+    mut = items[7:8].copy()
+    mut[0, 0] ^= 1
+    qs = host_signatures(mut, a, b)
+    qk = host_band_keys(qs, PARAMS.n_bands)
+    got = idx.query_labels(qs, qk, lambda u: sigs[u],
+                           PARAMS.n_hashes, PARAMS.threshold)
+    assert got[0] == idx.labels[7]
+    # a genuinely novel vector reads as a new singleton (-1)
+    nov = synth_session_sets(1, set_size=64, seed=991)[0]
+    ns = host_signatures(nov, a, b)
+    nk = host_band_keys(ns, PARAMS.n_bands)
+    assert idx.query_labels(ns, nk, lambda u: sigs[u],
+                            PARAMS.n_hashes, PARAMS.threshold)[0] == -1
+
+
+# -- store generation counter / reader refresh (satellite) --------------------
+
+def test_store_generation_counts_layout_changes_only(tmp_path):
+    store = SignatureStore(str(tmp_path / "s"), POLICY)
+    assert store.generation == 0
+    items = _unique_items(64)
+    d = row_digests(items)
+    sigs = np.ones((64, 32), np.uint32)
+    store.append(d, sigs)
+    assert store.generation == 1
+    # probing (LRU stamps) rewrites nothing layout-shaped
+    store.bulk_probe(d)
+    gen = store.generation
+    store.append(d, sigs)  # all-duplicate append: no new shard
+    assert store.generation == gen
+
+
+def test_probe_during_append_reader_consistency(tmp_path):
+    """The satellite regression: a reader opened BEFORE an append keeps
+    answering from its (consistent) older generation, and one cheap
+    refresh() adopts the newer one."""
+    path = str(tmp_path / "s")
+    writer = SignatureStore(path, POLICY)
+    items = _unique_items(256)
+    d = row_digests(items)
+    sigs = np.arange(256 * 32, dtype=np.uint32).reshape(256, 32)
+    writer.append(d[:128], sigs[:128])
+    reader = SignatureStore(path, POLICY, read_only=True)
+    hit0, sh0, rw0 = reader.bulk_probe(d)
+    assert hit0[:128].all() and not hit0[128:].any()
+    # concurrent append by the single writer
+    writer.append(d[128:], sigs[128:])
+    # un-refreshed reader: same consistent older view, gathers still work
+    hit1, sh1, rw1 = reader.bulk_probe(d)
+    assert np.array_equal(hit0, hit1)
+    assert np.array_equal(reader.load_signatures(sh1[:128], rw1[:128]),
+                          sigs[:128])
+    # no-op refresh is cheap and idempotent when nothing changed
+    assert reader.refresh() is True   # adopt the append
+    assert reader.refresh() is False  # nothing new now
+    hit2, sh2, rw2 = reader.bulk_probe(d)
+    assert hit2.all()
+    assert np.array_equal(reader.load_signatures(sh2, rw2), sigs)
+    assert reader.generation == writer.generation
+
+
+def test_reader_refresh_survives_compaction(tmp_path):
+    path = str(tmp_path / "s")
+    writer = SignatureStore(path, POLICY)
+    items = _unique_items(300)
+    d = row_digests(items)
+    sigs = np.arange(300 * 32, dtype=np.uint32).reshape(300, 32)
+    for lo in range(0, 300, 100):
+        writer.append(d[lo:lo + 100], sigs[lo:lo + 100])
+    reader = SignatureStore(path, POLICY, read_only=True)
+    writer.compact()
+    assert reader.refresh() is True
+    hit, sh, rw = reader.bulk_probe(d)
+    assert hit.all()
+    assert np.array_equal(reader.load_signatures(sh, rw), sigs)
+
+
+# -- daemon: ingest + query ---------------------------------------------------
+
+def test_daemon_parity_and_restart(tmp_path):
+    items = _items(600)
+    dm = _start_daemon(tmp_path)
+    try:
+        for lo in range(0, 600, 150):
+            r = dm.ingest(items[lo:lo + 150], timeout=300)
+            assert r["ok"] and r["acked"] == 150
+        dm.quiesce(timeout=300)
+        cold = cluster_sessions(items, PARAMS)
+        res = dm.query(items)
+        assert res["known"].all()
+        assert np.array_equal(res["labels"], cold)
+        # batch `cluster` against the SAME store is one more client of
+        # the same index code: warm merge reproduces the daemon's view
+        from dataclasses import replace
+
+        warm = cluster_sessions(
+            items, replace(PARAMS, sig_store=str(tmp_path / "store")))
+        assert np.array_equal(warm, cold)
+    finally:
+        dm.stop()
+    dm2 = ServeDaemon(str(tmp_path / "store"), params=PARAMS)
+    res2 = dm2.query(items)
+    assert res2["known"].all()
+    assert np.array_equal(res2["labels"], cluster_sessions(items, PARAMS))
+
+
+def test_daemon_recovers_acked_rows_without_state(tmp_path):
+    """State commits lag acks; a crash between append and state commit
+    must still serve every acknowledged row after restart (content-level
+    recovery from the store)."""
+    items = _items(400, seed=11)
+    dm = _start_daemon(tmp_path, state_commit_every=10**6)
+    try:
+        for lo in range(0, 400, 100):
+            dm.ingest(items[lo:lo + 100], timeout=300)
+    finally:
+        dm.stop(commit=False)  # crash-shaped: acked, state never written
+    dm2 = ServeDaemon(str(tmp_path / "store"), params=PARAMS)
+    res = dm2.query(items)
+    assert res["known"].all(), "acknowledged rows lost without state"
+    # recovered labels form the same partition as a cold batch run
+    from tse1m_tpu.cluster import adjusted_rand_index
+
+    cold = cluster_sessions(items, PARAMS)
+    assert adjusted_rand_index(res["labels"], cold) == pytest.approx(1.0)
+
+
+def test_concurrent_ingest_query_consistency(tmp_path):
+    """Queries DURING ingest: acked rows are always known and their
+    answers agree with the final partition; after quiesce the whole
+    sequence equals the cold batch labels elementwise."""
+    items = _items(800, seed=5)
+    dm = _start_daemon(tmp_path)
+    acked = [0]
+    observed: list[tuple[int, int]] = []  # (row, label at query time)
+    errors: list = []
+    done = threading.Event()
+
+    def querier():
+        rng = np.random.default_rng(17)
+        try:
+            while not done.is_set():
+                hi = acked[0]
+                if hi == 0:
+                    continue
+                i = int(rng.integers(0, hi))
+                res = dm.query(items[i:i + 1])
+                if not res["known"][0]:
+                    raise AssertionError(f"acked row {i} unknown")
+                observed.append((i, int(res["labels"][0])))
+        except Exception as e:  # noqa: BLE001 — relayed to the main thread below
+            errors.append(e)
+
+    threads = [threading.Thread(target=querier) for _ in range(2)]
+    try:
+        for t in threads:
+            t.start()
+        for lo in range(0, 800, 80):
+            dm.ingest(items[lo:lo + 80], timeout=300)
+            acked[0] = lo + 80
+        dm.quiesce(timeout=300)
+    finally:
+        done.set()
+        for t in threads:
+            t.join(timeout=60)
+        dm.stop()
+    assert not errors, errors[0]
+    assert observed, "queriers never ran"
+    cold = cluster_sessions(items, PARAMS)
+    final = dm.query(items)
+    assert np.array_equal(final["labels"], cold)
+    # a label observed mid-ingest is the min-index of the row's cluster
+    # at that generation; merging can only LOWER it, and the final
+    # cluster must contain it (labels are row indices)
+    # A label observed mid-ingest is the min-index of the row's cluster
+    # at that generation; later merges can only LOWER a row's label
+    # (union-by-min), and the observed hub row must share the final
+    # cluster with the queried row.
+    for i, lab in observed:
+        assert int(final["labels"][i]) <= lab
+        assert final["labels"][lab] == final["labels"][i]
+
+
+def test_query_hot_path_sanitizer_clean(tmp_path):
+    from tse1m_tpu.lint.runtime import sanitized
+
+    items = _items(300, seed=9)
+    dm = _start_daemon(tmp_path)
+    try:
+        dm.ingest(items, timeout=300)
+        dm.query(items[:1])  # warm numpy internals
+        nov = synth_session_sets(8, set_size=64, seed=997)[0]
+        with sanitized(0):
+            res = dm.query(items[:64])
+            resn = dm.query(nov)  # novel path: host minhash + verify
+        assert res["known"].all() and not resn["known"].any()
+    finally:
+        dm.stop()
+
+
+# -- SLO / admission ----------------------------------------------------------
+
+def test_backpressure_and_backlog_accounting(tmp_path):
+    items = _items(60, seed=21)
+    dm = ServeDaemon(str(tmp_path / "store"), params=PARAMS,
+                     slo=SloPolicy(max_backlog_batches=2))
+    # ingest thread NOT started: the queue can only fill
+    dm.submit(items[:20])
+    dm.submit(items[20:40])
+    with pytest.raises(IngestRejected) as exc:
+        dm.submit(items[40:])
+    assert exc.value.retry_after_s > 0
+    stats = dm.admission.stats()
+    assert stats["ingest_rejected"] == 1
+    assert stats["ingest_backlog_max"] >= 2
+    from tse1m_tpu.observability import peek_degradation_events
+
+    kinds = [e["kind"] for e in peek_degradation_events()]
+    assert "serve_backpressure" in kinds
+    # draining the queue re-admits
+    dm.start()
+    try:
+        dm.quiesce(timeout=300)
+        r = dm.ingest(items[40:], timeout=300)
+        assert r["ok"]
+    finally:
+        dm.stop()
+
+
+def test_slo_violation_counter(tmp_path):
+    dm = ServeDaemon(str(tmp_path / "store"), params=PARAMS,
+                     slo=SloPolicy(query_p99_target_ms=0.0))
+    try:
+        dm.tracker.observe_query(0.5)
+        dm.tracker.observe_query(0.5)
+        st = dm.status()
+        assert st["query_slo_violations"] == 2
+    finally:
+        dm.stop(commit=False)
+
+
+def test_request_budgets_env(monkeypatch):
+    from tse1m_tpu.resilience.watchdog import request_budget_s
+
+    assert request_budget_s("query") == pytest.approx(0.25)
+    monkeypatch.setenv("TSE1M_SERVE_QUERY_BUDGET_S", "1.5")
+    assert request_budget_s("query") == pytest.approx(1.5)
+    monkeypatch.setenv("TSE1M_WATCHDOG", "0")
+    assert request_budget_s("query") == 0.0
+
+
+def test_latency_recorder_percentiles():
+    from tse1m_tpu.observability.latency import LatencyRecorder
+
+    rec = LatencyRecorder("serve_query")
+    for ms in range(1, 101):
+        rec.add(ms / 1e3)
+    snap = rec.snapshot()
+    assert snap["count"] == 100
+    assert 35 <= snap["p50_ms"] <= 70
+    assert 85 <= snap["p99_ms"] <= 115
+    assert snap["max_ms"] >= 95
+    s = rec.summary()
+    assert "serve_query_p99_ms" in s and "serve_query_qps" in s
+    rec.reset_window()
+    assert rec.snapshot()["count"] == 0
+
+
+# -- TCP transport ------------------------------------------------------------
+
+def test_tcp_roundtrip_and_status(tmp_path):
+    items = _items(300, seed=8)
+    dm = _start_daemon(tmp_path)
+    server = ServeServer(dm, port=0)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        with ServeClient(port=server.port) as c:
+            assert c.ping()["ok"]
+            r = c.ingest(items, timeout_s=300)
+            assert r["ok"] and r["acked"] == 300
+            q = c.query(items[:10], timeout_s=60)
+            assert q["known"].all()
+            assert np.array_equal(
+                q["labels"], dm.query(items[:10])["labels"])
+            assert c.quiesce(timeout_s=300)["ok"]
+            st = c.status()
+            for key in ("rows", "generation", "queue_depth",
+                        "ingest_backlog_max", "last_scrub",
+                        "serve_query_p99_ms", "serve_ingest_p99_ms",
+                        "query_slo_violations"):
+                assert key in st, key
+            assert st["rows"] == 300
+            assert st["generation"] >= 1
+            c.shutdown()
+    finally:
+        server.server_close()
+        dm.stop()
+
+
+def test_cli_serve_status_records_manifest(tmp_path, monkeypatch):
+    """`tse1m serve --status` is a client ping recorded through
+    StepRunner into run_manifest.json (the satellite contract)."""
+    import json
+
+    from tse1m_tpu import cli
+
+    items = _items(120, seed=14)
+    dm = _start_daemon(tmp_path)
+    server = ServeServer(dm, port=0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    result_dir = tmp_path / "results"
+    monkeypatch.setenv("TSE1M_RESULT_DIR", str(result_dir))
+    try:
+        dm.ingest(items, timeout=300)
+        rc = cli.main(["serve", "--status", "--port", str(server.port)])
+        assert rc == 0
+        manifest = json.loads(
+            (result_dir / "run_manifest.json").read_text())
+        steps = {s["name"]: s for s in manifest["steps"]}
+        assert steps["serve_status"]["status"] == "ok"
+        res = steps["serve_status"]["result"]
+        assert res["rows"] == 120
+        assert "generation" in res and "queue_depth" in res
+        assert "last_scrub" in res
+    finally:
+        server.shutdown()
+        server.server_close()
+        dm.stop()

@@ -718,6 +718,106 @@ def _cmd_scrub(args) -> int:
     return 0
 
 
+def _serve_client(args):
+    """Resolve the target daemon (port flag or port file) -> ServeClient."""
+    from .serve import ServeClient
+
+    port = args.port
+    if not port and args.port_file and os.path.exists(args.port_file):
+        with open(args.port_file, encoding="utf-8") as f:
+            port = int(f.read().strip())
+    if not port:
+        raise SystemExit("no daemon address: pass --port or --port-file")
+    return ServeClient(host=args.host, port=port)
+
+
+def _cmd_serve(args) -> int:
+    """Online near-duplicate serving daemon (`tse1m serve`).
+
+    Runs the long-lived ingest daemon + query API (tse1m_tpu/serve) over
+    the persistent signature store: clients stream coverage vectors in
+    (`serve-client ingest`, durably acknowledged) and ask "which cluster
+    does this vector belong to?" (`serve-client query`) at interactive
+    latency while ingest continues.  The batch `cluster` command and
+    this daemon share one index implementation
+    (cluster/incremental.LiveClusterIndex), so serving answers are
+    CI-asserted elementwise-consistent with a cold batch run.
+
+    ``--status`` turns this invocation into a CLIENT ping instead: the
+    daemon's index generation, row count, queue depth, SLO counters and
+    last scrub result are printed AND recorded as a ``serve_status``
+    step in ``<result_dir>/run_manifest.json`` via StepRunner — the same
+    ledger every other operational command writes."""
+    import json
+    import signal
+
+    from .resilience import StepRunner
+
+    cfg = load_config()
+    if args.status:
+        manifest_path = os.path.join(cfg.result_dir, "run_manifest.json")
+        runner = StepRunner(manifest_path)
+
+        def status_step() -> dict:
+            with _serve_client(args) as client:
+                return client.status()
+
+        rec = runner.run("serve_status", status_step)
+        if rec.result is not None:
+            print(json.dumps(rec.result))
+        return 0 if rec.status == "ok" else 1
+
+    store = args.sig_store or cfg.sig_store
+    if not store:
+        log.error("no signature store: pass --sig-store, or set "
+                  "TSE1M_SIG_STORE / the INI's sig_store")
+        return 2
+    from .cluster import ClusterParams
+    from .serve import ServeDaemon, ServeServer, SloPolicy
+
+    params = ClusterParams(seed=args.seed, use_pallas=args.use_pallas)
+    daemon = ServeDaemon(store, params=params, slo=SloPolicy.from_env(),
+                         state_commit_every=args.state_every).start()
+    server = ServeServer(daemon, host=args.host, port=args.port)
+
+    def _graceful(signum, frame):  # noqa: ARG001
+        log.warning("serve: signal %d; shutting down", signum)
+        server.shutdown()
+
+    signal.signal(signal.SIGTERM, _graceful)
+    signal.signal(signal.SIGINT, _graceful)
+    try:
+        server.serve_until_shutdown(port_file=args.port_file)
+    finally:
+        server.server_close()
+        daemon.stop()
+    return 0 if daemon._ingest_error is None else 1
+
+
+def _cmd_serve_client(args) -> int:
+    """One serve-plane client request (`tse1m serve-client <op>`).
+
+    ``query``/``ingest`` read a ``[K, S] uint32`` .npy via ``--npy``;
+    every op prints the daemon's JSON response."""
+    import json
+
+    import numpy as np
+
+    with _serve_client(args) as client:
+        if args.op in ("query", "ingest"):
+            if not args.npy:
+                raise SystemExit(f"{args.op} needs --npy <vectors.npy>")
+            vectors = np.load(args.npy)
+            resp = (client.query(vectors) if args.op == "query"
+                    else client.ingest(vectors))
+            resp = {k: (v.tolist() if isinstance(v, np.ndarray) else v)
+                    for k, v in resp.items()}
+        else:
+            resp = getattr(client, args.op)()
+    print(json.dumps(resp))
+    return 0 if resp.get("ok", False) else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="tse1m")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -811,6 +911,46 @@ def main(argv=None) -> int:
     p.add_argument("--verify-sample", type=int, default=256,
                    help="max sampled rows recomputed on host")
     p.set_defaults(fn=_cmd_scrub)
+
+    p = sub.add_parser("serve",
+                       help="online near-duplicate serving daemon over a "
+                            "signature store (README 'Online serving'); "
+                            "--status pings a running daemon instead")
+    p.add_argument("--sig-store", default=None,
+                   help="signature store directory the daemon serves "
+                        "(also TSE1M_SIG_STORE / the INI's sig_store)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0,
+                   help="TCP port (0 = pick a free one; see --port-file)")
+    p.add_argument("--port-file", default=None,
+                   help="write the bound port here (atomic) so clients "
+                        "and --status can find a 0-port daemon")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--use-pallas", default="auto",
+                   choices=("auto", "never", "force", "interpret"))
+    p.add_argument("--state-every", type=int, default=8,
+                   help="commit the LSH state to the store every N ingest "
+                        "generations (acks are durable regardless; this "
+                        "bounds recovery work after a crash)")
+    p.add_argument("--status", action="store_true",
+                   help="client mode: print a running daemon's status "
+                        "(index generation, rows, queue depth, SLO "
+                        "counters, last scrub) and record it as a "
+                        "serve_status step in run_manifest.json")
+    p.set_defaults(fn=_cmd_serve)
+
+    p = sub.add_parser("serve-client",
+                       help="one client request against a running serve "
+                            "daemon")
+    p.add_argument("op", choices=("ping", "status", "query", "ingest",
+                                  "quiesce", "shutdown"))
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--port-file", default=None)
+    p.add_argument("--npy", default=None,
+                   help="[K, S] uint32 .npy of coverage vectors "
+                        "(query/ingest)")
+    p.set_defaults(fn=_cmd_serve_client)
 
     p = sub.add_parser("cluster", help="MinHash+LSH session dedup demo")
     p.add_argument("--n", type=int, default=100_000)

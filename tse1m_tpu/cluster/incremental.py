@@ -27,7 +27,7 @@ device transfer.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 import numpy as np
 
@@ -97,12 +97,15 @@ def extend_band_tables(band_keys_sorted: list, band_reps: list,
         first = np.empty(order.size, bool)
         first[0] = True
         np.not_equal(ks2[1:], ks2[:-1], out=first[1:])
-        merged_k = np.concatenate([ks, ks2[first]])
-        merged_r = np.concatenate(
-            [reps, (order[first] + base_index).astype(np.int32)])
-        resort = np.argsort(merged_k, kind="stable")
-        ks_out.append(merged_k[resort])
-        rep_out.append(merged_r[resort])
+        add_k = ks2[first]
+        add_r = (order[first] + base_index).astype(np.int32)
+        # Sorted-insert merge (both sides sorted, no ties — novel keys
+        # are by construction absent from ks): O(Kb) memcpy instead of a
+        # full re-sort, which matters when this runs once per serving
+        # ingest batch rather than once per warm run.
+        ins = np.searchsorted(ks, add_k)
+        ks_out.append(np.insert(ks, ins, add_k))
+        rep_out.append(np.insert(reps, ins, add_r))
     return ks_out, rep_out
 
 
@@ -222,5 +225,204 @@ def merge_labels(old_labels: np.ndarray, u: np.ndarray, v: np.ndarray,
     return np.concatenate([out_old, new_lab]).astype(np.int32)
 
 
-__all__ = ["LshState", "build_band_tables", "candidate_edges",
-           "extend_band_tables", "merge_labels", "verify_edges"]
+# ---------------------------------------------------------------------------
+# Live index: the serving-plane view of the same extend-never-rebuild
+# machinery.  A LiveClusterIndex is an IMMUTABLE snapshot of one ingest
+# generation — labels, band tables, store locator, and (optionally) a
+# sorted digest -> row map for membership lookups.  `absorb` returns a
+# NEW snapshot sharing every unchanged array with its parent (the band
+# tables are copy-on-extend already), so a serving daemon can swap the
+# snapshot reference atomically per ingest batch and concurrent queries
+# never observe a half-updated table.  The batch warm path
+# (cluster/pipeline._store_warm_merge) is a client of this same object:
+# one merge implementation, proven once, serving both shapes.
+
+
+@dataclass(frozen=True)
+class LiveClusterIndex:
+    """One ingest generation of the online cluster-membership index."""
+
+    generation: int
+    n_rows: int
+    labels: np.ndarray              # [n_rows] int32 min-orig-index labels
+    locator: np.ndarray             # [n_rows, 2] int32 (shard, row) in store
+    band_keys_sorted: list          # per band: [Kb] uint32 distinct keys
+    band_reps: list                 # per band: [Kb] int32 min index per key
+    # Sorted 128-bit digest map (membership lookups).  Optional: the
+    # batch warm path never queries by digest and skips building it.
+    digest_keys: np.ndarray | None = field(default=None, repr=False)
+    digest_rows: np.ndarray | None = field(default=None, repr=False)
+
+    # -- constructors --------------------------------------------------------
+
+    @classmethod
+    def empty(cls, n_bands: int) -> "LiveClusterIndex":
+        e32 = np.empty(0, np.uint32)
+        return cls(generation=0, n_rows=0,
+                   labels=np.empty(0, np.int32),
+                   locator=np.empty((0, 2), np.int32),
+                   band_keys_sorted=[e32.copy() for _ in range(n_bands)],
+                   band_reps=[np.empty(0, np.int32) for _ in range(n_bands)],
+                   digest_keys=_empty_digest_struct(),
+                   digest_rows=np.empty(0, np.int32))
+
+    @classmethod
+    def from_state(cls, state: LshState,
+                   digests: np.ndarray | None = None) -> "LiveClusterIndex":
+        """Adopt a persisted LSH state (store.SignatureStore.load_state)
+        as generation 0.  ``digests`` ([n_rows, 2] uint64, row order)
+        enables the digest-membership map; None skips it (batch path)."""
+        dk = dr = None
+        if digests is not None:
+            dk, dr = _sorted_digest_map(digests)
+        return cls(generation=0, n_rows=state.n_rows,
+                   labels=state.labels.astype(np.int32, copy=True),
+                   locator=state.locator, digest_keys=dk, digest_rows=dr,
+                   band_keys_sorted=list(state.band_keys_sorted),
+                   band_reps=list(state.band_reps))
+
+    # -- ingest --------------------------------------------------------------
+
+    def absorb(self, new_keys: np.ndarray, new_sigs: np.ndarray,
+               gather_old_sigs, n_hashes: int, threshold: float,
+               new_locator: np.ndarray | None = None,
+               new_digests: np.ndarray | None = None
+               ) -> "LiveClusterIndex":
+        """Absorb an appended tail of rows into a NEW snapshot.
+
+        Exactly the batch warm merge: candidate edges from the stored
+        band tables, verified with the device's signature-agreement
+        rule, merged with union-by-min — labels elementwise-equal to a
+        cold batch run over the union (see module docstring).  The
+        parent snapshot is untouched; unchanged band arrays are shared.
+        """
+        n_old = self.n_rows
+        k = int(new_keys.shape[0])
+        if k == 0:
+            return self
+        u, v = candidate_edges(self.band_keys_sorted, self.band_reps,
+                               new_keys, n_old)
+        ok = verify_edges(u, v, new_sigs, n_old, gather_old_sigs,
+                          n_hashes, threshold)
+        labels = merge_labels(self.labels, u[ok], v[ok], n_old, k)
+        bk, br = extend_band_tables(self.band_keys_sorted, self.band_reps,
+                                    new_keys, n_old)
+        locator = self.locator
+        if new_locator is not None:
+            locator = np.concatenate(
+                [locator, np.ascontiguousarray(new_locator, np.int32)])
+        dk, dr = self.digest_keys, self.digest_rows
+        if dk is not None and new_digests is not None:
+            dk, dr = _merge_digest_map(dk, dr, new_digests, n_old)
+        return LiveClusterIndex(
+            generation=self.generation + 1, n_rows=n_old + k,
+            labels=labels, locator=locator, band_keys_sorted=bk,
+            band_reps=br, digest_keys=dk, digest_rows=dr)
+
+    # -- queries (read-only; safe from any thread on one snapshot) ----------
+
+    def lookup_digests(self, digests: np.ndarray
+                       ) -> tuple[np.ndarray, np.ndarray]:
+        """[N, 2] uint64 digests -> (hit [N] bool, row [N] int32; -1 on
+        miss).  Requires the digest map (built with ``new_digests``)."""
+        if self.digest_keys is None:
+            raise RuntimeError("this LiveClusterIndex was built without a "
+                               "digest map (batch merge shape); membership "
+                               "lookups need from_state(digests=...)")
+        n = digests.shape[0]
+        row = np.full(n, -1, np.int32)
+        if n == 0 or self.digest_keys.shape[0] == 0:
+            return np.zeros(n, bool), row
+        q = _digest_struct(digests)
+        pos = np.searchsorted(self.digest_keys, q)
+        inb = pos < self.digest_keys.shape[0]
+        hit = np.zeros(n, bool)
+        hit[inb] = self.digest_keys[pos[inb]] == q[inb]
+        row[hit] = self.digest_rows[pos[hit]]
+        return hit, row
+
+    def candidate_hubs(self, keys: np.ndarray
+                       ) -> tuple[np.ndarray, np.ndarray]:
+        """Per-band bucket hubs for query vectors that are NOT index rows:
+        [K, B] band keys -> (q [E], hub_row [E]) pairs — the rows a cold
+        run would test these vectors' signatures against."""
+        k, n_bands = keys.shape
+        qs, hubs = [], []
+        for b in range(n_bands):
+            ks, reps = self.band_keys_sorted[b], self.band_reps[b]
+            kb = keys[:, b]
+            pos = np.searchsorted(ks, kb)
+            inb = pos < ks.shape[0]
+            hit = np.zeros(k, bool)
+            hit[inb] = ks[pos[inb]] == kb[inb]
+            if hit.any():
+                qs.append(np.flatnonzero(hit))
+                hubs.append(reps[pos[hit]].astype(np.int64))
+        if not qs:
+            e = np.empty(0, np.int64)
+            return e, e.copy()
+        return np.concatenate(qs), np.concatenate(hubs)
+
+    def query_labels(self, sigs: np.ndarray, keys: np.ndarray,
+                     gather_sigs, n_hashes: int, threshold: float
+                     ) -> np.ndarray:
+        """Cluster membership for novel vectors (no mutation): each
+        vector's candidate hubs are verified with the exact signature-
+        agreement rule; the answer is the minimum label over verified
+        hubs — the component a cold run would union this vector into —
+        or -1 (a new singleton cluster).  ``gather_sigs`` maps unique
+        index row ids -> their stored [*, H] signatures."""
+        k = int(sigs.shape[0])
+        out = np.full(k, -1, np.int64)
+        q, hub = self.candidate_hubs(keys)
+        if q.size == 0:
+            return out
+        uniq, inv = np.unique(hub, return_inverse=True)
+        hub_sigs = gather_sigs(uniq)
+        if hub_sigs is None:          # store raced (eviction): all miss
+            return out
+        agree = (sigs[q] == hub_sigs[inv]).sum(axis=1)
+        ok = agree.astype(np.float32) / np.float32(n_hashes) \
+            >= np.float32(threshold)
+        if not ok.any():
+            return out
+        hub_lab = self.labels[hub[ok]].astype(np.int64)
+        sentinel = np.int64(2**62)
+        acc = np.full(k, sentinel, np.int64)
+        np.minimum.at(acc, q[ok], hub_lab)
+        return np.where(acc == sentinel, np.int64(-1), acc)
+
+
+def _empty_digest_struct() -> np.ndarray:
+    return np.empty(0, np.dtype([("a", "<u8"), ("b", "<u8")]))
+
+
+def _digest_struct(digests: np.ndarray) -> np.ndarray:
+    from .store import _as_struct
+
+    return _as_struct(digests)
+
+
+def _sorted_digest_map(digests: np.ndarray
+                       ) -> tuple[np.ndarray, np.ndarray]:
+    d = _digest_struct(digests)
+    order = np.argsort(d, kind="stable").astype(np.int32)
+    return d[order].copy(), order
+
+
+def _merge_digest_map(keys: np.ndarray, rows: np.ndarray,
+                      new_digests: np.ndarray, base_index: int
+                      ) -> tuple[np.ndarray, np.ndarray]:
+    nd = _digest_struct(new_digests)
+    norder = np.argsort(nd, kind="stable")
+    nk = nd[norder]
+    nr = (norder + base_index).astype(np.int32)
+    pos = np.searchsorted(nk, keys)
+    merged_k = np.insert(nk, pos, keys)
+    merged_r = np.insert(nr, pos, rows)
+    return merged_k, merged_r
+
+
+__all__ = ["LiveClusterIndex", "LshState", "build_band_tables",
+           "candidate_edges", "extend_band_tables", "merge_labels",
+           "verify_edges"]

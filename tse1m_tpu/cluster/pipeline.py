@@ -1530,6 +1530,41 @@ def _store_policy(params: ClusterParams, qbits: int) -> dict:
             "quant_bits": qbits}
 
 
+def minhash_novel_rows(rows: np.ndarray, params: ClusterParams,
+                       qbits: int, rec: StageRecorder | None = None,
+                       wd: StageWatchdog | None = None,
+                       pad_pow2: bool = True) -> np.ndarray:
+    """Host [K, S] raw rows -> host [K, H] uint32 MinHash signatures via
+    the degraded streaming pipeline — the serve plane's ingest miss path.
+
+    Rows are quantized to the store policy's universe, streamed through
+    `_stream_minhash_degraded` (OOM halving / stall retry / CPU failover
+    — the same ladder every batch path rides), and the signatures
+    fetched back to host.  ``pad_pow2`` pads the row count to the next
+    power of two with copies of row 0 (MinHash is row-independent, the
+    pad is sliced off) so a long-lived daemon ingesting arbitrary batch
+    sizes compiles O(log max-batch) kernel shapes, not one per size."""
+    rec = rec or StageRecorder()
+    k = int(rows.shape[0])
+    if k == 0:
+        return np.empty((0, params.n_hashes), np.uint32)
+    sub = quantize_ids(rows, qbits) if qbits else rows
+    if pad_pow2:
+        padded = 1 << (k - 1).bit_length()
+        if padded > k:
+            sub = np.concatenate(
+                [sub, np.broadcast_to(sub[:1], (padded - k, sub.shape[1]))])
+    a, b = make_hash_params(params.n_hashes, params.seed)
+    a, b = jnp.asarray(a), jnp.asarray(b)
+    parts, _, _ = _stream_minhash_degraded(sub, a, b, params, rec,
+                                           want_decoded=False, wd=wd)
+    sig_d = (parts[0][0] if len(parts) == 1
+             else jnp.concatenate([p[0] for p in parts]))
+    with rec.stage("d2h", nbytes=int(sig_d.size) * 4):
+        sig = np.asarray(sig_d)
+    return np.ascontiguousarray(sig[:k], np.uint32)
+
+
 def _cluster_with_store(items: np.ndarray, params: ClusterParams,
                         merge_only: bool = False):
     """Store-enabled clustering; returns [N] int32 labels.
@@ -1617,8 +1652,6 @@ def _store_warm_merge(items, digests, hit, shard, row, state, store,
         # Band keys for the short tail on host — bit-identical to the
         # device fold (tests/test_cluster.py) and free of a link RTT.
         new_keys = host_band_keys(new_sig, params.n_bands)
-        u, v = inc.candidate_edges(state.band_keys_sorted, state.band_reps,
-                                   new_keys, n_old)
 
         def gather_old(uniq: np.ndarray) -> np.ndarray:
             loc = state.locator[uniq]
@@ -1626,9 +1659,15 @@ def _store_warm_merge(items, digests, hit, shard, row, state, store,
             rec.add("load", 0.0, out.nbytes)
             return out
 
-        ok = inc.verify_edges(u, v, new_sig, n_old, gather_old, h,
-                              params.threshold)
-        labels = inc.merge_labels(state.labels, u[ok], v[ok], n_old, k_new)
+        # The batch warm merge is a CLIENT of the serving plane's live
+        # index (cluster/incremental.LiveClusterIndex): one absorb
+        # implementation — candidate edges from the stored tables,
+        # exact signature verification, union-by-min label merge,
+        # extend-never-rebuild tables — shared with tse1m_tpu/serve.
+        index = inc.LiveClusterIndex.from_state(state)
+        index = index.absorb(new_keys, new_sig, gather_old, h,
+                             params.threshold)
+        labels = index.labels
     # Commit: append the novel signatures, extend (never rebuild) the band
     # tables, advance the state to cover all n rows.
     if miss.any():
@@ -1636,9 +1675,8 @@ def _store_warm_merge(items, digests, hit, shard, row, state, store,
     hit2, sh2, rw2 = store.bulk_probe(digests[n_old:])
     locator = np.concatenate(
         [state.locator, np.stack([sh2, rw2], axis=1)])
-    tables = inc.extend_band_tables(state.band_keys_sorted, state.band_reps,
-                                    new_keys, n_old)
-    store.save_state(labels, locator, tables, digests,
+    store.save_state(labels, locator,
+                     (index.band_keys_sorted, index.band_reps), digests,
                      params.n_bands, params.threshold)
     last_run_info["cache_novel_rows"] = int(miss.sum())
     return labels

@@ -190,6 +190,22 @@ def row_digests(items: np.ndarray) -> np.ndarray:
 _DIG_DT = np.dtype([("a", "<u8"), ("b", "<u8")])
 
 
+class _ProbeIndex:
+    """One immutable generation of the sorted probe index: mode
+    ('ram'|'mmap'), struct-view keys, raw [N, 2] keys, and the (shard,
+    row) locator columns.  Published as a single attribute so concurrent
+    readers snapshot it with one reference read."""
+
+    __slots__ = ("mode", "keys", "keys2d", "shard", "row")
+
+    def __init__(self, mode, keys, keys2d, shard, row):
+        self.mode = mode
+        self.keys = keys
+        self.keys2d = keys2d
+        self.shard = shard
+        self.row = row
+
+
 def _as_struct(digests: np.ndarray) -> np.ndarray:
     """[N, 2] uint64 -> [N] structured view (lexicographically sortable
     and searchsorted-able as one 128-bit key)."""
@@ -250,6 +266,7 @@ class SignatureStore:
                     f"{diff}")
             self.shards = [dict(s) for s in prior.get("shards", [])]
             self._probe_gen = int(prior.get("probe_gen", 0))
+            self.generation = int(prior.get("generation", 0))
             if prior.get("crc_algo", _CRC_ALGO) != _CRC_ALGO:
                 if self.read_only:
                     # Cannot re-frame another host's shards; skip frame
@@ -263,6 +280,9 @@ class SignatureStore:
         else:
             self.shards = []
             self._probe_gen = 0
+            self.generation = 0
+        self._committed_fp = self._index_fingerprint()
+        if prior is None:
             self._write_manifest()
         self._validate_shards()
         if not self.read_only:
@@ -325,9 +345,18 @@ class SignatureStore:
     def _write_manifest(self) -> None:
         if self.read_only:
             return  # readers never publish — the range owner's job
+        # The store GENERATION advances exactly when the committed shard
+        # layout changes (append / evict / compact / quarantine) — never
+        # for LRU probe stamps — so a concurrent reader can answer "did
+        # anything I mmap'd move?" with one integer compare (`refresh`).
+        fp = self._index_fingerprint()
+        if fp != self._committed_fp:
+            self.generation += 1
+            self._committed_fp = fp
         with atomic_write(self._manifest_path) as f:
             json.dump({"policy": self.policy, "crc_algo": _CRC_ALGO,
                        "probe_gen": self._probe_gen,
+                       "generation": self.generation,
                        "shards": self.shards}, f)
 
     def _reframe_all(self) -> None:
@@ -442,8 +471,9 @@ class SignatureStore:
 
     # -- probe index --------------------------------------------------------
 
-    def _index_fingerprint(self) -> str:
-        layout = [(int(s["id"]), int(s["rows"])) for s in self.shards]
+    def _index_fingerprint(self, shards: list | None = None) -> str:
+        layout = [(int(s["id"]), int(s["rows"]))
+                  for s in (self.shards if shards is None else shards)]
         return hashlib.blake2b(json.dumps(layout).encode(),
                                digest_size=6).hexdigest()
 
@@ -466,29 +496,54 @@ class SignatureStore:
                         np.concatenate(row_of)[order]], axis=1)
         return keys2d[order], loc
 
+    def _delta_index_for(self, sid: int, keys2d: np.ndarray) -> "_ProbeIndex":
+        """Small sorted index over ONE newly committed shard — the LSM
+        delta layer.  A full `_build_index` re-sorts every key in the
+        store (O(n log n), GIL-held); a serving daemon appending a batch
+        per second cannot afford that per append, so fresh shards get a
+        per-shard delta probed after the base index, and the base is
+        re-consolidated only when deltas pile up or the shard layout
+        shrinks (evict/compact/quarantine)."""
+        order = np.argsort(_as_struct(keys2d), kind="stable").astype(np.int32)
+        sorted2d = np.ascontiguousarray(keys2d[order])
+        return _ProbeIndex("ram", _as_struct(sorted2d), sorted2d,
+                           np.full(order.shape[0], sid, np.int32), order)
+
+    @staticmethod
+    def _delta_max() -> int:
+        return int(os.environ.get("TSE1M_SIG_STORE_DELTA_SHARDS", 48))
+
+    def _push_delta(self, sid: int, keys2d: np.ndarray) -> None:
+        self._idx_delta = self._idx_delta + [
+            self._delta_index_for(sid, keys2d)]
+        if len(self._idx_delta) > self._delta_max():
+            self._build_index()
+
     def _build_index(self) -> None:
+        """(Re)build the sorted probe index and publish it as ONE
+        snapshot object (`self._idx`) — `bulk_probe` reads the snapshot
+        reference once, so a concurrent `refresh()` swapping in a newer
+        generation can never hand a probe keys from one generation and
+        locators from another.  Consolidates: the delta layer empties."""
+        self._idx_delta: list[_ProbeIndex] = []
         total = sum(int(s["rows"]) for s in self.shards)
         if total == 0:
-            self._idx_mode = "ram"
-            self._idx_keys = np.empty(0, _DIG_DT)
-            self._idx_keys2d = np.empty((0, 2), np.uint64)
-            self._idx_shard = np.empty(0, np.int32)
-            self._idx_row = np.empty(0, np.int32)
+            self._idx = _ProbeIndex("ram", np.empty(0, _DIG_DT),
+                                    np.empty((0, 2), np.uint64),
+                                    np.empty(0, np.int32),
+                                    np.empty(0, np.int32))
             return
         if total < self._idx_mmap_rows():
-            self._idx_mode = "ram"
             keys2d, loc = self._gather_index_arrays()
-            self._idx_keys2d = keys2d
-            self._idx_keys = _as_struct(keys2d)
-            self._idx_shard = np.ascontiguousarray(loc[:, 0])
-            self._idx_row = np.ascontiguousarray(loc[:, 1])
+            self._idx = _ProbeIndex("ram", _as_struct(keys2d), keys2d,
+                                    np.ascontiguousarray(loc[:, 0]),
+                                    np.ascontiguousarray(loc[:, 1]))
             return
         # Bounded-memory mode: materialize the sorted index once per
         # shard-list generation, then PROBE VIA MMAP — steady-state RSS
         # is O(touched pages), not O(total keys).  Hits are re-verified
         # against the CRC-framed key shards below (`_verify_hits`), so a
         # rotted index byte downgrades to a miss, never a wrong gather.
-        self._idx_mode = "mmap"
         keys_path, loc_path = self._index_paths()
         if not (os.path.exists(keys_path) and os.path.exists(loc_path)):
             keys2d, loc = self._gather_index_arrays()
@@ -497,15 +552,83 @@ class SignatureStore:
                 np.save(tmp, arr)
                 os.replace(tmp, path)
             del keys2d, loc
-        self._idx_keys2d = np.load(keys_path, mmap_mode="r")
-        self._idx_keys = self._idx_keys2d.view(_DIG_DT).reshape(-1)
+        keys2d_mm = np.load(keys_path, mmap_mode="r")
         loc_mm = np.load(loc_path, mmap_mode="r")
-        self._idx_shard = loc_mm[:, 0]
-        self._idx_row = loc_mm[:, 1]
+        self._idx = _ProbeIndex("mmap",
+                                keys2d_mm.view(_DIG_DT).reshape(-1),
+                                keys2d_mm, loc_mm[:, 0], loc_mm[:, 1])
 
     @property
     def n_rows(self) -> int:
-        return int(self._idx_keys.shape[0])
+        return int(self._idx.keys.shape[0]) + sum(
+            int(d.keys.shape[0]) for d in self._idx_delta)
+
+    @property
+    def _idx_mode(self) -> str:
+        return self._idx.mode
+
+    def refresh(self) -> bool:
+        """Adopt shard-list changes committed by this directory's single
+        writer since this handle last looked — the concurrent-reader
+        half of the serving plane's reader/writer discipline.  Cheap
+        when nothing changed: one manifest read and an integer
+        generation compare.  When the generation moved, the committed
+        shard list is re-read, shards this handle already trusted keep
+        their frames (files are immutable once committed), NEW shards
+        are frame-verified before use, and the probe index is rebuilt
+        and swapped in as one atomic snapshot — a probe running in
+        another thread keeps its old consistent view.  Returns True when
+        the view changed."""
+        meta = self._load_json(self._manifest_path)
+        if meta is None:
+            return False
+        new_shards = [dict(s) for s in meta.get("shards", [])]
+        gen = int(meta.get("generation", 0))
+        if (gen == self.generation
+                and self._index_fingerprint(new_shards)
+                == self._index_fingerprint()):
+            return False
+        prior_policy = meta.get("policy", self.policy)
+        if prior_policy != self.policy:
+            raise ValueError(
+                f"signature store at {self.directory} changed policy "
+                f"under this reader (have {prior_policy}, want "
+                f"{self.policy})")
+        known = self.shard_ids()
+        good = []
+        added = []
+        for entry in new_shards:
+            if int(entry["id"]) in known:
+                good.append(entry)
+                continue
+            ok, reason = self._shard_ok(entry)
+            if ok:
+                good.append(entry)
+                added.append(int(entry["id"]))
+            else:
+                # A reader never quarantines (that is the writer's job at
+                # its next open); the bad shard just reads as absent.
+                log.warning("refresh: new shard %s failed verification "
+                            "(%s); treating as absent", entry.get("id"),
+                            reason)
+        removed = known - {int(e["id"]) for e in good}
+        self.shards = good
+        self.generation = gen
+        self._committed_fp = self._index_fingerprint()
+        live = self.shard_ids()
+        for cache in (self._mmaps, self._key_mmaps):
+            for sid in [s for s in cache if s not in live]:
+                cache.pop(sid, None)
+        if removed:
+            self._build_index()  # evict/compact under us: consolidate
+        else:
+            # Append-only delta adoption: per-shard sorted indexes, no
+            # O(total) re-sort — the serving reader refreshes once per
+            # ingest generation and must stay cheap at millions of rows.
+            for sid in added:
+                self._push_delta(
+                    sid, np.asarray(np.load(self._key_path(sid))))
+        return True
 
     @property
     def sig_bytes(self) -> int:
@@ -561,19 +684,39 @@ class SignatureStore:
         n = digests.shape[0]
         shard = np.full(n, -1, np.int32)
         row = np.full(n, -1, np.int32)
-        if n == 0 or self.n_rows == 0:
-            return np.zeros(n, bool), shard, row
-        q = _as_struct(digests)
-        pos = np.searchsorted(self._idx_keys, q)
-        inb = pos < self._idx_keys.shape[0]
         hit = np.zeros(n, bool)
-        hit[inb] = np.all(
-            np.asarray(self._idx_keys2d[pos[inb]]) == np.ascontiguousarray(
-                digests, dtype="<u8")[inb], axis=1)
-        shard[hit] = self._idx_shard[pos[hit]]
-        row[hit] = self._idx_row[pos[hit]]
-        if self._idx_mode == "mmap":
-            self._verify_hits(digests, hit, shard, row)
+        # ONE snapshot read each; append/refresh swap them atomically.
+        idx = self._idx
+        deltas = self._idx_delta
+        if n == 0 or (idx.keys.shape[0] == 0 and not deltas):
+            return hit, shard, row
+        d2 = np.ascontiguousarray(digests, dtype="<u8")
+        q = _as_struct(digests)
+        if idx.keys.shape[0]:
+            pos = np.searchsorted(idx.keys, q)
+            inb = pos < idx.keys.shape[0]
+            hit[inb] = np.all(
+                np.asarray(idx.keys2d[pos[inb]]) == d2[inb], axis=1)
+            shard[hit] = idx.shard[pos[hit]]
+            row[hit] = idx.row[pos[hit]]
+            if idx.mode == "mmap":
+                self._verify_hits(digests, hit, shard, row)
+        # LSM delta layer: shards appended since the last consolidation,
+        # each with its own small sorted index (no overlap with the base
+        # — consolidation empties the delta list).
+        for dl in deltas:
+            miss = np.flatnonzero(~hit)
+            if miss.size == 0:
+                break
+            pos = np.searchsorted(dl.keys, q[miss])
+            inb = pos < dl.keys.shape[0]
+            sub = np.zeros(miss.size, bool)
+            sub[inb] = np.all(dl.keys2d[pos[inb]] == d2[miss][inb], axis=1)
+            sel = miss[sub]
+            if sel.size:
+                shard[sel] = dl.shard[pos[sub]]
+                row[sel] = dl.row[pos[sub]]
+                hit[sel] = True
         self._touch_probed(shard, hit)
         return hit, shard, row
 
@@ -640,8 +783,12 @@ class SignatureStore:
                             "sig_crc": crcs["sig"], "key_crc": crcs["key"],
                             "probe_gen": self._probe_gen})
         self._write_manifest()
+        n_before = len(self.shards)
         self._evict(keep_sid=sid)
-        self._build_index()
+        if len(self.shards) != n_before:
+            self._build_index()  # layout shrank: consolidate everything
+        else:
+            self._push_delta(sid, d)
         return int(d.shape[0])
 
     def _evict(self, keep_sid: int) -> None:
@@ -1293,6 +1440,15 @@ class ShardedSignatureStore:
     def n_rows(self) -> int:
         return sum(self.range_store(r).n_rows
                    for r in range(self.n_ranges))
+
+    def refresh(self) -> bool:
+        """Adopt peers' committed appends in every range this process has
+        opened (see SignatureStore.refresh); returns True when any range
+        changed."""
+        changed = False
+        for store in list(self._stores.values()):
+            changed |= store.refresh()
+        return changed
 
     def scrub(self, repair: bool = False, compact: bool = False) -> dict:
         """Aggregate scrub over every range (repair/compact only on owned

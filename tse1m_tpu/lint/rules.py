@@ -641,12 +641,18 @@ def nondeterminism(src: FileSource) -> list[Finding]:
 # clock half of this rule already covers it once the name markers do.
 
 _WATCHDOG_PLANE = ("tse1m_tpu/resilience/watchdog.py",
-                   "tse1m_tpu/resilience/coordinator.py")
+                   "tse1m_tpu/resilience/coordinator.py",
+                   "tse1m_tpu/observability/latency.py")
+# The serving plane (PR 10) lives in the clock discipline wholesale: its
+# SLO decisions, latency histograms and admission windows all compare
+# against watchdog budgets, so a raw clock anywhere in tse1m_tpu/serve/
+# forks the time base the p99 is measured on.
+_WATCHDOG_PLANE_PREFIXES = ("tse1m_tpu/serve/",)
 _CLOCK_CALLS = {"time.time", "time.time_ns", "time.monotonic",
                 "time.monotonic_ns", "time.perf_counter",
                 "time.perf_counter_ns", "time.clock_gettime"}
 _WATCHDOG_NAME_MARKERS = ("deadline", "watchdog", "stall", "heartbeat",
-                          "lease")
+                          "lease", "slo", "admission")
 _LEASE_NAME_MARKERS = ("lease", "heartbeat")
 
 
@@ -668,7 +674,8 @@ def _open_write_mode(node: ast.Call) -> bool:
 def watchdog_clock(src: FileSource) -> list[Finding]:
     out = []
     parents = None
-    in_plane = src.path in _WATCHDOG_PLANE
+    in_plane = (src.path in _WATCHDOG_PLANE
+                or src.path.startswith(_WATCHDOG_PLANE_PREFIXES))
     for node in ast.walk(src.tree):
         if not isinstance(node, ast.Call):
             continue

@@ -184,10 +184,11 @@ def degradation_counts(events: list) -> dict:
     return by
 
 
+from .latency import LatencyRecorder
 from .merge import (MERGED_MANIFEST, fragment_manifest_path,
                     merge_run_manifests, sweep_stale_fragments)
 
-__all__ = ["MERGED_MANIFEST", "STAGES", "StageRecorder",
+__all__ = ["LatencyRecorder", "MERGED_MANIFEST", "STAGES", "StageRecorder",
            "degradation_counts", "fragment_manifest_path",
            "merge_run_manifests", "peek_degradation_events",
            "pop_degradation_events", "record_degradation",

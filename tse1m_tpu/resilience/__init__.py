@@ -42,7 +42,7 @@ from .retry import RetryError, RetryPolicy, retry_call
 from .runner import StepRunner
 from .watchdog import (Deadline, StageWatchdog, StallError, deadline_clock,
                        deadline_guard, is_device_loss, is_resource_exhausted,
-                       run_with_deadline, watchdog_enabled)
+                       request_budget_s, run_with_deadline, watchdog_enabled)
 
 __all__ = [
     "Deadline", "FaultPlan", "FaultRule", "HeartbeatWriter",
@@ -51,9 +51,9 @@ __all__ = [
     "StageWatchdog", "StallError", "StepRunner", "active_plan",
     "clear_plan", "deadline_clock", "deadline_guard", "fault_point",
     "install_plan", "io_retry_policy", "is_device_loss",
-    "is_resource_exhausted", "reraise_if_fault", "resume_heartbeats",
-    "retry_call", "run_with_deadline", "suspend_heartbeats",
-    "watchdog_enabled",
+    "is_resource_exhausted", "request_budget_s", "reraise_if_fault",
+    "resume_heartbeats", "retry_call", "run_with_deadline",
+    "suspend_heartbeats", "watchdog_enabled",
 ]
 
 

@@ -278,6 +278,35 @@ class StageWatchdog:
             return result
 
 
+# -- per-request-class budgets (online serving plane) -------------------------
+#
+# The serving daemon (tse1m_tpu/serve) answers two very different request
+# classes from one process: queries must stay interactive (tens of ms)
+# while ingest batches may legitimately spend seconds on the device
+# ladder.  One shared watchdog budget would either strangle ingest or
+# never catch a wedged query, so each class carries its own deadline —
+# read here, on the same monotonic clock as every other budget in this
+# plane, and overridable per deployment via TSE1M_SERVE_<CLASS>_BUDGET_S.
+
+_REQUEST_BUDGET_DEFAULTS_S = {
+    "query": 0.25,    # 5x the 50 ms p99 SLO: a violation is a wedge,
+    #                   not jitter — the SLO layer degrades before this
+    "ingest": 120.0,  # covers a cold-compile first batch on the ladder
+    "status": 5.0,
+}
+
+
+def request_budget_s(request_class: str) -> float:
+    """Watchdog budget (seconds) for one serve request class; 0 disables
+    (same contract as StageWatchdog budgets)."""
+    if not watchdog_enabled():
+        return 0.0
+    env = os.environ.get(f"TSE1M_SERVE_{request_class.upper()}_BUDGET_S")
+    if env is not None:
+        return float(env)
+    return _REQUEST_BUDGET_DEFAULTS_S.get(request_class, 30.0)
+
+
 __all__ = ["Deadline", "StageWatchdog", "StallError", "deadline_clock",
            "deadline_guard", "is_device_loss", "is_resource_exhausted",
-           "run_with_deadline", "watchdog_enabled"]
+           "request_budget_s", "run_with_deadline", "watchdog_enabled"]

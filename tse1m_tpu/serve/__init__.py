@@ -1,0 +1,19 @@
+"""Online near-duplicate serving plane.
+
+Turns the batch warm path (content-addressed signature store + extend-
+never-rebuild band tables) into a long-lived service: a single-writer
+ingest daemon (`daemon.ServeDaemon`), a lock-free query path over
+atomically swapped `cluster.incremental.LiveClusterIndex` snapshots, an
+SLO/admission layer (`slo`), and a tiny JSON-over-TCP transport
+(`server`/`client`).  `cli serve` runs it; batch `cli cluster` shares
+the same index code — one merge implementation for both shapes.
+"""
+
+from .client import Backpressure, ServeClient, ServeError
+from .daemon import IngestRejected, ServeDaemon
+from .server import ServeServer
+from .slo import AdmissionController, SloPolicy, SloTracker
+
+__all__ = ["AdmissionController", "Backpressure", "IngestRejected",
+           "ServeClient", "ServeDaemon", "ServeError", "ServeServer",
+           "SloPolicy", "SloTracker"]

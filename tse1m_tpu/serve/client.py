@@ -1,0 +1,138 @@
+"""Client for the serving daemon (CLI `serve-client`, bench, tests).
+
+One TCP connection, requests pipelined in order; reconnects lazily.
+Timeouts come from the per-request-class watchdog budgets
+(`resilience.watchdog.request_budget_s`) — the QUERY class is enforced
+here at the socket (the server keeps its query hot path reaper-free),
+while ingest/control classes are additionally reaper-guarded
+server-side.  Connection-level failures route through the shared retry
+engine (`resilience.retry_call`): a daemon mid-restart answers a ping
+after a reconnect instead of failing the caller's first attempt.
+"""
+
+from __future__ import annotations
+
+import socket
+
+import numpy as np
+
+from ..resilience import RetryPolicy, retry_call
+from ..resilience.watchdog import request_budget_s
+from .server import decode_vectors, encode_vectors, read_msg, write_msg
+
+_CONNECT_TIMEOUT_S = 5.0
+
+
+class ServeError(RuntimeError):
+    """The daemon answered with a structured error."""
+
+    def __init__(self, resp: dict) -> None:
+        super().__init__(str(resp.get("error", "serve request failed")))
+        self.resp = resp
+
+
+class Backpressure(ServeError):
+    """Ingest admission refused the batch; retry after ``retry_after_s``."""
+
+    def __init__(self, resp: dict) -> None:
+        super().__init__(resp)
+        self.retry_after_s = float(resp.get("retry_after_s", 0.1))
+
+
+class ServeClient:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 retry: RetryPolicy | None = None) -> None:
+        self.host = host
+        self.port = int(port)
+        self._sock: socket.socket | None = None
+        self._retry = retry or RetryPolicy(max_attempts=3, base_delay=0.05,
+                                           max_delay=1.0)
+
+    # -- transport -----------------------------------------------------------
+
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            s = socket.create_connection((self.host, self.port),
+                                         timeout=_CONNECT_TIMEOUT_S)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._sock = s
+        return self._sock
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+    def __enter__(self) -> "ServeClient":
+        return self
+
+    def __exit__(self, et, ev, tb) -> None:
+        self.close()
+
+    def request(self, op: str, timeout_s: float | None = None,
+                **payload) -> dict:
+        """One request/response on the pinned connection; connection
+        failures drop the socket and retry through the shared engine."""
+
+        def attempt() -> dict:
+            sock = self._connect()
+            sock.settimeout(timeout_s or _CONNECT_TIMEOUT_S)
+            try:
+                write_msg(sock, {"op": op, **payload})
+                return read_msg(sock)
+            except (ConnectionError, socket.timeout, OSError):
+                self.close()
+                raise
+
+        resp = retry_call(attempt, policy=self._retry,
+                          site=f"serve.client.{op}")
+        if not resp.get("ok", False):
+            if resp.get("error") == "backpressure":
+                raise Backpressure(resp)
+            raise ServeError(resp)
+        return resp
+
+    # -- API -----------------------------------------------------------------
+
+    def ping(self) -> dict:
+        return self.request("ping", timeout_s=request_budget_s("status")
+                            or None)
+
+    def status(self) -> dict:
+        return self.request("status", timeout_s=request_budget_s("status")
+                            or None)
+
+    def query(self, vectors: np.ndarray,
+              timeout_s: float | None = None) -> dict:
+        resp = self.request(
+            "query",
+            timeout_s=timeout_s or request_budget_s("query") or None,
+            **encode_vectors(vectors))
+        resp["labels"] = np.asarray(resp["labels"], np.int64)
+        resp["known"] = np.asarray(resp["known"], bool)
+        return resp
+
+    def ingest(self, vectors: np.ndarray,
+               timeout_s: float | None = None) -> dict:
+        """Durable ingest: the response means every row is committed to
+        the store (SIGKILL after this returns loses nothing).  Raises
+        :class:`Backpressure` under admission control — the caller owns
+        the backoff (it knows whether the batch is droppable)."""
+        return self.request(
+            "ingest",
+            timeout_s=timeout_s or request_budget_s("ingest") or None,
+            **encode_vectors(vectors))
+
+    def quiesce(self, timeout_s: float | None = None) -> dict:
+        return self.request(
+            "quiesce",
+            timeout_s=timeout_s or request_budget_s("ingest") or None)
+
+    def shutdown(self) -> dict:
+        return self.request("shutdown", timeout_s=5.0)
+
+
+__all__ = ["Backpressure", "ServeClient", "ServeError", "decode_vectors",
+           "encode_vectors"]

@@ -1,0 +1,468 @@
+"""Long-lived near-duplicate serving daemon (ingest loop + query path).
+
+The batch pipeline answers "cluster these N sessions" once a day; this
+daemon answers "which cluster does THIS coverage vector belong to?"
+continuously, over the same persistent machinery:
+
+- **Ingest** (single writer): batches of coverage vectors are digested,
+  deduplicated against the live index, probed against the signature
+  store, and only the content-novel tail is device-MinHashed — through
+  the existing degraded streaming pipeline
+  (`cluster.pipeline.minhash_novel_rows`: OOM halving, stall retry, CPU
+  failover), padded to power-of-two batch shapes so a long-lived process
+  compiles O(log max-batch) kernel shapes.  Novel signatures append to
+  the store under the single-writer discipline; a batch is ACKNOWLEDGED
+  only after the store manifest commit, so an acknowledged row survives
+  SIGKILL (the chaos contract: restart loses zero acked rows).
+- **Query** (lock-free readers): each ingest generation publishes a new
+  immutable `cluster.incremental.LiveClusterIndex` snapshot by swapping
+  ONE reference — queries grab the reference once and never observe a
+  half-updated band table.  Old-signature gathers go through a
+  read-only mmap store handle (`SignatureStore(read_only=True)`)
+  refreshed per generation via the store's generation counter.  The
+  query path is host-only (digest lookup, or host MinHash + band-table
+  probe + exact signature verification for novel vectors): zero device
+  transfers, zero compiles — sanitizer-clean by construction.
+- **SLO** (`serve/slo.py`): admission control refuses ingest past the
+  backlog bound BEFORE query p99 degrades; per-request-class watchdog
+  budgets come from `resilience.watchdog.request_budget_s`; latency
+  histograms (`observability.latency.LatencyRecorder`) and queue depth
+  flow into the status endpoint and the bench ``serve_*`` keys.
+
+Crash recovery: the daemon adopts the store's persisted LSH state as
+generation 0 and then absorbs, in deterministic (shard, row) order, any
+store rows the state does not cover — exactly the rows whose append
+committed (and was acked) but whose state commit the crash outran.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+from ..cluster.host import host_band_keys, host_signatures
+from ..cluster.incremental import LiveClusterIndex
+from ..cluster.minhash import make_hash_params
+from ..cluster.pipeline import (ClusterParams, _store_policy,
+                                minhash_novel_rows)
+from ..cluster.encode import quantize_ids
+from ..cluster.store import SignatureStore, row_digests
+from ..observability import StageRecorder, record_degradation
+from ..observability.latency import LatencyRecorder
+from ..resilience import (StageWatchdog, fault_point, reraise_if_fault)
+from ..resilience.watchdog import deadline_clock
+from ..utils.logging import get_logger
+from .slo import AdmissionController, SloPolicy, SloTracker
+
+log = get_logger("serve.daemon")
+
+_RECOVER_CHUNK = 65536
+_CONTROL_COMMIT = "commit_state"
+
+
+class IngestRejected(RuntimeError):
+    """Admission control refused the batch (backpressure)."""
+
+    def __init__(self, depth: int, retry_after_s: float) -> None:
+        super().__init__(
+            f"ingest backlog at {depth} batches; retry in "
+            f"~{retry_after_s:.2f}s")
+        self.depth = depth
+        self.retry_after_s = retry_after_s
+
+
+class _Ticket:
+    __slots__ = ("items", "op", "event", "result", "error")
+
+    def __init__(self, items=None, op: str = "ingest") -> None:
+        self.items = items
+        self.op = op
+        self.event = threading.Event()
+        self.result: dict | None = None
+        self.error: BaseException | None = None
+
+    def fail(self, e: BaseException) -> None:
+        self.error = e
+        self.event.set()
+
+    def done(self, result: dict) -> None:
+        self.result = result
+        self.event.set()
+
+    def wait(self, timeout: float | None = None) -> dict:
+        if not self.event.wait(timeout):
+            raise TimeoutError("ingest batch not acknowledged in time")
+        if self.error is not None:
+            raise self.error
+        return self.result or {}
+
+
+class ServeDaemon:
+    """The serving plane's single-process core: one writer thread, any
+    number of reader threads, one store directory.
+
+    Thread contract: `submit`/`ingest`/`query`/`status` are safe from
+    any thread; everything that WRITES (store appends, state commits,
+    index swaps) happens on the one ingest thread — the same
+    single-writer discipline the pod plane enforces with leases, here
+    enforced by construction."""
+
+    def __init__(self, store_dir: str,
+                 params: ClusterParams | None = None,
+                 slo: SloPolicy | None = None,
+                 state_commit_every: int = 8) -> None:
+        from ..cluster.store import ShardedSignatureStore
+
+        if ShardedSignatureStore.is_sharded_root(store_dir):
+            raise ValueError(
+                f"{store_dir} is a pod-sharded store root; the serving "
+                "daemon is single-host — serve one range directory, or "
+                "run one daemon per range owner")
+        self.params = params or ClusterParams()
+        self.slo = slo or SloPolicy.from_env()
+        self.state_commit_every = max(1, int(state_commit_every))
+        policy = self._resolve_policy(store_dir)
+        self.qbits = int(policy["quant_bits"])
+        self.store = SignatureStore(store_dir, policy)
+        self.reader = SignatureStore(store_dir, policy, read_only=True)
+        self._a, self._b = make_hash_params(self.params.n_hashes,
+                                            self.params.seed)
+        self.rec = StageRecorder()
+        self.watchdog = StageWatchdog()
+        self.admission = AdmissionController(self.slo)
+        self.tracker = SloTracker(self.slo)
+        self.lat_query = LatencyRecorder("serve_query")
+        self.lat_ingest = LatencyRecorder("serve_ingest")
+        self.last_scrub: dict = {
+            "store_scrub_shards": len(self.store.shards),
+            "store_scrub_corrupt": len(self.store.quarantined_at_open)}
+        self._digest_parts: list[np.ndarray] = []
+        self._index = LiveClusterIndex.empty(self.params.n_bands)
+        self._recover()
+        self._q: queue.Queue[_Ticket] = queue.Queue()
+        self._stop = threading.Event()
+        self._busy = False
+        self._last_committed_gen = self._index.generation
+        self._ingest_error: BaseException | None = None
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def _resolve_policy(self, store_dir: str) -> dict:
+        """An existing store's manifest policy wins (serving must answer
+        in the universe the cached signatures were computed in); a fresh
+        directory takes the policy from params."""
+        import json
+        import os
+
+        path = os.path.join(store_dir, "store_manifest.json")
+        if os.path.exists(path):
+            try:
+                with open(path, encoding="utf-8") as f:
+                    return dict(json.load(f)["policy"])
+            except (OSError, ValueError, KeyError) as e:
+                log.warning("unreadable store manifest (%s); opening "
+                            "fresh", e)
+        qb = self.params.wire_quant_bits
+        return _store_policy(self.params, qb if qb and qb > 0 else 0)
+
+    def start(self) -> "ServeDaemon":
+        self._thread = threading.Thread(target=self._ingest_loop,
+                                        name="tse1m-serve-ingest",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, commit: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=60)
+            self._thread = None
+        if commit and self._ingest_error is None:
+            # The ingest thread is dead; committing from here keeps the
+            # single-writer invariant (exactly one live writer).
+            self._commit_state()
+
+    # -- recovery ------------------------------------------------------------
+
+    def _recover(self) -> None:
+        state = self.store.load_state(self.params.n_bands,
+                                      self.params.threshold)
+        if state is not None:
+            digests = np.empty((state.n_rows, 2), np.uint64)
+            loc = state.locator
+            for sid in np.unique(loc[:, 0]):
+                sel = np.flatnonzero(loc[:, 0] == sid)
+                digests[sel] = np.asarray(
+                    self.store._key_mmap(int(sid))[loc[sel, 1]])
+            self._index = LiveClusterIndex.from_state(state, digests)
+            self._digest_parts = [digests]
+        # Absorb acked-but-uncommitted rows (append outran the state
+        # commit): every store row the index does not know, in
+        # deterministic (shard id, row) order.
+        absorbed = 0
+        for entry in sorted(self.store.shards, key=lambda e: int(e["id"])):
+            sid = int(entry["id"])
+            keys = np.asarray(self.store._key_mmap(sid))
+            for lo in range(0, keys.shape[0], _RECOVER_CHUNK):
+                d = keys[lo:lo + _RECOVER_CHUNK]
+                hit, _ = self._index.lookup_digests(d)
+                fresh = np.flatnonzero(~hit)
+                if fresh.size == 0:
+                    continue
+                sigs = np.asarray(
+                    self.store._sig_mmap(sid)[lo + fresh])
+                locator = np.stack(
+                    [np.full(fresh.size, sid, np.int32),
+                     (lo + fresh).astype(np.int32)], axis=1)
+                self._absorb(d[fresh], sigs, locator)
+                absorbed += int(fresh.size)
+        if absorbed:
+            log.warning("serve: recovered %d acked row(s) the persisted "
+                        "state did not cover (crash between append and "
+                        "state commit)", absorbed)
+
+    # -- index mutation (ingest thread only) ---------------------------------
+
+    def _gather_writer_sigs(self, index: LiveClusterIndex,
+                            uniq: np.ndarray) -> np.ndarray:
+        loc = index.locator[uniq]
+        try:
+            return self.store.load_signatures(loc[:, 0], loc[:, 1])
+        except (OSError, ValueError):
+            # LRU eviction raced an old locator: degrade per shard — a
+            # hub whose signature is gone gets a sentinel that can never
+            # reach the agreement threshold, so the candidate edge drops
+            # and the new row recomputes its own cluster (exactly the
+            # miss-and-recompute semantics eviction already means).
+            h = self.params.n_hashes
+            out = np.full((int(uniq.size), h), 0xFFFFFFFF, np.uint32)
+            lost = 0
+            for sid in np.unique(loc[:, 0]):
+                sel = np.flatnonzero(loc[:, 0] == sid)
+                try:
+                    out[sel] = self.store.load_signatures(loc[sel, 0],
+                                                          loc[sel, 1])
+                except (OSError, ValueError):
+                    lost += int(sel.size)
+            record_degradation(
+                "serve_evicted_gather", site="serve.ingest",
+                detail={"rows": lost})
+            log.warning("serve: %d hub signature(s) evicted from the "
+                        "store; their candidate edges drop and the new "
+                        "rows recompute", lost)
+            return out
+
+    def _absorb(self, digests: np.ndarray, sigs: np.ndarray,
+                locator: np.ndarray) -> None:
+        index = self._index
+        keys = host_band_keys(sigs, self.params.n_bands)
+        new_index = index.absorb(
+            keys, sigs, lambda u: self._gather_writer_sigs(index, u),
+            self.params.n_hashes, self.params.threshold,
+            new_locator=locator, new_digests=digests)
+        self._digest_parts.append(
+            np.ascontiguousarray(digests, np.uint64))
+        # THE publication point: one reference swap; concurrent queries
+        # keep whichever snapshot they already grabbed.
+        self._index = new_index
+
+    def _all_digests(self) -> np.ndarray:
+        if len(self._digest_parts) > 1:
+            self._digest_parts = [np.concatenate(self._digest_parts)]
+        return (self._digest_parts[0] if self._digest_parts
+                else np.empty((0, 2), np.uint64))
+
+    def _commit_state(self) -> None:
+        index = self._index
+        if index.n_rows == 0:
+            return
+        self.store.save_state(
+            index.labels, index.locator,
+            (index.band_keys_sorted, index.band_reps),
+            self._all_digests(), self.params.n_bands,
+            self.params.threshold)
+        self._last_committed_gen = index.generation
+
+    # -- ingest --------------------------------------------------------------
+
+    def submit(self, items: np.ndarray) -> _Ticket:
+        """Admission-checked enqueue; raises IngestRejected under
+        backpressure.  The returned ticket's ``wait()`` blocks until the
+        batch is durably acknowledged (store append committed)."""
+        if self._ingest_error is not None:
+            raise RuntimeError("serve ingest loop is down") \
+                from self._ingest_error
+        depth = self._q.qsize()
+        admitted, retry_after = self.admission.try_admit(depth)
+        if not admitted:
+            raise IngestRejected(depth, retry_after)
+        t = _Ticket(np.ascontiguousarray(items, np.uint32))
+        self._q.put(t)
+        return t
+
+    def ingest(self, items: np.ndarray,
+               timeout: float | None = None) -> dict:
+        return self.submit(items).wait(timeout)
+
+    def _ingest_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                t = self._q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            self._busy = True
+            try:
+                if t.op == _CONTROL_COMMIT:
+                    self._commit_state()
+                    t.done({"ok": True,
+                            "generation": self._index.generation})
+                else:
+                    with self.lat_ingest.time():
+                        t.done(self._ingest_batch(t.items))
+                    gen = self._index.generation
+                    if (gen - self._last_committed_gen
+                            >= self.state_commit_every):
+                        self._commit_state()
+            except BaseException as e:  # noqa: BLE001 — fail the ticket, then fault-transparent re-raise below
+                t.fail(e)
+                try:
+                    reraise_if_fault(e)
+                except BaseException:
+                    self._ingest_error = e
+                    raise
+                if isinstance(e, (KeyboardInterrupt, SystemExit)):
+                    self._ingest_error = e
+                    raise
+                log.error("serve: ingest batch failed (%s: %s); daemon "
+                          "continues", type(e).__name__, e)
+            finally:
+                self._busy = False
+
+    def _ingest_batch(self, items: np.ndarray) -> dict:
+        """One acknowledged batch: EVERY row becomes a new index row (the
+        batch pipeline's label space keeps content-duplicate sessions as
+        distinct rows, and post-quiesce parity is elementwise against
+        it), while the STORE stays content-addressed — cached contents
+        gather their signature, only the content-novel tail touches the
+        device."""
+        k = int(items.shape[0])
+        index = self._index
+        n_old = index.n_rows
+        if k == 0:
+            return {"ok": True, "acked": 0, "novel": 0,
+                    "generation": index.generation,
+                    "labels": [], "rows": []}
+        digests = row_digests(items)
+        h = self.params.n_hashes
+        sigs = np.empty((k, h), np.uint32)
+        s_hit, sh, rw = self.store.bulk_probe(digests)
+        if s_hit.any():
+            sigs[s_hit] = self.store.load_signatures(sh[s_hit], rw[s_hit])
+        miss = ~s_hit
+        novel = int(miss.sum())
+        if novel:
+            sigs[miss] = minhash_novel_rows(
+                items[miss], self.params, self.qbits,
+                rec=self.rec, wd=self.watchdog)
+        # Durability point: the ack below is only sent once this commit
+        # (tmp+rename shard + manifest) has happened — a SIGKILL anywhere
+        # after it loses zero acknowledged rows.
+        fault_point("serve.ingest.commit")
+        self.store.append(digests[miss], sigs[miss])
+        _, sh2, rw2 = self.store.bulk_probe(digests)
+        locator = np.stack([sh2, rw2], axis=1).astype(np.int32)
+        # Refresh the query-side reader BEFORE publishing the new index
+        # generation, so no published locator ever outruns the reader's
+        # view of the store.
+        self.reader.refresh()
+        self._absorb(digests, sigs, locator)
+        new_index = self._index
+        gr = n_old + np.arange(k, dtype=np.int64)
+        return {"ok": True, "acked": k, "novel": novel,
+                "generation": new_index.generation,
+                "labels": new_index.labels[gr].astype(int).tolist(),
+                "rows": gr.tolist()}
+
+    # -- queries (any thread) ------------------------------------------------
+
+    def _gather_reader_sigs(self, index: LiveClusterIndex,
+                            uniq: np.ndarray) -> np.ndarray | None:
+        loc = index.locator[uniq]
+        try:
+            return self.reader.load_signatures(loc[:, 0], loc[:, 1])
+        except (OSError, ValueError) as e:
+            # An evicted/compacted shard raced this gather: candidates
+            # degrade to misses (the vector reads as novel), never a
+            # wrong label.
+            log.warning("serve: query gather degraded (%s); treating "
+                        "candidates as misses", e)
+            return None
+
+    def query(self, vectors: np.ndarray) -> dict:
+        """Cluster membership for [K, S] uint32 coverage vectors.
+
+        Host-only hot path: known vectors (content digest already
+        ingested) answer straight from the snapshot's label array; novel
+        vectors are MinHashed on host (bit-identical to the device
+        kernel), probed against the snapshot's band tables and verified
+        with the exact signature-agreement rule.  Label -1 means "a new
+        singleton cluster"."""
+        t0 = deadline_clock()
+        vectors = np.ascontiguousarray(vectors, np.uint32)
+        index = self._index  # ONE snapshot reference for the whole query
+        n = int(vectors.shape[0])
+        digests = row_digests(vectors)
+        hit, row = index.lookup_digests(digests)
+        out = np.full(n, -1, np.int64)
+        if hit.any():
+            out[hit] = index.labels[row[hit]].astype(np.int64)
+        miss = np.flatnonzero(~hit)
+        if miss.size:
+            rows = vectors[miss]
+            if self.qbits:
+                rows = quantize_ids(rows, self.qbits)
+            sigs = host_signatures(rows, self._a, self._b)
+            keys = host_band_keys(sigs, self.params.n_bands)
+            out[miss] = index.query_labels(
+                sigs, keys, lambda u: self._gather_reader_sigs(index, u),
+                self.params.n_hashes, self.params.threshold)
+        wall = deadline_clock() - t0
+        self.lat_query.add(wall)
+        self.tracker.observe_query(wall)
+        return {"labels": out, "known": hit,
+                "generation": index.generation}
+
+    # -- control -------------------------------------------------------------
+
+    def quiesce(self, timeout: float | None = None) -> dict:
+        """Drain the ingest queue and commit the LSH state; returns the
+        commit acknowledgement.  After quiesce, a cold batch run over
+        the same session set reproduces the index labels elementwise."""
+        t = _Ticket(op=_CONTROL_COMMIT)
+        self._q.put(t)
+        return t.wait(timeout)
+
+    def status(self) -> dict:
+        index = self._index
+        return {
+            "ok": self._ingest_error is None,
+            "rows": int(index.n_rows),
+            "generation": int(index.generation),
+            "store_generation": int(self.store.generation),
+            "store_rows": int(self.store.n_rows),
+            "queue_depth": int(self._q.qsize()),
+            "uncommitted_generations": int(index.generation
+                                           - self._last_committed_gen),
+            "last_scrub": dict(self.last_scrub),
+            "policy": dict(self.store.policy),
+            **self.admission.stats(),
+            **self.tracker.stats(),
+            **self.lat_query.summary(),
+            **self.lat_ingest.summary(),
+        }
+
+
+__all__ = ["IngestRejected", "ServeDaemon"]

@@ -1,0 +1,185 @@
+"""JSON-over-TCP front end for the serving daemon.
+
+Deliberately tiny: a 4-byte big-endian length prefix followed by one
+UTF-8 JSON object per direction, stdlib only (this container has no web
+framework, and the protocol is trivially testable).  Vectors travel
+either as JSON lists (interactive/CLI use) or as base64 raw
+little-endian uint32 with an explicit shape (``vectors_b64``/``shape``
+— the bulk path bench and chaos drivers use).
+
+Request classes map to watchdog budgets
+(`resilience.watchdog.request_budget_s`): ingest and control requests
+run under `run_with_deadline` (a reaper thread cancels a wedged batch
+and the client gets a structured error instead of a hang); the query
+class is latency-bounded client-side (socket timeout = the query
+budget) and SLO-tracked server-side — a per-query reaper thread would
+cost more than the 50 ms p99 it protects.
+
+Request handlers are fault-transparent (graftlint ``broad-except``):
+errors become structured ``{"ok": false, "error": ...}`` responses, but
+an injected fault (`resilience.InjectedFault`) re-raises through the
+handler so chaos runs see the real failure mode, never a cosmetic
+error string.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import socket
+import socketserver
+import struct
+import threading
+
+import numpy as np
+
+from ..resilience import reraise_if_fault
+from ..resilience.watchdog import request_budget_s, run_with_deadline
+from ..utils.logging import get_logger
+from .daemon import IngestRejected, ServeDaemon
+
+log = get_logger("serve.server")
+
+_LEN = struct.Struct(">I")
+_MAX_MSG = 1 << 30
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed mid-message")
+        buf += chunk
+    return buf
+
+
+def read_msg(sock: socket.socket) -> dict:
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    if n > _MAX_MSG:
+        raise ValueError(f"message of {n} bytes exceeds the 1 GiB bound")
+    return json.loads(_recv_exact(sock, n).decode("utf-8"))
+
+
+def write_msg(sock: socket.socket, obj: dict) -> None:
+    payload = json.dumps(obj).encode("utf-8")
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def decode_vectors(msg: dict) -> np.ndarray:
+    if "vectors_b64" in msg:
+        k, s = (int(x) for x in msg["shape"])
+        raw = base64.b64decode(msg["vectors_b64"])
+        if len(raw) != k * s * 4:
+            raise ValueError(f"vectors_b64 carries {len(raw)} bytes; "
+                             f"shape {(k, s)} needs {k * s * 4}")
+        return np.frombuffer(raw, dtype="<u4").reshape(k, s)
+    return np.asarray(msg.get("vectors", []), dtype=np.uint32)
+
+
+def encode_vectors(vectors: np.ndarray) -> dict:
+    v = np.ascontiguousarray(vectors, dtype="<u4")
+    return {"vectors_b64": base64.b64encode(v.tobytes()).decode("ascii"),
+            "shape": list(v.shape)}
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self) -> None:
+        server: ServeServer = self.server  # type: ignore[assignment]
+        try:
+            while True:
+                try:
+                    msg = read_msg(self.request)
+                except (ConnectionError, struct.error):
+                    return  # client went away between requests
+                resp = server.dispatch(msg)
+                write_msg(self.request, resp)
+                if msg.get("op") == "shutdown":
+                    return
+        except Exception as e:
+            reraise_if_fault(e)
+            log.warning("serve: connection handler failed (%s: %s)",
+                        type(e).__name__, e)
+
+
+class ServeServer(socketserver.ThreadingTCPServer):
+    """One daemon, many concurrent client connections (thread per
+    connection; requests on one connection are processed in order)."""
+
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, daemon: ServeDaemon,
+                 host: str = "127.0.0.1", port: int = 0) -> None:
+        super().__init__((host, port), _Handler)
+        self.daemon = daemon
+        self._shutdown_requested = threading.Event()
+
+    @property
+    def port(self) -> int:
+        return int(self.server_address[1])
+
+    def dispatch(self, msg: dict) -> dict:
+        op = str(msg.get("op", ""))
+        try:
+            if op == "ping":
+                return {"ok": True, "op": "ping",
+                        "generation": self.daemon._index.generation,
+                        "rows": self.daemon._index.n_rows}
+            if op == "status":
+                return {"ok": True, **self._guarded(
+                    "status", self.daemon.status)}
+            if op == "query":
+                vectors = decode_vectors(msg)
+                res = self.daemon.query(vectors)
+                return {"ok": True,
+                        "labels": res["labels"].astype(int).tolist(),
+                        "known": res["known"].astype(bool).tolist(),
+                        "generation": int(res["generation"])}
+            if op == "ingest":
+                vectors = decode_vectors(msg)
+                return self._guarded(
+                    "ingest", lambda: self.daemon.ingest(
+                        vectors, timeout=request_budget_s("ingest") or None))
+            if op == "quiesce":
+                return self._guarded(
+                    "ingest", lambda: self.daemon.quiesce(
+                        timeout=request_budget_s("ingest") or None))
+            if op == "shutdown":
+                self._shutdown_requested.set()
+                threading.Thread(target=self.shutdown,
+                                 daemon=True).start()
+                return {"ok": True, "op": "shutdown"}
+            return {"ok": False, "error": f"unknown op {op!r}"}
+        except IngestRejected as e:
+            return {"ok": False, "error": "backpressure",
+                    "retry_after_s": round(e.retry_after_s, 3),
+                    "depth": e.depth}
+        except Exception as e:
+            reraise_if_fault(e)
+            log.error("serve: %s request failed (%s: %s)", op,
+                      type(e).__name__, e)
+            return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+
+    def _guarded(self, request_class: str, fn):
+        """Control-plane requests under the per-class watchdog budget: a
+        wedged batch is cancelled (StallError -> structured error), not
+        an open-ended hang holding the client's socket."""
+        return run_with_deadline(fn, request_budget_s(request_class),
+                                 f"serve.{request_class}")
+
+    def serve_until_shutdown(self, port_file: str | None = None) -> None:
+        if port_file:
+            from ..utils.atomic import atomic_write
+
+            with atomic_write(port_file) as f:
+                f.write(str(self.port))
+        log.info("serve: listening on %s:%d (store rows=%d gen=%d)",
+                 self.server_address[0], self.port,
+                 self.daemon._index.n_rows,
+                 self.daemon._index.generation)
+        self.serve_forever(poll_interval=0.1)
+
+
+__all__ = ["ServeServer", "decode_vectors", "encode_vectors", "read_msg",
+           "write_msg"]

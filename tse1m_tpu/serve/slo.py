@@ -1,0 +1,138 @@
+"""SLO layer for the online serving plane: admission control first.
+
+The serving daemon's contract is a QUERY p99, and the only lever that
+protects it under load is refusing work early: ingest is the elastic
+class (a fuzzing session landing a few seconds later is free; a wedged
+interactive query is not), so when the ingest backlog grows past the
+policy bound, new ingest batches are rejected with a retry hint —
+BEFORE query latency degrades — and every refusal is visible as a
+``serve_backpressure`` degradation event plus queue-depth telemetry.
+
+This is the load face of the PR 5 degradation ladder: the ingest path
+itself already rides the watchdog/OOM/failover rungs inside the
+pipeline; this module adds the request-class rung on top, with budgets
+from ``resilience.watchdog.request_budget_s`` (one monotonic clock, the
+``watchdog-clock`` lint plane).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+
+from ..observability import record_degradation
+from ..resilience.watchdog import request_budget_s
+
+
+@dataclass(frozen=True)
+class SloPolicy:
+    """Serving-plane targets and admission bounds.
+
+    ``max_backlog_batches`` bounds the ingest queue: past it, submit is
+    refused (backpressure) instead of queued — queue time is latency the
+    acknowledging client cannot see, and an unbounded queue turns a load
+    spike into an availability hole.  ``query_p99_target_ms`` is the SLO
+    the plane reports against (violations are counted, not enforced per
+    request — the per-request guard is the watchdog budget, which is a
+    wedge detector, not an SLO)."""
+
+    max_backlog_batches: int = 64
+    query_p99_target_ms: float = 50.0
+    query_budget_s: float = field(
+        default_factory=lambda: request_budget_s("query"))
+    ingest_budget_s: float = field(
+        default_factory=lambda: request_budget_s("ingest"))
+
+    @classmethod
+    def from_env(cls) -> "SloPolicy":
+        return cls(
+            max_backlog_batches=int(
+                os.environ.get("TSE1M_SERVE_MAX_BACKLOG", 64)),
+            query_p99_target_ms=float(
+                os.environ.get("TSE1M_SERVE_P99_TARGET_MS", 50.0)))
+
+
+class AdmissionController:
+    """Ingest admission + queue-depth accounting (thread-safe).
+
+    ``try_admit`` is called with the current queue depth before an
+    ingest batch may enqueue; a refusal returns the retry hint the
+    transport layer sends back.  Only the refused->admitted *transition*
+    fires a degradation event (a sustained overload is one incident, not
+    ten thousand), while every refusal increments the counter."""
+
+    def __init__(self, policy: SloPolicy) -> None:
+        self.policy = policy
+        self._lock = threading.Lock()
+        self._rejected = 0
+        self._in_backpressure = False
+        self._backlog_max = 0
+
+    def note_depth(self, depth: int) -> None:
+        with self._lock:
+            if depth > self._backlog_max:
+                self._backlog_max = depth
+
+    def try_admit(self, depth: int) -> tuple[bool, float]:
+        """(admitted, retry_after_s).  Depth counts batches queued ahead
+        of this one."""
+        self.note_depth(depth)
+        if depth < self.policy.max_backlog_batches:
+            with self._lock:
+                self._in_backpressure = False
+            return True, 0.0
+        with self._lock:
+            self._rejected += 1
+            fresh = not self._in_backpressure
+            self._in_backpressure = True
+        if fresh:
+            record_degradation(
+                "serve_backpressure", site="serve.ingest",
+                detail={"depth": int(depth),
+                        "max_backlog": self.policy.max_backlog_batches})
+        # Hint: roughly one queued batch's worth of drain time; the
+        # client owns the actual backoff (shared retry engine).
+        return False, max(0.05, self.policy.ingest_budget_s
+                          / max(1, self.policy.max_backlog_batches))
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"ingest_rejected": self._rejected,
+                    "ingest_backlog_max": self._backlog_max,
+                    "in_backpressure": self._in_backpressure}
+
+
+class SloTracker:
+    """Counts query-budget violations against the p99 target.
+
+    The per-request watchdog budget catches wedges; this tracker makes
+    slow-but-completing queries visible: each query wall past the p99
+    target counts, and the first violation in a run fires a
+    ``serve_slo_violation`` degradation event so the run manifest shows
+    the plane ran hot even when nothing timed out."""
+
+    def __init__(self, policy: SloPolicy) -> None:
+        self.policy = policy
+        self._lock = threading.Lock()
+        self._violations = 0
+
+    def observe_query(self, wall_s: float) -> None:
+        if wall_s * 1e3 <= self.policy.query_p99_target_ms:
+            return
+        with self._lock:
+            self._violations += 1
+            first = self._violations == 1
+        if first:
+            record_degradation(
+                "serve_slo_violation", site="serve.query",
+                detail={"wall_ms": round(wall_s * 1e3, 3),
+                        "target_ms": self.policy.query_p99_target_ms})
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"query_slo_violations": self._violations,
+                    "query_p99_target_ms": self.policy.query_p99_target_ms}
+
+
+__all__ = ["AdmissionController", "SloPolicy", "SloTracker"]
