@@ -333,6 +333,22 @@ def main() -> int:
     p.add_argument("--serve-batch", type=int,
                    default=int(os.environ.get("BENCH_SERVE_BATCH", 1024)),
                    help="ingest batch size for the serving round")
+    p.add_argument("--scheme", default=os.environ.get("BENCH_SCHEME",
+                                                      "kminhash"),
+                   choices=("kminhash", "cminhash", "weighted"),
+                   help="signature kernel family for the timed cluster "
+                        "round (cluster/schemes.py); 'weighted' expands "
+                        "synthetic hit counts into replica rows first "
+                        "(also BENCH_SCHEME)")
+    p.add_argument("--schemes-round", action="store_true",
+                   default=os.environ.get("BENCH_SCHEMES", "")
+                   not in ("", "0"),
+                   help="run the scheme-comparison round (BENCH_r09 "
+                        "contract): per-scheme signature wall, analytic "
+                        "hash evaluations, estimator error vs exact "
+                        "Jaccard on planted pairs, clustering quality, "
+                        "and host/device bit-parity across quantization "
+                        "rungs + resume (also BENCH_SCHEMES=1)")
     p.add_argument("--sanitize", action="store_true",
                    default=os.environ.get("BENCH_SANITIZE", "")
                    not in ("", "0"),
@@ -377,9 +393,20 @@ def main() -> int:
 
     items, truth = synth_session_sets(args.n, set_size=args.set_size,
                                       seed=args.seed)
+    if args.scheme == "weighted":
+        # The weighted workload consumes per-edge hit counts: replica-
+        # expand host-side (schemes.expand_weighted) and bench the
+        # pipeline over the replica rows — the similarity being
+        # estimated is weighted Jaccard, a different (new) workload.
+        from tse1m_tpu.cluster.schemes import expand_weighted
+        from tse1m_tpu.data.synth import synth_session_hitcounts
+
+        items = expand_weighted(
+            items, synth_session_hitcounts(items, truth, seed=args.seed))
     dev = jax.devices()[0]
     params = ClusterParams(n_hashes=args.hashes, n_bands=args.bands,
-                           prefilter=args.prefilter, entropy=args.entropy)
+                           prefilter=args.prefilter, entropy=args.entropy,
+                           scheme=args.scheme)
 
     # TSE1M_PROFILE_DIR=<dir> wraps ONE steady-state run in a
     # jax.profiler trace (same knob utils/timing.py gives the RQ drivers)
@@ -424,7 +451,8 @@ def main() -> int:
               "falling back to fused-jax", file=sys.stderr)
         params = ClusterParams(n_hashes=args.hashes, n_bands=args.bands,
                                prefilter=args.prefilter,
-                               entropy=args.entropy, use_pallas="never")
+                               entropy=args.entropy, use_pallas="never",
+                               scheme=args.scheme)
         cluster_sessions(items, params)
         labels, runs, sanitizer = timed(params)
 
@@ -483,19 +511,20 @@ def main() -> int:
         over the tunnel."""
         import jax
 
-        from tse1m_tpu.cluster.minhash import make_hash_params
-        from tse1m_tpu.cluster.minhash_pallas import minhash_and_keys
         from tse1m_tpu.cluster.pipeline import _cluster_from_sig_jit
+        from tse1m_tpu.cluster.schemes import (make_params,
+                                               scheme_sig_and_keys)
 
-        a, b = make_hash_params(params.n_hashes, params.seed)
+        hp = make_params(params.scheme, params.n_hashes,
+                         params.seed).device()
         items_d = jax.device_put(items)  # graftlint: disable=wire-layer -- compute-only probe pre-stages items to exclude the link
         float(items_d[0, 0])  # finish the staging transfer
         samples = []
         for _ in range(3):
             t0 = time.perf_counter()
-            sig, keys = minhash_and_keys(items_d, a, b, params.n_bands,
-                                         use_pallas=params.use_pallas,
-                                         block_n=params.block_n)
+            sig, keys = scheme_sig_and_keys(items_d, hp, params.n_bands,
+                                            use_pallas=params.use_pallas,
+                                            block_n=params.block_n)
             lab = _cluster_from_sig_jit(sig, keys, params.threshold,
                                         params.n_iters)
             float(lab[0])
@@ -829,6 +858,119 @@ def main() -> int:
             "serve_sanitized": bool(args.sanitize),
         }
 
+    def bench_schemes() -> dict:
+        """Scheme-comparison round (the BENCH_r09 contract): every member
+        of the kernel family over the same planted corpus — signature
+        pass wall, ANALYTIC element-hash evaluations (the honest FLOP
+        comparison: C-MinHash hashes each element once, kminhash once
+        per hash function), estimator error vs exact Jaccard on planted
+        pairs, clustering quality, and bit-parity of host vs device vs
+        pallas signatures across the b-bit quantization rungs plus a
+        checkpointed resume."""
+        import tempfile
+        from dataclasses import replace
+
+        import jax.numpy as jnp
+        import numpy as np
+
+        from tse1m_tpu.cluster import cluster_sessions_resumable
+        from tse1m_tpu.cluster.encode import quantize_ids
+        from tse1m_tpu.cluster.schemes import (expand_weighted,
+                                               make_params,
+                                               scheme_hash_evals,
+                                               scheme_host_signatures,
+                                               scheme_sig_and_keys)
+        from tse1m_tpu.data.synth import synth_session_hitcounts
+
+        sn = int(os.environ.get("BENCH_SCHEMES_N",
+                                min(args.n, 200_000)))
+        base, struth = synth_session_sets(sn, set_size=args.set_size,
+                                          seed=args.seed + 17)
+        weights = synth_session_hitcounts(base, struth,
+                                          seed=args.seed + 17)
+        out = {"schemes_round_n": sn}
+        evals = {}
+        for scheme in ("kminhash", "cminhash", "weighted"):
+            rows = (expand_weighted(base, weights)
+                    if scheme == "weighted" else base)
+            prm = replace(params, scheme=scheme, sig_store=None,
+                          prefilter="off")
+            hp = make_params(scheme, prm.n_hashes, prm.seed)
+            # Clustering quality + wall (median of 2 after a warm run).
+            cluster_sessions(rows, prm)
+            walls = []
+            for _ in range(2):
+                t0 = time.perf_counter()
+                lab = cluster_sessions(rows, prm)
+                walls.append(time.perf_counter() - t0)
+            out[f"scheme_{scheme}_wall_s"] = round(
+                statistics.median(walls), 4)
+            out[f"scheme_{scheme}_ari_vs_planted"] = round(
+                adjusted_rand_index(lab, struth), 5)
+            evals[scheme] = scheme_hash_evals(scheme, rows.shape[0],
+                                              rows.shape[1], prm.n_hashes)
+            out[f"scheme_{scheme}_sig_hash_evals"] = evals[scheme]
+            # Host/device/pallas bit-parity across the quantization
+            # rungs the degradation ladder can land on (None/10/8-bit
+            # universes — a mid-run quant drop re-hashes in the smaller
+            # universe, so parity must hold at every rung).
+            parity = True
+            sample = rows[:4096]
+            for qb in (0, 10, 8):
+                sub = quantize_ids(sample, qb) if qb else sample
+                want = scheme_host_signatures(sub, hp)
+                got, _ = scheme_sig_and_keys(jnp.asarray(sub),
+                                             hp.device(), prm.n_bands,
+                                             use_pallas="never")
+                pall, _ = scheme_sig_and_keys(jnp.asarray(sub),
+                                              hp.device(), prm.n_bands,
+                                              use_pallas="interpret")
+                parity &= bool(np.array_equal(want, np.asarray(got)))
+                parity &= bool(np.array_equal(want, np.asarray(pall)))
+            out[f"scheme_{scheme}_sig_parity"] = parity
+            # Resume parity: a checkpointed run, then a resume against
+            # the committed shards — labels must match the direct run.
+            with tempfile.TemporaryDirectory() as ck:
+                sl = rows[:min(sn, 50_000)]
+                r1 = cluster_sessions_resumable(sl, prm,
+                                                checkpoint_dir=ck,
+                                                cleanup=False)
+                r2 = cluster_sessions_resumable(sl, prm,
+                                                checkpoint_dir=ck)
+            out[f"scheme_{scheme}_resume_parity"] = bool(
+                np.array_equal(r1, r2)
+                and np.array_equal(r1, cluster_sessions(sl, prm)))
+            # Estimator error vs exact Jaccard over planted pairs —
+            # host signatures only for the SAMPLED pair rows (the
+            # kminhash oracle broadcasts [rows, S, H]; 20k rows would
+            # be a 13 GB temporary).
+            uniq, counts = np.unique(struth, return_counts=True)
+            rng = np.random.default_rng(args.seed)
+            labs = rng.choice(uniq[counts >= 2],
+                              size=min(128, int((counts >= 2).sum())),
+                              replace=False)
+            pairs = [np.flatnonzero(struth == lab_id)[:2]
+                     for lab_id in labs]
+            need = np.unique(np.concatenate(pairs))
+            pos = {int(i): p for p, i in enumerate(need)}
+            hs = scheme_host_signatures(rows[need], hp)
+            errs = []
+            for a_i, b_i in pairs:
+                sa = set(rows[a_i].tolist())
+                sb = set(rows[b_i].tolist())
+                j = len(sa & sb) / len(sa | sb)
+                est = float((hs[pos[int(a_i)]]
+                             == hs[pos[int(b_i)]]).mean())
+                errs.append(abs(est - j))
+            out[f"scheme_{scheme}_est_err_mean"] = round(
+                float(np.mean(errs)), 5)
+        out["scheme_hash_eval_ratio_cminhash"] = round(
+            evals["kminhash"] / max(evals["cminhash"], 1), 1)
+        out["scheme_label_quality_delta"] = round(
+            abs(out["scheme_kminhash_ari_vs_planted"]
+                - out["scheme_cminhash_ari_vs_planted"]), 5)
+        return out
+
     warm_stats = {}
     if args.sig_store:
         warm_stats = bench_warm_store()
@@ -852,6 +994,10 @@ def main() -> int:
     if args.serve:
         serve_stats = bench_serve()
 
+    scheme_stats = {}
+    if args.schemes_round:
+        scheme_stats = bench_schemes()
+
     ari = adjusted_rand_index(labels, truth)
     ari_host = None
     if args.ari_sample > 0:
@@ -863,7 +1009,8 @@ def main() -> int:
         k = min(args.ari_sample, args.n)
         dev_k = cluster_sessions(items[:k], params)
         host_k = host_cluster(items[:k], n_hashes=args.hashes,
-                              n_bands=args.bands, seed=params.seed)
+                              n_bands=args.bands, seed=params.seed,
+                              scheme=params.scheme)
         ari_host = round(adjusted_rand_index(dev_k, host_k), 5)
 
     result = {
@@ -897,6 +1044,8 @@ def main() -> int:
         result["wire_drift_bytes"] = wire_drift
     result.update(warm_stats)
     result.update(serve_stats)
+    result.update(scheme_stats)
+    result["scheme"] = params.scheme
     if sanitizer is not None:
         # Runtime-sanitizer proof for this bench round: the timed window
         # ran under the transfer guard (zero implicit H2D transfers, or it

@@ -382,12 +382,44 @@ def seat_serve_kill(store: str) -> dict:
             "store_scrub_quarantined": 0}
 
 
+def seat_scheme_smoke(store: str) -> dict:
+    """Signature-scheme family smoke (tier-1 speed): the sanitized 2k
+    bench under ``--scheme cminhash`` with the scheme-comparison round
+    on and one injected RESOURCE_EXHAUSTED — the BENCH_r09 contract at
+    CI scale.  Asserts >=4x fewer hash evaluations for C-MinHash at
+    equal n_hashes, per-scheme host/device/pallas signature bit-parity
+    across the quantization rungs + a checkpointed resume, clustering-
+    quality parity between families, and that the degradation ladder
+    still fires (and heals with label parity — run_bench's ARI gate)
+    under the non-default scheme."""
+    plan = {"rules": [plan_rule("pipeline.h2d", kind="raise",
+                                message="RESOURCE_EXHAUSTED: injected "
+                                        "1GiB allocation failure",
+                                times=1)]}
+    r = run_bench(store, plan, env_extra={"BENCH_SCHEME": "cminhash",
+                                          "BENCH_SCHEMES": "1",
+                                          "BENCH_SCHEMES_N": "2000"})
+    assert r["scheme"] == "cminhash", r
+    assert r["scheme_hash_eval_ratio_cminhash"] >= 4, r
+    for s in ("kminhash", "cminhash", "weighted"):
+        assert r[f"scheme_{s}_sig_parity"] is True, (s, r)
+        assert r[f"scheme_{s}_resume_parity"] is True, (s, r)
+    assert r["scheme_label_quality_delta"] <= 0.02, r
+    # One RESOURCE_EXHAUSTED answers with the FIRST applicable rung —
+    # the b-bit quant drop on a storeless stream, chunk halving
+    # otherwise; either proves the ladder ran under the scheme.
+    assert (r["degradation_counts"].get("quant_drop", 0) >= 1
+            or r["degradation_counts"].get("chunk_halving", 0) >= 1), r
+    return r
+
+
 SEATS = {"stall": seat_stall, "oom": seat_oom, "kill": seat_kill,
          "corrupt-shard": seat_corrupt_shard, "hostloss": seat_hostloss,
          "heartbeat-timeout": seat_heartbeat_timeout,
          "zombie": seat_zombie,
          "leader-loss-promote": seat_leader_loss_promote,
-         "serve-kill": seat_serve_kill}
+         "serve-kill": seat_serve_kill,
+         "scheme-smoke": seat_scheme_smoke}
 
 
 def main() -> int:

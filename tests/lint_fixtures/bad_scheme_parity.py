@@ -1,0 +1,22 @@
+"""Fixture: raw signature-kernel calls outside the scheme registry."""
+import numpy as np
+
+from tse1m_tpu.cluster.host import host_signatures
+from tse1m_tpu.cluster.minhash import minhash_signatures
+from tse1m_tpu.cluster.minhash_pallas import cminhash_and_keys, minhash_and_keys
+
+
+def ingest(rows, a, b):
+    # BAD: hard-codes the kminhash family — a cminhash/weighted run
+    # would silently verify against the wrong kernel.
+    sig = minhash_signatures(rows, a, b)
+    host = host_signatures(np.asarray(rows), a, b)
+    return sig, host
+
+
+def fused(rows, a, b, n_bands):
+    return minhash_and_keys(rows, a, b, n_bands)
+
+
+def fused_cm(rows, consts, n_bands):
+    return cminhash_and_keys(rows, *consts, n_bands)

@@ -1,0 +1,12 @@
+"""Fixture: signature computation dispatched through the scheme registry."""
+import numpy as np
+
+from tse1m_tpu.cluster.schemes import (make_params, scheme_host_signatures,
+                                       scheme_sig_and_keys)
+
+
+def ingest(rows, scheme, n_hashes, seed, n_bands):
+    hp = make_params(scheme, n_hashes, seed)
+    sig, keys = scheme_sig_and_keys(rows, hp.device(), n_bands)
+    host = scheme_host_signatures(np.asarray(rows), hp)
+    return sig, keys, host

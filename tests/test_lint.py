@@ -58,6 +58,10 @@ def _rule_findings(rule: str, filename: str, relpath: str | None = None):
     # swallowing an InjectedFault into a JSON string is not.
     ("broad-except", "bad_serve_handler.py", "good_serve_handler.py",
      None),
+    # Signature computation must dispatch through the scheme registry
+    # (cluster/schemes.py), never call a raw kernel family directly.
+    ("scheme-parity", "bad_scheme_parity.py", "good_scheme_parity.py",
+     "tse1m_tpu/serve/fixture.py"),
 ])
 def test_rule_bad_fires_good_silent(rule, bad, good, spoof):
     assert _rule_findings(rule, bad, spoof), f"{rule} missed {bad}"
@@ -86,6 +90,21 @@ def test_wire_layer_admits_wire_v3_seats():
         assert not _rule_findings("wire-layer", "bad_wire_layer.py", seat)
     assert _rule_findings("wire-layer", "bad_wire_layer.py",
                           "tse1m_tpu/cluster/kernels/rans.py")
+
+
+def test_scheme_parity_kernel_modules_exempt():
+    # The kernel-defining modules are the implementation of the plane —
+    # raw calls there are the point; anywhere else they are a parity bug.
+    for seat in ("tse1m_tpu/cluster/schemes.py",
+                 "tse1m_tpu/cluster/minhash.py",
+                 "tse1m_tpu/cluster/minhash_pallas.py",
+                 "tse1m_tpu/cluster/host.py"):
+        assert not _rule_findings("scheme-parity", "bad_scheme_parity.py",
+                                  seat)
+    found = _rule_findings("scheme-parity", "bad_scheme_parity.py",
+                           "tse1m_tpu/cluster/pipeline.py")
+    # one finding per raw kernel call site in the fixture
+    assert len(found) == 4
 
 
 def test_nondeterminism_scoped_to_replay_planes():

@@ -1,0 +1,386 @@
+"""Signature kernel family (cluster/schemes.py): per-scheme host/device/
+pallas bit-parity, estimator convergence to exact Jaccard within theory
+bounds (hypothesis), weighted replica-expansion semantics, mixed-scheme
+policy refusals + the absent-key migration default (store, checkpoint,
+serve), and the live index's LSM delta band tables."""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tse1m_tpu.cluster import (ClusterParams, adjusted_rand_index,
+                               cluster_sessions,
+                               cluster_sessions_resumable, host_cluster)
+from tse1m_tpu.cluster import incremental as inc
+from tse1m_tpu.cluster.host import host_band_keys
+from tse1m_tpu.cluster.schemes import (MAX_WEIGHT, SCHEMES, expand_weighted,
+                                       get_scheme, make_params,
+                                       scheme_hash_evals,
+                                       scheme_host_signatures,
+                                       scheme_sig_and_keys)
+from tse1m_tpu.data.synth import synth_session_hitcounts, synth_session_sets
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    return synth_session_sets(1500, seed=3)
+
+
+def _exact_jaccard(x: np.ndarray, y: np.ndarray) -> float:
+    sx, sy = set(x.tolist()), set(y.tolist())
+    return len(sx & sy) / len(sx | sy)
+
+
+# -- bit-parity: host oracle == jax reference == pallas, per scheme ----------
+
+@pytest.mark.parametrize("scheme", SCHEMES)
+def test_host_device_pallas_bit_parity(scheme):
+    rng = np.random.default_rng(11)
+    dense = rng.integers(0, 1 << 24, size=(257, 48), dtype=np.uint32)
+    sparse = rng.integers(0, 1 << 24, size=(63, 3), dtype=np.uint32)
+    hp = make_params(scheme, 128, 5)
+    hpd = hp.device()
+    for rows in (dense, sparse):
+        host = scheme_host_signatures(rows, hp)
+        sig_j, keys_j = scheme_sig_and_keys(jnp.asarray(rows), hpd, 16,
+                                            use_pallas="never")
+        sig_p, keys_p = scheme_sig_and_keys(jnp.asarray(rows), hpd, 16,
+                                            use_pallas="interpret")
+        assert np.array_equal(host, np.asarray(sig_j))
+        assert np.array_equal(host, np.asarray(sig_p))
+        assert np.array_equal(np.asarray(keys_j), np.asarray(keys_p))
+        assert np.array_equal(host_band_keys(host, 16),
+                              np.asarray(keys_j))
+
+
+def test_schemes_are_distinct_families():
+    rng = np.random.default_rng(2)
+    rows = rng.integers(0, 1 << 24, size=(16, 32), dtype=np.uint32)
+    sigs = {s: scheme_host_signatures(rows, make_params(s, 64, 0))
+            for s in SCHEMES}
+    assert not np.array_equal(sigs["kminhash"], sigs["cminhash"])
+    assert not np.array_equal(sigs["cminhash"], sigs["weighted"])
+
+
+def test_kminhash_params_bit_compatible_with_legacy():
+    # The kminhash constant stream must equal minhash.make_hash_params
+    # exactly — stores/checkpoints written before the registry existed
+    # hold signatures of THESE constants.
+    from tse1m_tpu.cluster.minhash import make_hash_params
+
+    a, b = make_hash_params(96, 13)
+    hp = make_params("kminhash", 96, 13)
+    assert np.array_equal(hp.arrays[0], a)
+    assert np.array_equal(hp.arrays[1], b)
+
+
+def test_unknown_scheme_refuses(corpus):
+    with pytest.raises(ValueError, match="unknown signature scheme"):
+        get_scheme("simhash")
+    items, _ = corpus
+    with pytest.raises(ValueError, match="unknown signature scheme"):
+        cluster_sessions(items[:64], ClusterParams(scheme="simhash"))
+
+
+def test_hash_eval_accounting():
+    assert scheme_hash_evals("kminhash", 1000, 64, 128) == 1000 * 64 * 128
+    assert scheme_hash_evals("cminhash", 1000, 64, 128) == 1000 * 64
+    ratio = (scheme_hash_evals("kminhash", 1, 64, 128)
+             / scheme_hash_evals("cminhash", 1, 64, 128))
+    assert ratio == 128
+
+
+# -- estimator convergence (theory-bound property tests) ---------------------
+
+def _est_error(scheme: str, n_hashes: int, set_size: int, n_shared: int,
+               seed: int) -> tuple[float, float]:
+    rng = np.random.default_rng(seed)
+    base = rng.integers(0, 1 << 24, size=set_size, dtype=np.uint32)
+    x = base.copy()
+    y = base.copy()
+    nm = set_size - n_shared
+    if nm:
+        y[:nm] = rng.integers(0, 1 << 24, size=nm, dtype=np.uint32)
+    j = _exact_jaccard(x, y)
+    sig = scheme_host_signatures(np.stack([x, y]),
+                                 make_params(scheme, n_hashes, seed))
+    return float((sig[0] == sig[1]).mean()), j
+
+
+@pytest.mark.parametrize("scheme", ["kminhash", "cminhash"])
+def test_estimator_converges_to_exact_jaccard(scheme):
+    # Mean absolute error over independent seeds stays within the
+    # binomial-theory envelope (std/sqrt(trials) head-room x4): the
+    # densified one-permutation estimator is unbiased, not just "close".
+    h, trials = 256, 24
+    errs = []
+    for t in range(trials):
+        est, j = _est_error(scheme, h, 64, 40, 100 + t)
+        errs.append(est - j)
+    bound = 4.0 * np.sqrt(0.25 / h) / np.sqrt(trials) + 0.01
+    assert abs(float(np.mean(errs))) < bound, (np.mean(errs), bound)
+
+
+def test_cminhash_densification_sparse_rows_still_estimate():
+    # |S| << H: most bins are empty and the estimate rides the
+    # densification walk + circulant fallback; it must stay calibrated.
+    h, trials = 128, 30
+    errs = []
+    for t in range(trials):
+        est, j = _est_error("cminhash", h, 6, 4, 500 + t)
+        errs.append(est - j)
+    assert abs(float(np.mean(errs))) < 0.06, np.mean(errs)
+
+
+try:
+    from hypothesis import given, settings, strategies as st
+
+    @settings(deadline=None, max_examples=20)
+    @given(n_shared=st.integers(8, 60), seed=st.integers(0, 10_000))
+    def test_cminhash_estimate_within_bounds_hypothesis(n_shared, seed):
+        h = 256
+        est, j = _est_error("cminhash", h, 64, n_shared, seed)
+        # Single-pair concentration: 6 sigma of the H-trial binomial
+        # plus a small densification allowance — a miscalibrated kernel
+        # (the collapsed-donor-map bug this suite exists to catch)
+        # misses this by an order of magnitude.
+        assert abs(est - j) <= 6.0 * np.sqrt(max(j * (1 - j), 0.01) / h) \
+            + 0.04, (est, j)
+except ImportError:  # pragma: no cover - environment without hypothesis
+    @pytest.mark.skip(reason="property tests need hypothesis")
+    def test_cminhash_estimate_within_bounds_hypothesis():
+        pass
+
+
+# -- weighted minwise --------------------------------------------------------
+
+def test_expand_weighted_semantics():
+    items = np.array([[10, 20, 30]], np.uint32)
+    w = np.array([[2, 0, 12]], np.uint32)  # 0 clips to 1, 12 clips to 8
+    out = expand_weighted(items, w)
+    assert out.shape == (1, 2 + 1 + MAX_WEIGHT)
+    from tse1m_tpu.cluster.schemes import _REPLICA_MULT
+
+    m = int(_REPLICA_MULT)
+    want = {(10 * m) & 0xFFFFFFFF, (10 * m + 1) & 0xFFFFFFFF,
+            (20 * m) & 0xFFFFFFFF} | {
+        (30 * m + r) & 0xFFFFFFFF for r in range(MAX_WEIGHT)}
+    assert set(out[0].tolist()) == want
+
+
+def test_expand_weighted_padding_is_signature_neutral():
+    # Rows pad with duplicates of their own first replica; duplicates
+    # never move a min, so signatures of [row] and [row + pad] agree.
+    rng = np.random.default_rng(5)
+    items = rng.integers(0, 1 << 24, size=(1, 16), dtype=np.uint32)
+    w = rng.integers(1, MAX_WEIGHT + 1, size=(1, 16), dtype=np.uint32)
+    exp = expand_weighted(items, w)
+    padded = np.concatenate([exp, exp[:, :1].repeat(7, axis=1)], axis=1)
+    hp = make_params("weighted", 128, 0)
+    assert np.array_equal(scheme_host_signatures(exp, hp),
+                          scheme_host_signatures(padded, hp))
+
+
+def test_weighted_estimator_matches_weighted_jaccard():
+    rng = np.random.default_rng(9)
+    ids = rng.integers(0, 1 << 24, size=40, dtype=np.uint32)
+    wx = rng.integers(1, MAX_WEIGHT + 1, size=40)
+    wy = wx.copy()
+    wy[:10] = rng.integers(1, MAX_WEIGHT + 1, size=10)
+    jw = np.minimum(wx, wy).sum() / np.maximum(wx, wy).sum()
+    rows = expand_weighted(np.stack([ids, ids]), np.stack([wx, wy]))
+    sig = scheme_host_signatures(rows, make_params("weighted", 512, 3))
+    est = float((sig[0] == sig[1]).mean())
+    assert abs(est - jw) <= 6.0 * np.sqrt(jw * (1 - jw) / 512) + 0.04
+
+
+def test_synth_hitcounts_cluster_profile(corpus):
+    items, truth = corpus
+    w = synth_session_hitcounts(items, truth, seed=1)
+    assert w.shape == items.shape and w.dtype == np.uint32
+    assert w.min() >= 1 and w.max() <= MAX_WEIGHT
+    # members of one planted cluster share the count profile
+    lab = truth[0]
+    members = np.flatnonzero(truth == lab)
+    if members.size >= 2:
+        agree = (w[members[0]] == w[members[1]]).mean()
+        assert agree >= 0.8, agree
+
+
+def test_weighted_cluster_end_to_end(corpus):
+    items, truth = corpus
+    w = synth_session_hitcounts(items, truth, seed=2)
+    rows = expand_weighted(items, w)
+    prm = ClusterParams(scheme="weighted", prefilter="off")
+    labels = cluster_sessions(rows, prm)
+    assert adjusted_rand_index(labels, truth) > 0.9
+    host = host_cluster(rows[:400], scheme="weighted")
+    dev = cluster_sessions(rows[:400], prm)
+    assert adjusted_rand_index(dev, host) == 1.0
+
+
+# -- policy plumbing: store, checkpoint, serve -------------------------------
+
+def _store_run(tmp_path, scheme: str, n: int = 600):
+    items, truth = synth_session_sets(n, seed=4)
+    if scheme == "weighted":
+        items = expand_weighted(
+            items, synth_session_hitcounts(items, truth, seed=4))
+    store = str(tmp_path / "store")
+    prm = ClusterParams(scheme=scheme, sig_store=store)
+    labels = cluster_sessions(items, prm)
+    return items, labels, store, prm
+
+
+@pytest.mark.parametrize("other", ["cminhash", "weighted"])
+def test_mixed_scheme_store_refuses(tmp_path, other):
+    items, _, store, _ = _store_run(tmp_path, "kminhash")
+    with pytest.raises(ValueError, match="scheme"):
+        cluster_sessions(items, ClusterParams(scheme=other,
+                                              sig_store=store))
+
+
+def test_legacy_store_manifest_opens_as_kminhash(tmp_path):
+    from tse1m_tpu.cluster.store import SignatureStore
+
+    items, labels, store, prm = _store_run(tmp_path, "kminhash")
+    # Simulate a pre-scheme store: strip the key the old code never wrote.
+    mpath = os.path.join(store, "store_manifest.json")
+    with open(mpath, encoding="utf-8") as f:
+        manifest = json.load(f)
+    assert manifest["policy"]["scheme"] == "kminhash"  # explicit on write
+    manifest["policy"].pop("scheme")
+    with open(mpath, "w", encoding="utf-8") as f:
+        json.dump(manifest, f)
+    # kminhash opens (migration default) and the warm run still matches.
+    warm = cluster_sessions(items, prm)
+    assert np.array_equal(warm, labels)
+    with open(mpath, encoding="utf-8") as f:
+        rewritten = json.load(f)
+    assert rewritten["policy"]["scheme"] == "kminhash"
+    # ...but a cminhash open refuses on the (defaulted) scheme key.
+    with pytest.raises(ValueError, match="scheme"):
+        SignatureStore(store, {"n_hashes": prm.n_hashes, "seed": prm.seed,
+                               "quant_bits": 0, "scheme": "cminhash"})
+
+
+def test_checkpoint_scheme_refusal_and_migration(tmp_path):
+    items, _ = synth_session_sets(400, seed=6)
+    ck = str(tmp_path / "ckpt")
+    prm = ClusterParams(scheme="kminhash", prefilter="off")
+    labels = cluster_sessions_resumable(items, prm, checkpoint_dir=ck,
+                                        cleanup=False)
+    with pytest.raises(ValueError, match="scheme"):
+        cluster_sessions_resumable(items,
+                                   ClusterParams(scheme="cminhash",
+                                                 prefilter="off"),
+                                   checkpoint_dir=ck, cleanup=False)
+    # Legacy manifest (no scheme key) resumes under kminhash.
+    mpath = os.path.join(ck, "manifest.json")
+    with open(mpath, encoding="utf-8") as f:
+        manifest = json.load(f)
+    manifest.pop("scheme")
+    with open(mpath, "w", encoding="utf-8") as f:
+        json.dump(manifest, f)
+    resumed = cluster_sessions_resumable(items, prm, checkpoint_dir=ck)
+    assert np.array_equal(resumed, labels)
+
+
+def test_serve_daemon_adopts_store_scheme(tmp_path):
+    from tse1m_tpu.serve.daemon import ServeDaemon
+
+    items, labels, store, _ = _store_run(tmp_path, "cminhash")
+    daemon = ServeDaemon(store)  # default params say kminhash
+    try:
+        assert daemon.params.scheme == "cminhash"
+        assert daemon.store.policy["scheme"] == "cminhash"
+        # Query known rows: answers come from the committed state and
+        # must match the batch run's labels elementwise.
+        r = daemon.query(items[:128])
+        assert np.array_equal(np.asarray(r["labels"]), labels[:128])
+        # Novel-vector path host-MinHashes under the adopted scheme —
+        # a mutated member must land in its cluster, same as batch.
+        mut = items[64:65].copy()
+        mut[0, -1] ^= np.uint32(1)
+        q = daemon.query(mut)["labels"][0]
+        cold = cluster_sessions(np.concatenate([items, mut]),
+                                ClusterParams(scheme="cminhash"))
+        assert q == cold[-1] or q == -1 and cold[-1] == items.shape[0]
+    finally:
+        daemon.stop(commit=False)
+
+
+# -- LiveClusterIndex LSM delta band tables ----------------------------------
+
+def _mini_index_rows(n: int, seed: int):
+    items, _ = synth_session_sets(n, seed=seed, set_size=24)
+    hp = make_params("kminhash", 64, 0)
+    sigs = scheme_host_signatures(items, hp)
+    keys = host_band_keys(sigs, 8)
+    return items, sigs, keys
+
+
+def _absorb_all(index, sigs, keys, batch: int):
+    gather = lambda uniq: sigs[uniq]  # noqa: E731
+    for lo in range(0, sigs.shape[0], batch):
+        index = index.absorb(keys[lo:lo + batch], sigs[lo:lo + batch],
+                             gather, 64, 0.5)
+    return index
+
+
+def test_live_index_delta_runs_accumulate_and_consolidate(monkeypatch):
+    monkeypatch.setenv("TSE1M_LIVE_DELTA_RUNS", "3")
+    _, sigs, keys = _mini_index_rows(400, 8)
+    index = inc.LiveClusterIndex.empty(8)
+    seen_runs = 0
+    gather = lambda uniq: sigs[uniq]  # noqa: E731
+    for lo in range(0, 400, 80):
+        index = index.absorb(keys[lo:lo + 80], sigs[lo:lo + 80],
+                             gather, 64, 0.5)
+        seen_runs = max(seen_runs, len(index.band_deltas))
+    assert seen_runs >= 1          # deltas actually used
+    assert len(index.band_deltas) < 3   # ...and consolidation fired
+    # Consolidated view == ground-truth tables over all keys.
+    bk, br = index.band_tables()
+    want_bk, want_br = inc.build_band_tables(keys)
+    for b in range(8):
+        assert np.array_equal(bk[b], want_bk[b])
+        assert np.array_equal(br[b], want_br[b])
+
+
+def test_live_index_delta_labels_match_batch(monkeypatch):
+    items, sigs, keys = _mini_index_rows(300, 9)
+    monkeypatch.setenv("TSE1M_LIVE_DELTA_RUNS", "100")  # never consolidate
+    with_deltas = _absorb_all(inc.LiveClusterIndex.empty(8), sigs, keys, 30)
+    monkeypatch.setenv("TSE1M_LIVE_DELTA_RUNS", "1")    # always consolidate
+    consolidated = _absorb_all(inc.LiveClusterIndex.empty(8), sigs, keys, 30)
+    assert with_deltas.band_deltas and not consolidated.band_deltas
+    assert np.array_equal(with_deltas.labels, consolidated.labels)
+    # Batch-level contract: absorb == cold host clustering, elementwise.
+    cold = host_cluster(items, n_hashes=64, n_bands=8, seed=0)
+    assert np.array_equal(with_deltas.labels.astype(np.int64), cold)
+
+
+def test_live_index_delta_query_parity(monkeypatch):
+    items, sigs, keys = _mini_index_rows(300, 10)
+    monkeypatch.setenv("TSE1M_LIVE_DELTA_RUNS", "100")
+    deltas = _absorb_all(inc.LiveClusterIndex.empty(8), sigs, keys, 30)
+    monkeypatch.setenv("TSE1M_LIVE_DELTA_RUNS", "1")
+    solid = _absorb_all(inc.LiveClusterIndex.empty(8), sigs, keys, 30)
+    # Novel query vectors (mutations of index rows) answer identically
+    # whether their band keys land in the base table or a delta run.
+    mut = items[::17].copy()
+    mut[:, 0] ^= np.uint32(3)
+    hp = make_params("kminhash", 64, 0)
+    qs = scheme_host_signatures(mut, hp)
+    qk = host_band_keys(qs, 8)
+    gather = lambda uniq: sigs[uniq]  # noqa: E731
+    a = deltas.query_labels(qs, qk, gather, 64, 0.5)
+    b = solid.query_labels(qs, qk, gather, 64, 0.5)
+    assert np.array_equal(a, b)

@@ -564,9 +564,21 @@ def _run_cluster_step(args, sig_store: str | None,
     from .parallel import multihost
 
     items, truth = synth_session_sets(args.n, seed=args.seed)
+    scheme = getattr(args, "scheme", "kminhash")
     params = ClusterParams(seed=args.seed, sig_store=sig_store,
                            prefilter=getattr(args, "prefilter", "auto"),
-                           entropy=getattr(args, "entropy", "auto"))
+                           entropy=getattr(args, "entropy", "auto"),
+                           scheme=scheme)
+    if scheme == "weighted":
+        # The weighted workload consumes per-edge hit counts: expand
+        # (id, count) into replica ids host-side (schemes.expand_weighted)
+        # and feed the replica rows through the unchanged pipeline —
+        # signatures then estimate weighted Jaccard exactly.
+        from .cluster.schemes import expand_weighted
+        from .data.synth import synth_session_hitcounts
+
+        weights = synth_session_hitcounts(items, truth, seed=args.seed)
+        items = expand_weighted(items, weights)
     pod_report: dict = {}
     if pod_route:
         # Pod path: per-host digest-range sharded store + supervision,
@@ -636,7 +648,8 @@ def _run_cluster_step(args, sig_store: str | None,
         from dataclasses import replace
 
         host_k = host_cluster(items[:k], n_hashes=params.n_hashes,
-                              n_bands=params.n_bands, seed=params.seed)
+                              n_bands=params.n_bands, seed=params.seed,
+                              scheme=params.scheme)
         # The subsample re-cluster must NOT touch the store: committing
         # state for a k-row prefix would clobber the full run's state.
         dev_k = (labels if k == args.n else
@@ -693,11 +706,21 @@ def _cmd_scrub(args) -> int:
             store = SignatureStore.open_existing(directory)
         report = store.scrub(repair=args.repair, compact=args.compact)
         if args.verify_sigs:
-            from .data.synth import synth_session_sets
+            from .data.synth import synth_session_hitcounts, \
+                synth_session_sets
 
-            items, _ = synth_session_sets(args.verify_n,
-                                          set_size=args.verify_set_size,
-                                          seed=args.verify_seed)
+            items, truth = synth_session_sets(
+                args.verify_n, set_size=args.verify_set_size,
+                seed=args.verify_seed)
+            if store.policy.get("scheme") == "weighted":
+                # A weighted store caches signatures of replica-expanded
+                # rows; verify must present the same expansion or every
+                # probe would miss and the check would be vacuous.
+                from .cluster.schemes import expand_weighted
+
+                items = expand_weighted(
+                    items, synth_session_hitcounts(items, truth,
+                                                   seed=args.verify_seed))
             report.update(store.verify_signatures(
                 items, sample=args.verify_sample, seed=args.verify_seed))
         report["store_scrub_dir"] = directory
@@ -981,6 +1004,18 @@ def main(argv=None) -> int:
                         "'auto' entropy-codes wire lanes that beat their "
                         "bit-packed form, per chunk/lane; 'force' codes "
                         "everything (testing)")
+    p.add_argument("--scheme", default="kminhash",
+                   choices=("kminhash", "cminhash", "weighted"),
+                   help="signature kernel family (cluster/schemes.py): "
+                        "'kminhash' = K-permutation multiply-shift (the "
+                        "original family, default); 'cminhash' = one-"
+                        "permutation C-MinHash + densification (~H x "
+                        "fewer hash evaluations per row); 'weighted' = "
+                        "exact weighted minwise over per-edge hit counts "
+                        "(replica expansion; a NEW workload — the paper "
+                        "models set membership only). Joins the store/"
+                        "checkpoint policy tuple: mixed-scheme stores "
+                        "refuse like mixed-seed stores")
     p.set_defaults(fn=_cmd_cluster)
 
     args = ap.parse_args(argv)

@@ -18,6 +18,7 @@ from .minhash import band_keys, make_hash_params, minhash_signatures
 from .host import host_cluster
 from .pipeline import (ClusterParams, cluster_sessions,
                        cluster_sessions_pod, cluster_sessions_resumable)
+from .schemes import SCHEMES, expand_weighted, make_params
 
 __all__ = [
     "adjusted_rand_index",
@@ -29,4 +30,7 @@ __all__ = [
     "cluster_sessions",
     "cluster_sessions_pod",
     "cluster_sessions_resumable",
+    "SCHEMES",
+    "expand_weighted",
+    "make_params",
 ]

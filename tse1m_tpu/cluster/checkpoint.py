@@ -68,6 +68,10 @@ class ClusterCheckpoint:
             "n_hashes": params.n_hashes,
             "n_bands": params.n_bands,
             "seed": params.seed,
+            # Signature scheme (cluster/schemes.py): shards hold this
+            # kernel family's signatures, so a resume under a different
+            # scheme must refuse like any policy change.
+            "scheme": getattr(params, "scheme", "kminhash"),
             "step": int(step),
             # Shape-affecting facts beyond (items, params) — e.g. the delta
             # encoder's lane split, which decides what each chunk contains.
@@ -83,6 +87,11 @@ class ClusterCheckpoint:
             # means the shards hold different rows — refuse, don't load.
             prior_meta = {k: v for k, v in prior.items()
                           if k not in ("chunks_done", "chunk_crcs")}
+            # Migration default: a manifest written before schemes
+            # existed holds kminhash shards by definition — it must
+            # RESUME under scheme="kminhash", not refuse on a key it
+            # could not have known.
+            prior_meta.setdefault("scheme", "kminhash")
             if prior_meta != self.meta:
                 # The meta diff, not the raw dicts: a long chunks_done
                 # list would bury the one key that actually differs

@@ -11,7 +11,9 @@ from __future__ import annotations
 
 import numpy as np
 
-from .minhash import _FNV_OFFSET, _FNV_PRIME, make_hash_params
+from .minhash import _FNV_OFFSET, _FNV_PRIME
+
+_UMAX = np.uint32(0xFFFFFFFF)
 
 
 def host_signatures(items: np.ndarray, a: np.ndarray, b: np.ndarray,
@@ -27,6 +29,36 @@ def host_signatures(items: np.ndarray, a: np.ndarray, b: np.ndarray,
             hashed = blk[:, :, None] * a[None, None, :] + b[None, None, :]
             sig[lo:lo + chunk] = hashed.min(axis=1)
     return sig
+
+
+def host_cminhash_signatures(items: np.ndarray, a0, b0, jmap: np.ndarray,
+                             offs: np.ndarray,
+                             chunk: int = 65536) -> np.ndarray:
+    """[N, S] uint32 -> [N, H] uint32, identical to
+    minhash.cminhash_signatures: one permutation pass, bin-by-modulo
+    segment min, the same densification schedule, the same circulant
+    fallback.  Every operation is uint32 with natural wraparound, so
+    host and device agree bit-for-bit."""
+    items = np.ascontiguousarray(items, dtype=np.uint32)
+    n, s = items.shape
+    h = int(offs.shape[0])
+    t_rounds = int(jmap.shape[0])
+    out = np.empty((n, h), dtype=np.uint32)
+    with np.errstate(over="ignore"):
+        for lo in range(0, n, chunk):
+            blk = items[lo:lo + chunk]
+            bn = blk.shape[0]
+            u = blk * a0 + b0
+            bins = (u % np.uint32(h)).astype(np.intp)
+            v = np.full((bn, h), _UMAX, dtype=np.uint32)
+            rows = np.repeat(np.arange(bn, dtype=np.intp), blk.shape[1])
+            np.minimum.at(v, (rows, bins.ravel()), u.ravel())
+            for t in range(t_rounds):
+                cand = v[:, jmap[t]]
+                v = np.where((v == _UMAX) & (cand != _UMAX), cand, v)
+            fb = u.min(axis=1)[:, None] + offs[None, :]
+            out[lo:lo + chunk] = np.where(v == _UMAX, fb, v)
+    return out
 
 
 def host_band_keys(sig: np.ndarray, n_bands: int) -> np.ndarray:
@@ -67,10 +99,15 @@ class _UnionFind:
 
 
 def host_cluster(items: np.ndarray, n_hashes: int = 128, n_bands: int = 16,
-                 threshold: float = 0.5, seed: int = 0) -> np.ndarray:
-    """End-to-end host clustering; returns [N] int64 min-index labels."""
-    a, b = make_hash_params(n_hashes, seed)
-    sig = host_signatures(items, a, b)
+                 threshold: float = 0.5, seed: int = 0,
+                 scheme: str = "kminhash") -> np.ndarray:
+    """End-to-end host clustering; returns [N] int64 min-index labels.
+
+    ``scheme`` picks the signature kernel family (cluster/schemes.py);
+    for ``weighted`` the caller feeds already-expanded replica rows."""
+    from .schemes import make_params, scheme_host_signatures
+
+    sig = scheme_host_signatures(items, make_params(scheme, n_hashes, seed))
     keys = host_band_keys(sig, n_bands)
     n = items.shape[0]
     uf = _UnionFind(n)

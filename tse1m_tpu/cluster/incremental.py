@@ -27,9 +27,26 @@ device transfer.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 
 import numpy as np
+
+# LSM delta layer for the live band tables: past this many delta runs
+# an absorb consolidates them into the base arrays.  Mirrors the store's
+# probe-index delta layer (store._ProbeIndex): the BENCH_r08 GIL convoy
+# was the O(Kb) sorted-insert into every band's full table on the ingest
+# thread — with runs, an absorb touches O(batch log batch) per band and
+# the rare consolidation pays the big memcpy, bounding the query tail.
+_DELTA_RUNS_DEFAULT = 8
+
+
+def _delta_max_runs() -> int:
+    try:
+        return max(1, int(os.environ.get("TSE1M_LIVE_DELTA_RUNS",
+                                         _DELTA_RUNS_DEFAULT)))
+    except ValueError:
+        return _DELTA_RUNS_DEFAULT
 
 
 @dataclass
@@ -246,12 +263,19 @@ class LiveClusterIndex:
     n_rows: int
     labels: np.ndarray              # [n_rows] int32 min-orig-index labels
     locator: np.ndarray             # [n_rows, 2] int32 (shard, row) in store
-    band_keys_sorted: list          # per band: [Kb] uint32 distinct keys
-    band_reps: list                 # per band: [Kb] int32 min index per key
+    band_keys_sorted: list          # BASE per band: [Kb] uint32 distinct keys
+    band_reps: list                 # BASE per band: [Kb] int32 min index
     # Sorted 128-bit digest map (membership lookups).  Optional: the
     # batch warm path never queries by digest and skips building it.
     digest_keys: np.ndarray | None = field(default=None, repr=False)
     digest_rows: np.ndarray | None = field(default=None, repr=False)
+    # LSM delta runs over the band tables: each run is one absorbed
+    # generation's novel keys, (ks_per_band, reps_per_band) with every
+    # per-band array sorted; keys are distinct ACROSS runs and the base
+    # (a key is added only when no earlier source holds it).  Probes
+    # search base + runs; absorb appends a run instead of re-writing
+    # the base arrays, and consolidates past _delta_max_runs().
+    band_deltas: tuple = field(default=(), repr=False)
 
     # -- constructors --------------------------------------------------------
 
@@ -281,6 +305,97 @@ class LiveClusterIndex:
                    band_keys_sorted=list(state.band_keys_sorted),
                    band_reps=list(state.band_reps))
 
+    # -- band-table probing (base + LSM delta runs) --------------------------
+
+    def _band_sources(self, b: int):
+        yield self.band_keys_sorted[b], self.band_reps[b]
+        for run_ks, run_reps in self.band_deltas:
+            yield run_ks[b], run_reps[b]
+
+    def _probe_band(self, b: int, kb: np.ndarray
+                    ) -> tuple[np.ndarray, np.ndarray]:
+        """(hit [K] bool, rep [K] int32): binary-search the base table,
+        then each delta run — a key lives in exactly one source."""
+        k = kb.shape[0]
+        hit = np.zeros(k, bool)
+        rep = np.zeros(k, np.int32)
+        for ks, reps in self._band_sources(b):
+            if ks.shape[0] == 0:
+                continue
+            todo = np.flatnonzero(~hit)
+            if todo.size == 0:
+                break
+            q = kb[todo]
+            pos = np.searchsorted(ks, q)
+            inb = pos < ks.shape[0]
+            m = np.zeros(todo.size, bool)
+            m[inb] = ks[pos[inb]] == q[inb]
+            if m.any():
+                sel = todo[m]
+                hit[sel] = True
+                rep[sel] = reps[pos[m]]
+        return hit, rep
+
+    def _probe_new_keys(self, new_keys: np.ndarray, base_index: int):
+        """One pass per band over an appended batch: the candidate edge
+        list (exactly candidate_edges' semantics, against base+deltas)
+        AND the batch's novel-key delta run."""
+        k, n_bands = new_keys.shape
+        idx = np.arange(k, dtype=np.int64) + base_index
+        us, vs = [], []
+        run_ks, run_reps = [], []
+        for b in range(n_bands):
+            kb = new_keys[:, b]
+            hit, rep = self._probe_band(b, kb)
+            if hit.any():
+                us.append(idx[hit])
+                vs.append(rep[hit].astype(np.int64))
+            rest = np.flatnonzero(~hit)
+            if rest.size:
+                order = rest[np.argsort(kb[rest], kind="stable")]
+                ks2 = kb[order]
+                first = np.empty(order.size, bool)
+                first[0] = True
+                np.not_equal(ks2[1:], ks2[:-1], out=first[1:])
+                grp = np.cumsum(first) - 1
+                us.append(idx[order])
+                vs.append(idx[order[np.flatnonzero(first)][grp]])
+                run_ks.append(np.ascontiguousarray(ks2[first]))
+                run_reps.append((order[np.flatnonzero(first)]
+                                 + base_index).astype(np.int32))
+            else:
+                run_ks.append(np.empty(0, np.uint32))
+                run_reps.append(np.empty(0, np.int32))
+        if not us:
+            e = np.empty(0, np.int64)
+            u, v = e, e.copy()
+        else:
+            u = np.concatenate(us)
+            v = np.concatenate(vs)
+            keep = u != v
+            u, v = u[keep], v[keep]
+        return u, v, run_ks, run_reps
+
+    def band_tables(self) -> tuple[list, list]:
+        """Fully consolidated (band_keys_sorted, band_reps) — what the
+        persistence layer commits (store.save_state's format predates
+        the delta runs and stays one sorted array per band).  Pure; the
+        snapshot keeps its runs."""
+        if not self.band_deltas:
+            return list(self.band_keys_sorted), list(self.band_reps)
+        return self._consolidated()
+
+    def _consolidated(self) -> tuple[list, list]:
+        bk, br = [], []
+        for b in range(len(self.band_keys_sorted)):
+            parts = list(self._band_sources(b))
+            ks = np.concatenate([p[0] for p in parts])
+            reps = np.concatenate([p[1] for p in parts])
+            order = np.argsort(ks, kind="stable")
+            bk.append(np.ascontiguousarray(ks[order]))
+            br.append(np.ascontiguousarray(reps[order]))
+        return bk, br
+
     # -- ingest --------------------------------------------------------------
 
     def absorb(self, new_keys: np.ndarray, new_sigs: np.ndarray,
@@ -294,19 +409,21 @@ class LiveClusterIndex:
         band tables, verified with the device's signature-agreement
         rule, merged with union-by-min — labels elementwise-equal to a
         cold batch run over the union (see module docstring).  The
-        parent snapshot is untouched; unchanged band arrays are shared.
+        parent snapshot is untouched; the base band arrays are SHARED
+        with the parent (the batch's novel keys land in a new LSM delta
+        run) until the run count crosses the consolidation threshold.
         """
         n_old = self.n_rows
         k = int(new_keys.shape[0])
         if k == 0:
             return self
-        u, v = candidate_edges(self.band_keys_sorted, self.band_reps,
-                               new_keys, n_old)
+        u, v, run_ks, run_reps = self._probe_new_keys(new_keys, n_old)
         ok = verify_edges(u, v, new_sigs, n_old, gather_old_sigs,
                           n_hashes, threshold)
         labels = merge_labels(self.labels, u[ok], v[ok], n_old, k)
-        bk, br = extend_band_tables(self.band_keys_sorted, self.band_reps,
-                                    new_keys, n_old)
+        deltas = self.band_deltas
+        if any(a.size for a in run_ks):
+            deltas = deltas + ((run_ks, run_reps),)
         locator = self.locator
         if new_locator is not None:
             locator = np.concatenate(
@@ -314,10 +431,20 @@ class LiveClusterIndex:
         dk, dr = self.digest_keys, self.digest_rows
         if dk is not None and new_digests is not None:
             dk, dr = _merge_digest_map(dk, dr, new_digests, n_old)
-        return LiveClusterIndex(
+        out = LiveClusterIndex(
             generation=self.generation + 1, n_rows=n_old + k,
-            labels=labels, locator=locator, band_keys_sorted=bk,
-            band_reps=br, digest_keys=dk, digest_rows=dr)
+            labels=labels, locator=locator,
+            band_keys_sorted=self.band_keys_sorted,
+            band_reps=self.band_reps, digest_keys=dk, digest_rows=dr,
+            band_deltas=deltas)
+        if len(deltas) >= _delta_max_runs():
+            bk, br = out._consolidated()
+            out = LiveClusterIndex(
+                generation=out.generation, n_rows=out.n_rows,
+                labels=out.labels, locator=out.locator,
+                band_keys_sorted=bk, band_reps=br, digest_keys=dk,
+                digest_rows=dr, band_deltas=())
+        return out
 
     # -- queries (read-only; safe from any thread on one snapshot) ----------
 
@@ -345,19 +472,16 @@ class LiveClusterIndex:
                        ) -> tuple[np.ndarray, np.ndarray]:
         """Per-band bucket hubs for query vectors that are NOT index rows:
         [K, B] band keys -> (q [E], hub_row [E]) pairs — the rows a cold
-        run would test these vectors' signatures against."""
+        run would test these vectors' signatures against.  Probes the
+        base tables AND every LSM delta run (a key lives in exactly one
+        source, so the union of hits is the consolidated answer)."""
         k, n_bands = keys.shape
         qs, hubs = [], []
         for b in range(n_bands):
-            ks, reps = self.band_keys_sorted[b], self.band_reps[b]
-            kb = keys[:, b]
-            pos = np.searchsorted(ks, kb)
-            inb = pos < ks.shape[0]
-            hit = np.zeros(k, bool)
-            hit[inb] = ks[pos[inb]] == kb[inb]
+            hit, rep = self._probe_band(b, keys[:, b])
             if hit.any():
                 qs.append(np.flatnonzero(hit))
-                hubs.append(reps[pos[hit]].astype(np.int64))
+                hubs.append(rep[hit].astype(np.int64))
         if not qs:
             e = np.empty(0, np.int64)
             return e, e.copy()

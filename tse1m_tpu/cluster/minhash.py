@@ -1,16 +1,37 @@
-"""MinHash signatures + banded LSH keys (jax reference path).
+"""MinHash signature kernels + banded LSH keys (jax reference paths).
 
-Hash family: multiply-add over uint32 with natural wraparound —
-``h_i(x) = a_i * x + b_i (mod 2^32)`` with odd ``a_i``.  Multiply-shift
-universal hashing is integer-only, so everything rides the VPU; no
-float precision traps, bit-exact across CPU/TPU and vs the numpy host
-oracle (host.py), which shares the same parameters.
+Two signature kernels live here; the per-run choice is the ``scheme``
+policy field (cluster/schemes.py owns the registry and every dispatch):
 
-The signature kernel is deliberately a `fori_loop` over the (small, static)
-set dimension accumulating an elementwise min of `[N, H]` blocks: peak
-memory stays O(N*H) instead of the O(N*S*H) a broadcast formulation would
-materialise, and XLA fuses the multiply-add-min chain into one pass.
-A fused pallas VMEM-blocked variant lives in minhash_pallas.py.
+- **kminhash** — K independent multiply-add hashes over uint32 with
+  natural wraparound: ``h_i(x) = a_i * x + b_i (mod 2^32)`` with odd
+  ``a_i``.  Multiply-shift universal hashing is integer-only, so
+  everything rides the VPU; no float precision traps, bit-exact across
+  CPU/TPU and vs the numpy host oracle (host.py), which shares the same
+  parameters.  The kernel is deliberately a `fori_loop` over the (small,
+  static) set dimension accumulating an elementwise min of `[N, H]`
+  blocks: peak memory stays O(N*H) instead of the O(N*S*H) a broadcast
+  formulation would materialise, and XLA fuses the multiply-add-min
+  chain into one pass.
+
+- **cminhash** — one-permutation hashing with circulant-shift repair
+  (C-MinHash, arXiv:2109.03337/2109.04595) and bounded optimal-style
+  densification (arXiv:1703.04664).  ONE multiply-add pass permutes the
+  elements (the only per-element hash evaluations — ~H× fewer than
+  kminhash at equal ``n_hashes``); each permuted value lands in bin
+  ``u % H`` and the bin keeps its minimum.  Empty bins (sparse rows)
+  densify deterministically: a fixed schedule of donor maps borrows
+  from non-empty bins (chained rounds — the empty fraction squares per
+  round), and any bin still empty after the schedule takes the
+  C-MinHash circulant value ``rowmin(u) + off[k]`` so no bin ever
+  carries the UMAX sentinel into a band key.  All three implementations
+  (this fori_loop reference, host.py's numpy mirror, and the pallas
+  VMEM-blocked variant in minhash_pallas.py) are bit-identical —
+  CI-asserted, because the store/prefilter/serve planes compare
+  signatures across them.
+
+Band keys are computed from signatures by the same FNV fold for every
+scheme — banding consumes signature rows, not hash internals.
 """
 
 from __future__ import annotations
@@ -57,6 +78,57 @@ def minhash_signatures(items: jax.Array, a: jax.Array, b: jax.Array) -> jax.Arra
 
     init = jnp.full((n, a.shape[0]), UMAX, dtype=jnp.uint32)
     return jax.lax.fori_loop(0, s, body, init)
+
+
+@partial(jax.jit, static_argnames=())
+def _cminhash_densify(v: jax.Array, rowmin: jax.Array, jmap: jax.Array,
+                      offs: jax.Array) -> jax.Array:
+    """Densification + circulant fallback over the [N, H] bin-min block
+    (UMAX = empty).  O(N*H) — bandwidth-trivial next to the O(N*S)
+    permutation pass, so it runs as plain jnp even when that pass runs
+    in pallas: ONE implementation serves both, which is half of the
+    bit-parity argument."""
+
+    def densify(t, v):
+        jm = jax.lax.dynamic_index_in_dim(jmap, t, 0, keepdims=False)
+        cand = jnp.take(v, jm, axis=1)
+        return jnp.where((v == UMAX) & (cand != UMAX), cand, v)
+
+    v = jax.lax.fori_loop(0, jmap.shape[0], densify, v)
+    # Circulant-shift fallback (the C-MinHash construction, degenerate
+    # to the row minimum): only bins still empty after the densification
+    # schedule — for |S| within ~2x of H this is statistically never.
+    fb = rowmin[:, None] + offs[None, :]
+    return jnp.where(v == UMAX, fb, v)
+
+
+@partial(jax.jit, static_argnames=())
+def cminhash_signatures(items: jax.Array, a0: jax.Array, b0: jax.Array,
+                        jmap: jax.Array, offs: jax.Array) -> jax.Array:
+    """[N, S] uint32 feature sets -> [N, H] uint32 one-permutation
+    signatures (C-MinHash + bounded densification; module docstring).
+
+    ``a0``/``b0``: the single permutation's multiply-add constants
+    (scalar uint32, a0 odd).  ``jmap``: [T, H] int32 donor maps for the
+    densification rounds.  ``offs``: [H] uint32 circulant fallback
+    offsets.  All are host-derived from the scheme seed
+    (schemes.make_params) so host/device share them bit-identically.
+    """
+    items = items.astype(jnp.uint32)
+    n, s = items.shape
+    h = offs.shape[0]
+    u = items * a0 + b0                       # the one permutation pass
+    bins = (u % jnp.uint32(h)).astype(jnp.int32)
+    rows = jnp.arange(n, dtype=jnp.int32)
+
+    def seg_min(i, acc):
+        uc = jax.lax.dynamic_slice_in_dim(u, i, 1, axis=1)[:, 0]
+        bc = jax.lax.dynamic_slice_in_dim(bins, i, 1, axis=1)[:, 0]
+        return acc.at[rows, bc].min(uc)
+
+    v = jax.lax.fori_loop(0, s, seg_min,
+                          jnp.full((n, h), UMAX, dtype=jnp.uint32))
+    return _cminhash_densify(v, u.min(axis=1), jmap, offs)
 
 
 @partial(jax.jit, static_argnames=("n_bands",))

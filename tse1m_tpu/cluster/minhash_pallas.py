@@ -20,7 +20,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .minhash import _FNV_OFFSET, _FNV_PRIME, UMAX, band_keys, minhash_signatures
+from .minhash import (_FNV_OFFSET, _FNV_PRIME, UMAX, band_keys,
+                      cminhash_signatures, minhash_signatures)
 
 
 def _kernel(items_ref, a_ref, b_ref, sig_ref, keys_ref, *, n_bands: int):
@@ -111,6 +112,117 @@ def minhash_and_keys(items, a, b, n_bands: int, *, use_pallas: str = "auto",
             block_n=block_n, interpret=(use_pallas == "interpret"))
         return sig[:n], keys[:n]
     sig = minhash_signatures(jnp.asarray(items), jnp.asarray(a), jnp.asarray(b))
+    return sig, band_keys(sig, n_bands)
+
+
+# ---------------------------------------------------------------------------
+# VMEM-blocked C-MinHash (one-permutation) bin-min kernel.  The scheme's
+# expensive pass is O(N*S): permute every element once and fold it into
+# its bin's minimum — that is what runs here, one HBM->VMEM load per
+# item block, as a one-hot compare against a broadcasted bin iota
+# (Mosaic has no scatter).  The O(N*H) tail — densification rounds,
+# circulant fallback, band fold — runs OUTSIDE the kernel as the SAME
+# jitted jnp the reference path uses (minhash._cminhash_densify +
+# band_keys): its donor gathers don't lower to anything Mosaic-shaped,
+# it is bandwidth-trivial next to the bin-min pass, and sharing one
+# implementation is half the bit-parity argument.  The sentinel algebra
+# matches the reference exactly: a biased 0x7FFFFFFF is UMAX, so a
+# never-touched bin and a bin holding a genuine UMAX element are
+# indistinguishable in BOTH implementations.
+
+def _cminhash_binmin_kernel(items_ref, c_ref, binmin_ref, rowmin_ref, *,
+                            n_hashes: int):
+    items = items_ref[...]          # [BN, S] uint32
+    c = c_ref[...]                  # [2] uint32: (a0, b0)
+    bn, s = items.shape
+    h = n_hashes
+
+    bias = jnp.uint32(0x80000000)
+    u = items * c[0] + c[1]                        # the one permutation
+    bins = (u % jnp.uint32(h)).astype(jnp.int32)
+    ub = jax.lax.bitcast_convert_type(u ^ bias, jnp.int32)
+    iota_h = jax.lax.broadcasted_iota(jnp.int32, (bn, h), 1)
+    acc = jnp.full((bn, h), 0x7FFFFFFF, dtype=jnp.int32)
+    for i in range(s):  # static unroll: one-hot segment min per column
+        acc = jnp.minimum(acc, jnp.where(iota_h == bins[:, i:i + 1],
+                                         ub[:, i:i + 1], 0x7FFFFFFF))
+    binmin_ref[...] = jax.lax.bitcast_convert_type(acc, jnp.uint32) ^ bias
+    rowmin_ref[...] = jax.lax.bitcast_convert_type(
+        jnp.min(ub, axis=1, keepdims=True), jnp.uint32) ^ bias
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n_hashes", "block_n", "interpret"))
+def _cminhash_binmin_pallas(items, consts, n_hashes: int, block_n: int,
+                            interpret: bool):
+    from jax.experimental import pallas as pl
+
+    n, s = items.shape
+    assert n % block_n == 0, (n, block_n)
+    grid = (n // block_n,)
+    return pl.pallas_call(
+        functools.partial(_cminhash_binmin_kernel, n_hashes=n_hashes),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_n, s), lambda i: (i, 0)),
+            pl.BlockSpec((2,), lambda i: (0,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_n, n_hashes), lambda i: (i, 0)),
+            pl.BlockSpec((block_n, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, n_hashes), jnp.uint32),
+            jax.ShapeDtypeStruct((n, 1), jnp.uint32),
+        ],
+        interpret=interpret,
+    )(items.astype(jnp.uint32), consts)
+
+
+# One-shot breaker, same contract as the fused-unpack kernel below: the
+# urem this kernel leans on is among the least-portable Mosaic ops, so a
+# lowering rejection falls back to the bit-identical jax reference for
+# the rest of the process instead of failing every chunk.
+_CMINHASH_PALLAS_OK = True
+
+
+def cminhash_and_keys(items, a0, b0, jmap, offs, n_bands: int, *,
+                      use_pallas: str = "auto", block_n: int = 512):
+    """[N, S] items -> ([N, H] signatures, [N, B] band keys) under the
+    cminhash scheme.  Dispatch mirrors minhash_and_keys: pallas bin-min
+    on TPU (or forced/interpret), jax reference elsewhere; pad rows are
+    zeros and sliced off (the kernel is row-independent)."""
+    global _CMINHASH_PALLAS_OK
+    from .minhash import _cminhash_densify
+
+    if use_pallas == "auto":
+        use_pallas = "force" if jax.default_backend() == "tpu" else "never"
+    a0 = jnp.asarray(a0, jnp.uint32).reshape(1)
+    b0 = jnp.asarray(b0, jnp.uint32).reshape(1)
+    jmap = jnp.asarray(jmap, jnp.int32)
+    offs = jnp.asarray(offs, jnp.uint32)
+    if use_pallas in ("force", "interpret") and _CMINHASH_PALLAS_OK:
+        n = items.shape[0]
+        padded = jnp.asarray(items)
+        pad = (-n) % block_n
+        if pad:
+            padded = jnp.concatenate(
+                [padded,
+                 jnp.zeros((pad, items.shape[1]), dtype=jnp.uint32)], axis=0)
+        try:
+            binmin, rowmin = _cminhash_binmin_pallas(
+                padded, jnp.concatenate([a0, b0]), int(offs.shape[0]),
+                block_n, use_pallas == "interpret")
+            sig = _cminhash_densify(binmin[:n], rowmin[:n, 0], jmap, offs)
+            return sig, band_keys(sig, n_bands)
+        except Exception as e:  # Mosaic lowering gap: unfuse, don't fail  # graftlint: disable=broad-except -- compiler rejections are arbitrary; fallback is bit-identical
+            _CMINHASH_PALLAS_OK = False
+            from ..utils.logging import get_logger
+
+            get_logger("cluster.pallas").warning(
+                "cminhash pallas kernel unavailable (%s: %s); falling "
+                "back to the jax reference", type(e).__name__, e)
+    sig = cminhash_signatures(jnp.asarray(items), a0, b0, jmap, offs)
     return sig, band_keys(sig, n_bands)
 
 

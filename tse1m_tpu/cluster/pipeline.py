@@ -45,8 +45,9 @@ from .encode import (_AUTO_MIN_BYTES, _AUTO_MIN_DELTA_FRACTION,
                      encode_delta, pack_bits_host, pack_chunk,
                      pack_delta_meta, quantize_ids, width_bits)
 from .lsh import bucket_representatives, estimated_jaccard, propagate_labels
-from .minhash import band_keys, make_hash_params, minhash_signatures
-from .minhash_pallas import minhash_and_keys, minhash_and_keys_packed
+from .minhash import band_keys
+from .schemes import (get_scheme, make_params, scheme_sig_and_keys,
+                      scheme_sig_and_keys_packed)
 
 log = get_logger("cluster.pipeline")
 
@@ -115,6 +116,15 @@ class ClusterParams:
     # v2 bit-packed format.  Choice is per chunk/lane and label-
     # invariant either way.
     entropy: str = "auto"
+    # Signature kernel family (cluster/schemes.py): 'kminhash' is the
+    # original K-permutation multiply-shift family (bit-compatible with
+    # every pre-scheme store/checkpoint); 'cminhash' is one-permutation
+    # C-MinHash + densification (~n_hashes x fewer hash evaluations per
+    # row); 'weighted' runs the one-permutation kernel over host-side
+    # replica-expanded rows (schemes.expand_weighted) for hit-count-
+    # weighted coverage similarity.  Joins the store/checkpoint policy
+    # tuple, so mixed-scheme stores refuse exactly like mixed-seed ones.
+    scheme: str = "kminhash"
 
 
 # Observability surface for bench.py: stats of the last single-host
@@ -185,6 +195,7 @@ def _cluster_encoded_labels(sig, keys, mask_bytes, n: int, threshold: float,
 
 
 def _validate_encoding(params: ClusterParams) -> None:
+    get_scheme(params.scheme)
     if params.encoding not in ("auto", "delta", "pack24"):
         raise ValueError(f"unknown encoding {params.encoding!r}; "
                          "expected auto | delta | pack24")
@@ -629,28 +640,29 @@ def _iter_streamed(chunks: list, rec: StageRecorder, overlap: bool,
         ex.shutdown(wait=False, cancel_futures=True)
 
 
-def _chunk_minhash(payload_d, wire: ChunkWire, a, b, params: ClusterParams,
+def _chunk_minhash(payload_d, wire: ChunkWire, hp, params: ClusterParams,
                    rec: StageRecorder, want_decoded: bool,
                    sup: "_DeviceSupervisor | None" = None):
-    """One chunk's device half: decode + fused MinHash/band keys (compute
-    stage).  Byte-width chunks take the pallas fused-unpack kernel when
-    available (decoded bytes never round-trip HBM); ``want_decoded``
-    forces a materialized decode (the encoded path needs the full-lane
-    rows resident for the delta scatter).  The completion wait runs under
-    an absolute watchdog deadline (`pipeline.compute` seat): a hung
-    device surfaces as a cancellable StallError instead of wedging the
-    run forever."""
+    """One chunk's device half: decode + fused signature/band keys per
+    the run's scheme (compute stage).  Byte-width chunks take the
+    fused-unpack path when the scheme has one (decoded bytes never
+    round-trip HBM); ``want_decoded`` forces a materialized decode (the
+    encoded path needs the full-lane rows resident for the delta
+    scatter).  The completion wait runs under an absolute watchdog
+    deadline (`pipeline.compute` seat): a hung device surfaces as a
+    cancellable StallError instead of wedging the run forever."""
     kw = dict(use_pallas=params.use_pallas, block_n=params.block_n)
     with rec.stage("compute"), (sup.device_ctx() if sup is not None
                                 else contextlib.nullcontext()):
         decoded = None
         if wire.ent is not None or want_decoded or wire.bits % 8 != 0:
             decoded = _decode_wire(payload_d, wire, params.use_pallas)
-            sig, keys = minhash_and_keys(decoded, a, b, params.n_bands, **kw)
+            sig, keys = scheme_sig_and_keys(decoded, hp, params.n_bands,
+                                            **kw)
         else:
-            sig, keys = minhash_and_keys_packed(
+            sig, keys = scheme_sig_and_keys_packed(
                 payload_d, wire.shape, wire.bits // 8,
-                jax.device_put(np.uint32(wire.offset)), a, b, params.n_bands,
+                jax.device_put(np.uint32(wire.offset)), hp, params.n_bands,
                 **kw)
 
         def wait():
@@ -661,7 +673,7 @@ def _chunk_minhash(payload_d, wire: ChunkWire, a, b, params: ClusterParams,
     return sig, keys, decoded
 
 
-def _stream_minhash_degraded(rows: np.ndarray, a, b, params: ClusterParams,
+def _stream_minhash_degraded(rows: np.ndarray, hp, params: ClusterParams,
                              rec: StageRecorder, want_decoded: bool,
                              sup: "_DeviceSupervisor | None" = None,
                              wd: StageWatchdog | None = None,
@@ -695,7 +707,7 @@ def _stream_minhash_degraded(rows: np.ndarray, a, b, params: ClusterParams,
             for payload_d, wire in _iter_streamed(chunks, rec,
                                                   params.overlap, wd, sup,
                                                   params.entropy):
-                sig, keys, cd = _chunk_minhash(payload_d, wire, a, b, params,
+                sig, keys, cd = _chunk_minhash(payload_d, wire, hp, params,
                                                rec, want_decoded=want_decoded,
                                                sup=sup)
                 parts.append((sig, keys))
@@ -761,7 +773,7 @@ def _row_chunks(rows: np.ndarray, step: int) -> list:
     return [rows[i:i + step] for i in range(0, max(rows.shape[0], 1), step)]
 
 
-def _checkpointed_chunks(pending: list, a, b, params: ClusterParams,
+def _checkpointed_chunks(pending: list, hp, params: ClusterParams,
                          rec: StageRecorder, ckpt, parts: dict,
                          want_decoded: bool = False,
                          chunks_d: list | None = None) -> None:
@@ -786,7 +798,7 @@ def _checkpointed_chunks(pending: list, a, b, params: ClusterParams,
                                     params.entropy)
             for (idx, _), (payload_d, wire) in zip(remaining, stream):
                 sig, keys, cd = _chunk_minhash(
-                    payload_d, wire, a, b, params, rec,
+                    payload_d, wire, hp, params, rec,
                     want_decoded=want_decoded, sup=sup)
                 if chunks_d is not None:
                     chunks_d[idx] = cd
@@ -810,7 +822,7 @@ def _checkpointed_chunks(pending: list, a, b, params: ClusterParams,
                     last_run_info.get("chunk_halvings", 0) + 1)
                 _persist_chunk_bytes(half, chunk)
                 sub_parts, sub_dec, _ = _stream_minhash_degraded(
-                    chunk, a, b, params, rec, want_decoded=want_decoded,
+                    chunk, hp, params, rec, want_decoded=want_decoded,
                     sup=sup, wd=wd, initial_step=half)
                 sig = jnp.concatenate([p[0] for p in sub_parts])
                 keys = jnp.concatenate([p[1] for p in sub_parts])
@@ -890,23 +902,23 @@ def _decode_delta_meta(meta, enc, full_d, rep_d, counts_d, pos_d, val_d,
     return _decode_delta_raw(full_d, rep, counts, pos, vals)
 
 
-def _cluster_encoded(items: np.ndarray, enc, a, b, params: ClusterParams,
+def _cluster_encoded(items: np.ndarray, enc, hp, params: ClusterParams,
                      rec: StageRecorder) -> np.ndarray:
     """Single-host encoded path: stream the full lane chunked + double-
     buffered (retaining the decoded device rows), decode the delta lane
     against it, MinHash both, cluster with original-order labels."""
     n = items.shape[0]
     parts, chunks_d, wire_bits = _stream_minhash_degraded(
-        enc.full_rows, a, b, params, rec, want_decoded=True)
+        enc.full_rows, hp, params, rec, want_decoded=True)
     full_d = chunks_d[0] if len(chunks_d) == 1 else jnp.concatenate(chunks_d)
     meta, mask_d, rep_d, counts_d, pos_d, val_d = _put_delta_meta(
         enc, rec, params.entropy)
     with rec.stage("compute"):
         delta_items = _decode_delta_meta(meta, enc, full_d, rep_d, counts_d,
                                          pos_d, val_d, params.use_pallas)
-        dsig, dkeys = minhash_and_keys(delta_items, a, b, params.n_bands,
-                                       use_pallas=params.use_pallas,
-                                       block_n=params.block_n)
+        dsig, dkeys = scheme_sig_and_keys(delta_items, hp, params.n_bands,
+                                          use_pallas=params.use_pallas,
+                                          block_n=params.block_n)
         sig = jnp.concatenate([p[0] for p in parts] + [dsig])
         keys = jnp.concatenate([p[1] for p in parts] + [dkeys])
         labels = _cluster_encoded_labels(sig, keys, mask_d, n,
@@ -977,8 +989,7 @@ def cluster_sessions(items, params: ClusterParams | None = None,
         # _cluster_with_store).
         return _cluster_with_store(
             np.ascontiguousarray(items, dtype=np.uint32), params)
-    a, b = make_hash_params(params.n_hashes, params.seed)
-    a, b = jnp.asarray(a), jnp.asarray(b)
+    hp = make_params(params.scheme, params.n_hashes, params.seed).device()
 
     if mesh is not None:
         # The base-delta + adaptive-width wire encoding is a single-host
@@ -1032,9 +1043,10 @@ def cluster_sessions(items, params: ClusterParams | None = None,
         # bucket/verify/propagate stages, not just MinHash.
         kernel = _sharded_cluster_kernel(mesh, axis, params.n_bands,
                                          params.threshold, params.n_iters,
-                                         packed=packed)
+                                         packed=packed,
+                                         scheme=params.scheme)
         with rec.stage("compute"):
-            labels = kernel(items_d, a, b)
+            labels = kernel(items_d, *hp.arrays)
             jax.block_until_ready(labels)
         if jax.process_count() > 1:
             # Multi-host: shards live on non-addressable devices, so a
@@ -1064,7 +1076,7 @@ def cluster_sessions(items, params: ClusterParams | None = None,
     qbits_full = _quant_bits(items, params)
     keep = _prefilter_keep(items, params, rec)
     work = items if keep is None else items[keep]
-    out = _cluster_single_host(work, a, b, params, rec, qbits_full)
+    out = _cluster_single_host(work, hp, params, rec, qbits_full)
     if keep is not None:
         out = _scatter_prefiltered(items.shape[0], keep, out)
     _record_wire(rec)
@@ -1102,7 +1114,7 @@ def _prefilter_mask(items: np.ndarray,
         return None
     from .prefilter import collide_mask
 
-    return collide_mask(items, params.seed)
+    return collide_mask(items, params.seed, scheme=params.scheme)
 
 
 def _prefilter_keep(items: np.ndarray, params: ClusterParams,
@@ -1148,7 +1160,7 @@ def _record_wire_v3(items: np.ndarray, params: ClusterParams, qbits: int,
         wire_v3_saved_mb=round((ent_saved + pf_saved) / 2**20, 3))
 
 
-def _cluster_single_host(items: np.ndarray, a, b, params: ClusterParams,
+def _cluster_single_host(items: np.ndarray, hp, params: ClusterParams,
                          rec: StageRecorder,
                          qbits_override: int | None = None) -> np.ndarray:
     """The storeless single-host pipeline over (possibly prefiltered)
@@ -1167,7 +1179,7 @@ def _cluster_single_host(items: np.ndarray, a, b, params: ClusterParams,
         last_run_info.update(
             encoding="delta", encode_s=round(time.perf_counter() - t0, 4),
             n_full=enc.n_full, n_delta=enc.n_delta)
-        return _cluster_encoded(items, enc, a, b, params, rec)
+        return _cluster_encoded(items, enc, hp, params, rec)
 
     last_run_info.update(encoding="plain")
     # The quant-drop rung is storeless-only (a store's policy key pins
@@ -1176,7 +1188,7 @@ def _cluster_single_host(items: np.ndarray, a, b, params: ClusterParams,
     quant_ctx = ({"raw": raw_items, "bits": qbits}
                  if params.sig_store is None
                  and params.wire_quant_bits >= 0 else None)
-    sig, keys = _minhash_streamed(items, a, b, params, rec,
+    sig, keys = _minhash_streamed(items, hp, params, rec,
                                   quant_ctx=quant_ctx)
     with rec.stage("compute"):
         labels = _cluster_from_sig_jit(sig, keys, params.threshold,
@@ -1264,8 +1276,7 @@ def cluster_sessions_resumable(items, params: ClusterParams | None = None,
         from .store import row_digests
 
         digests = row_digests(items)  # of the RAW ids, before quantization
-    a, b = make_hash_params(params.n_hashes, params.seed)
-    a, b = jnp.asarray(a), jnp.asarray(b)
+    hp = make_params(params.scheme, params.n_hashes, params.seed).device()
     rec = StageRecorder()
     t_all = time.perf_counter()
     last_run_info.clear()
@@ -1318,7 +1329,7 @@ def cluster_sessions_resumable(items, params: ClusterParams | None = None,
                                   jax.device_put(shard[1]))
                 continue
             pending.append((idx, items[i:i + step]))
-        _checkpointed_chunks(pending, a, b, params, rec, ckpt, parts)
+        _checkpointed_chunks(pending, hp, params, rec, ckpt, parts)
         with rec.stage("compute"):
             sig = jnp.concatenate([parts[i][0] for i in sorted(parts)])
             keys = jnp.concatenate([parts[i][1] for i in sorted(parts)])
@@ -1373,7 +1384,7 @@ def cluster_sessions_resumable(items, params: ClusterParams | None = None,
                               jax.device_put(shard[1]))
             continue
         pending.append((idx, full[i:i + step]))
-    _checkpointed_chunks(pending, a, b, params, rec, ckpt, parts,
+    _checkpointed_chunks(pending, hp, params, rec, ckpt, parts,
                          want_decoded=True, chunks_d=chunks_d)
     didx = n_full_chunks
     dshard = ckpt.load_chunk_or_none(didx) if ckpt.chunk_done(didx) else None
@@ -1399,9 +1410,9 @@ def cluster_sessions_resumable(items, params: ClusterParams | None = None,
             delta_items = _decode_delta_meta(meta, enc, full_d, rep_d,
                                              counts_d, pos_d, val_d,
                                              params.use_pallas)
-            dsig, dkeys = minhash_and_keys(delta_items, a, b, params.n_bands,
-                                           use_pallas=params.use_pallas,
-                                           block_n=params.block_n)
+            dsig, dkeys = scheme_sig_and_keys(
+                delta_items, hp, params.n_bands,
+                use_pallas=params.use_pallas, block_n=params.block_n)
         with rec.stage("d2h"):
             dsig_h, dkeys_h = np.asarray(dsig), np.asarray(dkeys)
         ckpt.save_chunk(didx, dsig_h, dkeys_h)
@@ -1430,7 +1441,7 @@ def cluster_sessions_resumable(items, params: ClusterParams | None = None,
     return out
 
 
-def _minhash_streamed(items: np.ndarray, a, b, params: ClusterParams,
+def _minhash_streamed(items: np.ndarray, hp, params: ClusterParams,
                       rec: StageRecorder, quant_ctx: dict | None = None):
     """items -> (signatures, band keys), overlapping encode + H2D with
     compute.
@@ -1443,7 +1454,7 @@ def _minhash_streamed(items: np.ndarray, a, b, params: ClusterParams,
     which is also why the degradation ladder (OOM halving, stall retry,
     CPU failover) is label-invariant here.
     """
-    parts, _, wire_bits = _stream_minhash_degraded(items, a, b, params, rec,
+    parts, _, wire_bits = _stream_minhash_degraded(items, hp, params, rec,
                                                    want_decoded=False,
                                                    quant_ctx=quant_ctx)
     last_run_info["chunk_bits"] = wire_bits
@@ -1527,7 +1538,7 @@ def wire_payloads(items, params: ClusterParams | None = None):
 
 def _store_policy(params: ClusterParams, qbits: int) -> dict:
     return {"n_hashes": params.n_hashes, "seed": params.seed,
-            "quant_bits": qbits}
+            "quant_bits": qbits, "scheme": params.scheme}
 
 
 def minhash_novel_rows(rows: np.ndarray, params: ClusterParams,
@@ -1554,9 +1565,8 @@ def minhash_novel_rows(rows: np.ndarray, params: ClusterParams,
         if padded > k:
             sub = np.concatenate(
                 [sub, np.broadcast_to(sub[:1], (padded - k, sub.shape[1]))])
-    a, b = make_hash_params(params.n_hashes, params.seed)
-    a, b = jnp.asarray(a), jnp.asarray(b)
-    parts, _, _ = _stream_minhash_degraded(sub, a, b, params, rec,
+    hp = make_params(params.scheme, params.n_hashes, params.seed).device()
+    parts, _, _ = _stream_minhash_degraded(sub, hp, params, rec,
                                            want_decoded=False, wd=wd)
     sig_d = (parts[0][0] if len(parts) == 1
              else jnp.concatenate([p[0] for p in parts]))
@@ -1643,9 +1653,9 @@ def _store_warm_merge(items, digests, hit, shard, row, state, store,
         sub = items[n_old:][miss]
         if qbits:
             sub = quantize_ids(sub, qbits)
-        a, b = make_hash_params(params.n_hashes, params.seed)
-        a, b = jnp.asarray(a), jnp.asarray(b)
-        sig_d, _ = _minhash_streamed(sub, a, b, params, rec)
+        hp = make_params(params.scheme, params.n_hashes,
+                         params.seed).device()
+        sig_d, _ = _minhash_streamed(sub, hp, params, rec)
         with rec.stage("d2h", nbytes=int(sig_d.size) * 4):
             new_sig[miss] = np.asarray(sig_d)
     with rec.stage("compute"):
@@ -1675,8 +1685,7 @@ def _store_warm_merge(items, digests, hit, shard, row, state, store,
     hit2, sh2, rw2 = store.bulk_probe(digests[n_old:])
     locator = np.concatenate(
         [state.locator, np.stack([sh2, rw2], axis=1)])
-    store.save_state(labels, locator,
-                     (index.band_keys_sorted, index.band_reps), digests,
+    store.save_state(labels, locator, index.band_tables(), digests,
                      params.n_bands, params.threshold)
     last_run_info["cache_novel_rows"] = int(miss.sum())
     return labels
@@ -1694,8 +1703,7 @@ def _store_union(items, digests, hit, shard, row, store,
     from . import incremental as inc
 
     n = items.shape[0]
-    a, b = make_hash_params(params.n_hashes, params.seed)
-    a, b = jnp.asarray(a), jnp.asarray(b)
+    hp = make_params(params.scheme, params.n_hashes, params.seed).device()
     miss = ~hit
     hit_idx = np.flatnonzero(hit)
     miss_idx = np.flatnonzero(miss)
@@ -1714,7 +1722,7 @@ def _store_union(items, digests, hit, shard, row, store,
         sub = items[miss_idx]
         if qbits:
             sub = quantize_ids(sub, qbits)
-        sig_miss_d, keys_miss_d = _minhash_streamed(sub, a, b, params, rec)
+        sig_miss_d, keys_miss_d = _minhash_streamed(sub, hp, params, rec)
         sig_parts.append(sig_miss_d)
         key_parts.append(keys_miss_d)
     mask_bits = np.packbits(miss, bitorder="little")
@@ -1934,9 +1942,9 @@ def cluster_sessions_pod(local_items, n_rows: int,
         sub = local_items[miss]
         if qbits:
             sub = quantize_ids(sub, qbits)
-        a, b = make_hash_params(params.n_hashes, params.seed)
-        a, b = jnp.asarray(a), jnp.asarray(b)
-        sig_d, _ = _minhash_streamed(sub, a, b, params, rec)
+        hp = make_params(params.scheme, params.n_hashes,
+                         params.seed).device()
+        sig_d, _ = _minhash_streamed(sub, hp, params, rec)
         with rec.stage("d2h", nbytes=int(sig_d.size) * 4):
             sig_local[miss] = np.asarray(sig_d)
     if local_only:

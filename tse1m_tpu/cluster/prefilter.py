@@ -102,10 +102,25 @@ def band_keys_host(items: np.ndarray, seed: int = 0) -> np.ndarray:
     return keys
 
 
-def collide_mask(items: np.ndarray, seed: int = 0) -> np.ndarray:
+def collide_mask(items: np.ndarray, seed: int = 0,
+                 scheme: str = "kminhash") -> np.ndarray:
     """[N] bool: True for rows sharing at least one band bucket with
     another row (the rows that can possibly collide on device).  Rows
-    with False are bucketed singleton in EVERY band and skip the wire."""
+    with False are bucketed singleton in EVERY band and skip the wire.
+
+    ``scheme`` names the run's signature kernel family
+    (cluster/schemes.py) — validated here so a typo'd policy fails at
+    the filter, not three stages later.  The MASK itself is one
+    implementation for the whole family, because the isolation argument
+    lives in the id-set space every scheme estimates: kminhash and
+    cminhash both estimate plain Jaccard of the presented rows, and
+    ``weighted`` rows arrive replica-expanded (schemes.expand_weighted)
+    so set isolation in replica space IS weighted-Jaccard isolation.
+    A scheme-specific key derivation would change which rows ship, but
+    never whether a dropped row could have gained a verified edge."""
+    from .schemes import get_scheme
+
+    get_scheme(scheme)
     n = items.shape[0]
     collide = np.zeros(n, bool)
     if n < 2:

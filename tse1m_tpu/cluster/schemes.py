@@ -1,0 +1,262 @@
+"""Signature-scheme registry: THE dispatch point for signature kernels.
+
+The pipeline's original assumption — "a signature is K independent
+multiply-shift MinHashes" — is now one member of a kernel *family*,
+selected per run by the ``scheme`` policy field (ClusterParams.scheme,
+the store/checkpoint policy tuple, the serve daemon's ingest path):
+
+- ``kminhash`` — the original K-permutation multiply-add family
+  (minhash.minhash_signatures).  Bit-compatible with every store and
+  checkpoint written before schemes existed: a manifest with no
+  ``scheme`` key loads as kminhash.
+- ``cminhash`` — one-permutation hashing with circulant-shift repair
+  (C-MinHash, arXiv:2109.03337/2109.04595) and bounded optimal-style
+  densification (arXiv:1703.04664) for sparse rows.  ONE element-hash
+  pass instead of K: ~``n_hashes``× fewer hash evaluations per row,
+  which is the whole device-compute story post-prefilter (the rows the
+  host prefilter keeps are exactly the rows that pay kernel time).
+- ``weighted`` — exact weighted minwise hashing over integer hit
+  counts (arXiv:1602.08393 lineage): each (element, weight) pair
+  expands host-side into ``weight`` replica ids (``expand_weighted``),
+  and the cminhash kernel runs over the replica universe.  Weighted
+  Jaccard of the (clipped-integer) weighted sets equals plain Jaccard
+  of the replica sets, so every downstream stage — banding, LSH,
+  verification, label propagation, the store, the serve plane — works
+  unchanged on the expanded rows.
+
+Every module that *computes* signatures must dispatch through this
+registry (graftlint rule ``scheme-parity``); the raw kernels in
+minhash.py / minhash_pallas.py / host.py are implementation detail.
+That is what makes the bit-parity story auditable: host oracle, device
+reference, pallas variant and serve-side host MinHash all draw their
+constants from one ``make_params`` and are CI-asserted bit-identical
+per scheme.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+SCHEMES = ("kminhash", "cminhash", "weighted")
+DEFAULT_SCHEME = "kminhash"
+
+# Densification schedule length (cminhash): chained donor rounds square
+# the empty-bin fraction per round, so 12 rounds fill any row with at
+# least one non-empty bin to ~1e-4 residual even at |S| = H/32; the
+# circulant fallback covers the residual deterministically.
+_T_DENSIFY = 12
+
+# Weighted expansion: hit counts clip to [1, MAX_WEIGHT] (a count of 0
+# still means "this edge was covered" — set membership is the floor the
+# reference paper models; the weights refine it).  Replica ids embed as
+# x * _REPLICA_MULT + r — an odd-multiplier hash embedding, injective in
+# x per replica index; cross-pair collisions are birthday-rare (~(S*W)^2
+# / 2^33 per row pair) and land below the verifier's threshold noise.
+MAX_WEIGHT = 8
+_REPLICA_MULT = np.uint32(0x85EBCA6B)
+
+
+@dataclass(frozen=True)
+class HashParams:
+    """One scheme's resolved hash constants (host numpy arrays).
+
+    ``arrays`` is the positional constant tuple the scheme's kernels
+    take after ``items`` — (a, b) for kminhash, (a0, b0, jmap, offs)
+    for cminhash/weighted.  Derived deterministically from (scheme,
+    n_hashes, seed) so host and device share them bit-identically.
+    """
+
+    scheme: str
+    n_hashes: int
+    arrays: tuple
+
+    def device(self) -> "HashParams":
+        """The same params with device-resident arrays (one conversion
+        per run, outside the hot loop — the runtime sanitizer rejects
+        per-chunk implicit staging)."""
+        import jax.numpy as jnp
+
+        return HashParams(self.scheme, self.n_hashes,
+                          tuple(jnp.asarray(a) for a in self.arrays))
+
+
+def get_scheme(name: str) -> str:
+    if name not in SCHEMES:
+        raise ValueError(
+            f"unknown signature scheme {name!r}; valid schemes: "
+            f"{', '.join(SCHEMES)}")
+    return name
+
+
+def _one_perm_consts(n_hashes: int, seed: int, stream: int) -> tuple:
+    """(a0, b0, jmap, offs) for the one-permutation kernel.  ``stream``
+    separates the cminhash and weighted constant streams so the two
+    schemes' signatures of identical rows differ (their stores must not
+    be confusable even before the policy key refuses)."""
+    rng = np.random.default_rng([int(seed) & 0xFFFFFFFF, stream])
+    # Shape-(1,) rather than 0-d: jnp.asarray of a 0-d numpy scalar
+    # converts via convert_element_type — an IMPLICIT transfer the
+    # runtime sanitizer rejects; 1-element arrays ride device_put like
+    # every other constant, and uint32 broadcasting is unchanged.
+    a0 = np.array([int(rng.integers(1, 1 << 32)) | 1], np.uint32)
+    b0 = np.array([int(rng.integers(0, 1 << 32))], np.uint32)
+    # Donor maps must be PERMUTATIONS: a multiply-mod map whose
+    # multiplier shares a factor with H collapses its image (observed:
+    # 4 of 128 bins) and the densification walk starves — the estimator
+    # bias the optimal-densification paper exists to kill.  A seeded
+    # permutation per round keeps every bin reachable and the walk's
+    # bin-priority sequence set-independent, which is the unbiasedness
+    # argument (both rows stop at the first self-non-empty bin of one
+    # shared sequence).
+    jmap = np.stack([rng.permutation(n_hashes)
+                     for _ in range(_T_DENSIFY)]).astype(np.int32)
+    k = np.arange(n_hashes, dtype=np.uint64)
+    cf = np.uint64(int(rng.integers(1, 1 << 32)) | 1)
+    df = np.uint64(int(rng.integers(0, 1 << 32)))
+    offs = ((cf * (k + np.uint64(1)) + df)
+            & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    return (a0, b0, jmap, offs)
+
+
+def make_params(scheme: str, n_hashes: int, seed: int = 0) -> HashParams:
+    """Resolve a scheme's hash constants.  kminhash keeps the exact
+    pre-scheme constant stream (minhash.make_hash_params) — stores and
+    checkpoints written before the registry existed stay valid."""
+    get_scheme(scheme)
+    if scheme == "kminhash":
+        from .minhash import make_hash_params
+
+        return HashParams(scheme, n_hashes,
+                          tuple(make_hash_params(n_hashes, seed)))
+    stream = 0xC31F if scheme == "cminhash" else 0x3E16
+    return HashParams(scheme, n_hashes,
+                      _one_perm_consts(n_hashes, seed, stream))
+
+
+# -- device dispatch ---------------------------------------------------------
+
+
+def scheme_signatures_traced(items, scheme: str, arrays):
+    """Traced-level dispatch for shard_map/jit bodies: [N, S] items (+
+    the scheme's positional constants) -> [N, H] signatures.  The
+    caller owns staging ``arrays`` (e.g. shard_map in_specs)."""
+    from .minhash import cminhash_signatures, minhash_signatures
+
+    if scheme == "kminhash":
+        return minhash_signatures(items, *arrays)
+    return cminhash_signatures(items, *arrays)
+
+
+def scheme_sig_and_keys(items, hp: HashParams, n_bands: int, *,
+                        use_pallas: str = "auto", block_n: int = 512):
+    """[N, S] device items -> ([N, H] signatures, [N, B] band keys),
+    fused per scheme (pallas on TPU, jax elsewhere)."""
+    from .minhash_pallas import cminhash_and_keys, minhash_and_keys
+
+    if hp.scheme == "kminhash":
+        return minhash_and_keys(items, *hp.arrays, n_bands,
+                                use_pallas=use_pallas, block_n=block_n)
+    return cminhash_and_keys(items, *hp.arrays, n_bands,
+                             use_pallas=use_pallas, block_n=block_n)
+
+
+def scheme_sig_and_keys_packed(payload_d, shape: tuple, k: int, offset,
+                               hp: HashParams, n_bands: int, *,
+                               use_pallas: str = "auto",
+                               block_n: int = 512):
+    """scheme_sig_and_keys over a byte-packed wire chunk.  kminhash
+    keeps its fused-unpack pallas path (offset folds into the additive
+    hash constant); the one-permutation schemes decode on device first
+    (bit-identical by definition — decode-then-hash IS the contract the
+    fused path is verified against)."""
+    from .minhash_pallas import (_combine_bytes, cminhash_and_keys,
+                                 minhash_and_keys_packed)
+
+    if hp.scheme == "kminhash":
+        return minhash_and_keys_packed(payload_d, shape, k, offset,
+                                       *hp.arrays, n_bands,
+                                       use_pallas=use_pallas,
+                                       block_n=block_n)
+    items = _combine_bytes(payload_d, shape, k, offset)
+    return cminhash_and_keys(items, *hp.arrays, n_bands,
+                             use_pallas=use_pallas, block_n=block_n)
+
+
+# -- host dispatch -----------------------------------------------------------
+
+
+def scheme_host_signatures(items: np.ndarray, hp: HashParams) -> np.ndarray:
+    """Numpy [N, S] -> [N, H], bit-identical to the device path for the
+    same scheme (the host-oracle / prefilter / serve-query contract)."""
+    from .host import host_cminhash_signatures, host_signatures
+
+    if hp.scheme == "kminhash":
+        return host_signatures(items, *hp.arrays)
+    return host_cminhash_signatures(items, *hp.arrays)
+
+
+# -- accounting --------------------------------------------------------------
+
+
+def scheme_hash_evals(scheme: str, n_rows: int, set_size: int,
+                      n_hashes: int) -> int:
+    """Element-hash evaluations (multiply-add over an element id) a
+    signature pass executes — the honest FLOP-side comparison bench
+    emits (BENCH_r09): kminhash hashes every element once per hash
+    function; the one-permutation schemes hash every element once,
+    period (densification/banding touch [N, H] state, never re-hash an
+    element).  For ``weighted``, ``set_size`` is the expanded replica
+    width."""
+    get_scheme(scheme)
+    if scheme == "kminhash":
+        return int(n_rows) * int(set_size) * int(n_hashes)
+    return int(n_rows) * int(set_size)
+
+
+# -- weighted expansion ------------------------------------------------------
+
+
+def expand_weighted(items: np.ndarray, weights: np.ndarray,
+                    max_weight: int = MAX_WEIGHT) -> np.ndarray:
+    """[N, S] ids + [N, S] integer hit counts -> [N, S'] replica ids.
+
+    Element x with (clipped) weight w contributes replicas
+    ``x * _REPLICA_MULT + r`` for r in [0, w): plain Jaccard over the
+    replica sets equals weighted Jaccard over the clipped integer
+    weights — the exact reduction the weighted-minwise literature
+    builds on.  Rows pad to the batch's widest expansion with a
+    duplicate of their own first replica (weight >= 1 everywhere, so
+    the pad is always a real member and duplicates never move a min).
+    The expanded matrix is what enters the pipeline: wire, store
+    digests, prefilter and signatures all see the replica universe, so
+    content addressing distinguishes same-support/different-counts
+    rows for free."""
+    items = np.ascontiguousarray(items, dtype=np.uint32)
+    n, s = items.shape
+    if n == 0:
+        return np.empty((0, s), np.uint32)
+    w = np.clip(weights, 1, int(max_weight)).astype(np.int64)
+    totals = w.sum(axis=1)
+    width = int(totals.max())
+    reps = w.ravel()
+    with np.errstate(over="ignore"):
+        flat_ids = np.repeat(items.ravel(), reps)
+        idx = np.arange(int(reps.sum()), dtype=np.int64)
+        starts = np.repeat(np.cumsum(reps) - reps, reps)
+        r = (idx - starts).astype(np.uint32)
+        rep_ids = flat_ids * _REPLICA_MULT + r
+        out = np.empty((n, width), np.uint32)
+        out[:] = items[:, :1] * _REPLICA_MULT  # pad: own first replica
+    row_starts = np.repeat(np.cumsum(totals) - totals, totals)
+    row_of = np.repeat(np.arange(n, dtype=np.int64), totals)
+    out[row_of, idx - row_starts] = rep_ids
+    return out
+
+
+__all__ = ["DEFAULT_SCHEME", "HashParams", "MAX_WEIGHT", "SCHEMES",
+           "expand_weighted", "get_scheme", "make_params",
+           "scheme_hash_evals", "scheme_host_signatures",
+           "scheme_sig_and_keys", "scheme_sig_and_keys_packed",
+           "scheme_signatures_traced"]

@@ -37,7 +37,8 @@ from jax.sharding import PartitionSpec as P
 from ..utils.compat import shard_map
 
 from .lsh import bucket_representatives, estimated_jaccard, propagate_labels
-from .minhash import band_keys, minhash_signatures
+from .minhash import band_keys
+from .schemes import scheme_signatures_traced
 
 
 def _band_sharded_tail(sig_loc, keys_loc, axis: str, pad_bands: int,
@@ -75,7 +76,8 @@ def _band_sharded_tail(sig_loc, keys_loc, axis: str, pad_bands: int,
 
 @lru_cache(maxsize=32)
 def _sharded_cluster_kernel(mesh, axis: str, n_bands: int, threshold: float,
-                            n_iters: int, packed: bool = False):
+                            n_iters: int, packed: bool = False,
+                            scheme: str = "kminhash"):
     # lru_cache'd factory (parallel/rq_mesh.py pattern): a jit wrapper
     # built per call would discard its compile cache every time.
     n_dev = mesh.shape[axis]
@@ -91,21 +93,31 @@ def _sharded_cluster_kernel(mesh, axis: str, n_bands: int, threshold: float,
     # combine is plain jnp (not pallas): it fuses into the row-local
     # MinHash chain under jit.
     items_spec = P(axis, None, None) if packed else P(axis, None)
+    # The scheme's hash constants ride as replicated positional arrays —
+    # (a[H], b[H]) for kminhash; (a0[1], b0[1], jmap[T, H], offs[H]) for
+    # the one-permutation schemes; specs must match each rank.  The
+    # kernel dispatches through the scheme registry so the mesh path can
+    # never drift from the single-device family (graftlint scheme-parity).
+    const_specs = ((P(None), P(None)) if scheme == "kminhash"
+                   else (P(None), P(None), P(None, None), P(None)))
 
-    # check_vma off: the shared row-local kernels (minhash_signatures,
-    # band_keys) build fori_loop carries with jnp.full/iota — replicated in
-    # the varying-manifest type system — while their bodies mix in varying
-    # shards, which the 0.9 vma checker rejects even though the program is
-    # sound.  Replication of the output is guaranteed by construction: both
-    # propagation reductions cross the mesh through `pmin`.
+    # check_vma off: the shared row-local kernels (scheme signature
+    # kernels, band_keys) build fori_loop carries with jnp.full/iota —
+    # replicated in the varying-manifest type system — while their bodies
+    # mix in varying shards, which the 0.9 vma checker rejects even
+    # though the program is sound.  Replication of the output is
+    # guaranteed by construction: both propagation reductions cross the
+    # mesh through `pmin`.
     @jax.jit
     @partial(shard_map, mesh=mesh, check_vma=False,
-             in_specs=(items_spec, P(None), P(None)), out_specs=P(None))
-    def kernel(items_loc, a, b):
+             in_specs=(items_spec,) + const_specs,
+             out_specs=P(None))
+    def kernel(items_loc, *consts):
         if packed:
             p = items_loc.astype(jnp.uint32)               # [N/d, S, 3]
             items_loc = p[..., 0] | (p[..., 1] << 8) | (p[..., 2] << 16)
-        sig_loc = minhash_signatures(items_loc, a, b)      # [N/d, H]
+        sig_loc = scheme_signatures_traced(items_loc, scheme,
+                                           consts)         # [N/d, H]
         keys_loc = band_keys(sig_loc, n_bands)             # [N/d, B]
         return _band_sharded_tail(sig_loc, keys_loc, axis, pad_bands,
                                   threshold, n_iters)

@@ -18,10 +18,12 @@ Layout (all writes tmp + ``os.replace`` — a SIGKILL mid-write leaves a
 torn temp file that the next open sweeps, never a half-shard):
 
 - ``store_manifest.json``: the policy key ``(n_hashes, seed,
-  quant_bits)`` plus the committed shard list.  A store opened under a
-  different policy REFUSES (mirrors ``cluster/checkpoint.py``'s
+  quant_bits, scheme)`` plus the committed shard list.  A store opened
+  under a different policy REFUSES (mirrors ``cluster/checkpoint.py``'s
   ``wire_quant_bits`` handling) — signatures of a different hash family
-  or quantized universe are wrong for this run, every one of them.
+  or quantized universe are wrong for this run, every one of them.  A
+  manifest with no ``scheme`` key predates the kernel family and loads
+  as ``kminhash`` (see ``normalize_policy``).
 - ``sig_NNNNN.npy`` / ``key_NNNNN.npy``: append-only shards —
   ``[M, n_hashes] uint32`` signatures, mmap-loaded so a warm probe reads
   only the rows it gathers, and ``[M, 2] uint64`` content digests
@@ -89,7 +91,24 @@ _QUARANTINE_DIR = "quarantine"
 
 # The policy tuple: any of these changing invalidates every stored
 # signature (different hash family / universe), so it is THE manifest key.
-POLICY_KEYS = ("n_hashes", "seed", "quant_bits")
+# ``scheme`` (cluster/schemes.py) joined the tuple after stores already
+# existed in the wild: a manifest WITHOUT the key is a kminhash store by
+# definition (the only family that existed when it was written), so
+# normalization defaults absent -> "kminhash" on load and every newly
+# written manifest carries the key explicitly.
+POLICY_KEYS = ("n_hashes", "seed", "quant_bits", "scheme")
+
+
+def normalize_policy(policy: dict) -> dict:
+    """Canonical policy dict: ints for the numeric keys, the scheme
+    string validated against the registry, absent scheme -> kminhash
+    (pre-scheme stores must OPEN, not refuse — the migration contract)."""
+    from .schemes import get_scheme
+
+    out = {k: int(policy[k]) for k in POLICY_KEYS
+           if k != "scheme" and k in policy}
+    out["scheme"] = get_scheme(str(policy.get("scheme", "kminhash")))
+    return out
 
 # Past this many index rows the probe index is materialized + mmap'd
 # instead of held in RAM (the bounded-memory story past ~10M rows).
@@ -240,7 +259,7 @@ class SignatureStore:
         self.directory = directory
         self.read_only = bool(read_only)
         os.makedirs(directory, exist_ok=True)
-        self.policy = {k: int(policy[k]) for k in POLICY_KEYS}
+        self.policy = normalize_policy(policy)
         if max_bytes is None:
             mb = os.environ.get("TSE1M_SIG_STORE_MAX_MB")
             max_bytes = int(float(mb) * 2**20) if mb else None
@@ -252,8 +271,13 @@ class SignatureStore:
         # Shards quarantined while opening THIS instance (scrub reports).
         self.quarantined_at_open: list[dict] = []
         prior = self._load_json(self._manifest_path)
+        # Pre-scheme manifest: normalization defaults it to kminhash; a
+        # writable open heals the manifest once so every committed
+        # manifest carries the key explicitly from here on.
+        heal_scheme = (prior is not None and not self.read_only
+                       and "scheme" not in prior.get("policy", {}))
         if prior is not None:
-            prior_policy = prior.get("policy", {})
+            prior_policy = normalize_policy(prior.get("policy", {}))
             if prior_policy != self.policy:
                 diff = {k: (prior_policy.get(k), self.policy.get(k))
                         for k in set(prior_policy) | set(self.policy)
@@ -282,7 +306,7 @@ class SignatureStore:
             self._probe_gen = 0
             self.generation = 0
         self._committed_fp = self._index_fingerprint()
-        if prior is None:
+        if prior is None or heal_scheme:
             self._write_manifest()
         self._validate_shards()
         if not self.read_only:
@@ -588,7 +612,7 @@ class SignatureStore:
                 and self._index_fingerprint(new_shards)
                 == self._index_fingerprint()):
             return False
-        prior_policy = meta.get("policy", self.policy)
+        prior_policy = normalize_policy(meta.get("policy", self.policy))
         if prior_policy != self.policy:
             raise ValueError(
                 f"signature store at {self.directory} changed policy "
@@ -994,10 +1018,14 @@ class SignatureStore:
         same universe the device did) and compare elementwise.  A shard
         holding any mismatching row is quarantined — its rows probe as
         misses and recompute, the same semantics torn/corrupt shards get.
-        Returns the ``store_scrub_verify_*`` report keys."""
+        Recompute dispatches through the scheme registry on the store's
+        OWN policy scheme (a cminhash store verifies against the
+        cminhash host kernel; a weighted store's caller feeds the same
+        replica-expanded rows it ingests), so the check stays honest for
+        every member of the kernel family.  Returns the
+        ``store_scrub_verify_*`` report keys."""
         from .encode import quantize_ids
-        from .host import host_signatures
-        from .minhash import make_hash_params
+        from .schemes import make_params, scheme_host_signatures
 
         items = np.ascontiguousarray(items, dtype=np.uint32)
         digests = row_digests(items)
@@ -1017,9 +1045,9 @@ class SignatureStore:
         qb = self.policy["quant_bits"]
         if qb:
             rows = quantize_ids(rows, qb)
-        a, b = make_hash_params(self.policy["n_hashes"],
-                                self.policy["seed"])
-        want = host_signatures(rows, a, b)
+        hp = make_params(self.policy["scheme"], self.policy["n_hashes"],
+                         self.policy["seed"])
+        want = scheme_host_signatures(rows, hp)
         bad = ~np.all(stored == want, axis=1)
         if not bad.any():
             return report
@@ -1225,7 +1253,7 @@ class ShardedSignatureStore:
                 "(store_manifest.json present); a pod run needs a sharded "
                 "root — point --sig-store at a fresh directory")
         self.root = root
-        self.policy = {k: int(policy[k]) for k in POLICY_KEYS}
+        self.policy = normalize_policy(policy)
         self.process_id = int(process_id)
         self.n_processes = max(1, int(n_processes))
         self.max_bytes = max_bytes
@@ -1251,7 +1279,7 @@ class ShardedSignatureStore:
                 # is the commit, re-read it.
                 with open(topo_path, encoding="utf-8") as f:
                     topo = json.load(f)
-        if topo.get("policy") != self.policy:
+        if normalize_policy(topo.get("policy") or {}) != self.policy:
             raise ValueError(
                 f"sharded signature store at {root} was built under a "
                 f"different policy (have {topo.get('policy')}, want "

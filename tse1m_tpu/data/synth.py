@@ -292,3 +292,41 @@ def synth_session_sets(
 
     perm = rng.permutation(n_sessions)
     return items[perm], labels[perm]
+
+
+def synth_session_hitcounts(
+    items: np.ndarray,
+    labels: np.ndarray,
+    max_weight: int = 8,
+    noise_prob: float = 0.05,
+    seed: int = 0,
+) -> np.ndarray:
+    """Per-edge hit counts for the weighted-minwise workload
+    (``--scheme weighted``): [N, S] uint32 in [1, max_weight].
+
+    The reference paper models coverage as set membership only; real
+    fuzzing coverage is a COUNT per edge, and sessions from the same
+    campaign share not just which edges they hit but how hard (a hot
+    parsing loop is hot in every near-duplicate run).  So members of a
+    planted cluster share a per-cluster count profile, with
+    ``noise_prob`` of positions re-rolled per row — planted weighted
+    Jaccard stays high within a cluster and the count profile separates
+    rows whose SETS collide by chance.  A count of 0 never occurs:
+    membership in the row's set implies at least one hit (the weighted
+    scheme clips to [1, MAX_WEIGHT] anyway — schemes.expand_weighted).
+    """
+    rng = np.random.default_rng(seed)
+    items = np.asarray(items)
+    labels = np.asarray(labels)
+    uniq, inv = np.unique(labels, return_inverse=True)
+    # Skewed profile (small counts common, hot edges rare) — geometric-
+    # ish via integer powers, deterministic per cluster.
+    base = np.minimum(
+        1 + rng.geometric(0.45, size=(uniq.size, items.shape[1])) - 1,
+        int(max_weight)).astype(np.uint32)
+    base = np.maximum(base, np.uint32(1))
+    w = base[inv].copy()
+    noise = rng.random(w.shape) < noise_prob
+    w[noise] = rng.integers(1, int(max_weight) + 1,
+                            size=int(noise.sum())).astype(np.uint32)
+    return w

@@ -430,6 +430,50 @@ def wire_layer(src: FileSource) -> list[Finding]:
     return out
 
 
+# -- 5b. scheme-parity -------------------------------------------------------
+
+# The signature-scheme registry (cluster/schemes.py) is the ONLY dispatch
+# point for signature computation: every consumer routes through it so
+# host oracle, device reference, pallas variant, prefilter and serve-side
+# MinHash can never disagree about which kernel family a run uses — the
+# bit-parity contract the store/checkpoint policy tuple pins.  The raw
+# kernels are implementation detail of these modules alone.
+_SCHEME_KERNEL_MODULES = (
+    "tse1m_tpu/cluster/schemes.py",
+    "tse1m_tpu/cluster/minhash.py",
+    "tse1m_tpu/cluster/minhash_pallas.py",
+    "tse1m_tpu/cluster/host.py",
+)
+_SCHEME_KERNEL_CALLS = {
+    "minhash_signatures", "cminhash_signatures",
+    "host_signatures", "host_cminhash_signatures",
+    "minhash_and_keys", "minhash_and_keys_pallas",
+    "minhash_and_keys_packed", "cminhash_and_keys",
+}
+
+
+def scheme_parity(src: FileSource) -> list[Finding]:
+    if src.path in _SCHEME_KERNEL_MODULES:
+        return []
+    out = []
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Call):
+            name = _dotted(node.func)
+            if name.rsplit(".", 1)[-1] in _SCHEME_KERNEL_CALLS:
+                out.append(_f(src, node,
+                              f"raw signature kernel call `{name}` "
+                              "outside the scheme registry "
+                              "(cluster/schemes.py) — a module that "
+                              "hard-codes one kernel family silently "
+                              "breaks bit-parity the moment a run "
+                              "selects another scheme; dispatch through "
+                              "schemes.scheme_sig_and_keys / "
+                              "scheme_host_signatures / "
+                              "scheme_signatures_traced, or baseline "
+                              "with a reason"))
+    return out
+
+
 # -- 6. unlocked-shared-state ------------------------------------------------
 
 def _is_lock_ctor(node: ast.AST) -> bool:
@@ -714,6 +758,7 @@ RULES = {
     "sql-interp": sql_interp,
     "host-in-jit": host_in_jit,
     "wire-layer": wire_layer,
+    "scheme-parity": scheme_parity,
     "unlocked-shared-state": unlocked_shared_state,
     "retry-bypass": retry_bypass,
     "nondeterminism": nondeterminism,

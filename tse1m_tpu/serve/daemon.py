@@ -42,11 +42,11 @@ import threading
 
 import numpy as np
 
-from ..cluster.host import host_band_keys, host_signatures
+from ..cluster.host import host_band_keys
 from ..cluster.incremental import LiveClusterIndex
-from ..cluster.minhash import make_hash_params
 from ..cluster.pipeline import (ClusterParams, _store_policy,
                                 minhash_novel_rows)
+from ..cluster.schemes import make_params, scheme_host_signatures
 from ..cluster.encode import quantize_ids
 from ..cluster.store import SignatureStore, row_digests
 from ..observability import StageRecorder, record_degradation
@@ -125,10 +125,20 @@ class ServeDaemon:
         self.state_commit_every = max(1, int(state_commit_every))
         policy = self._resolve_policy(store_dir)
         self.qbits = int(policy["quant_bits"])
+        # The store's scheme WINS (serving must answer in the kernel
+        # family the cached signatures were computed under — a legacy
+        # manifest with no scheme key is kminhash by definition), and
+        # the ingest pipeline must MinHash novel rows under the same
+        # scheme, so the params adopt it.
+        scheme = str(policy.get("scheme", self.params.scheme))
+        if scheme != self.params.scheme:
+            from dataclasses import replace
+
+            self.params = replace(self.params, scheme=scheme)
         self.store = SignatureStore(store_dir, policy)
         self.reader = SignatureStore(store_dir, policy, read_only=True)
-        self._a, self._b = make_hash_params(self.params.n_hashes,
-                                            self.params.seed)
+        self._hp = make_params(self.params.scheme, self.params.n_hashes,
+                               self.params.seed)
         self.rec = StageRecorder()
         self.watchdog = StageWatchdog()
         self.admission = AdmissionController(self.slo)
@@ -281,7 +291,7 @@ class ServeDaemon:
             return
         self.store.save_state(
             index.labels, index.locator,
-            (index.band_keys_sorted, index.band_reps),
+            index.band_tables(),
             self._all_digests(), self.params.n_bands,
             self.params.threshold)
         self._last_committed_gen = index.generation
@@ -424,7 +434,7 @@ class ServeDaemon:
             rows = vectors[miss]
             if self.qbits:
                 rows = quantize_ids(rows, self.qbits)
-            sigs = host_signatures(rows, self._a, self._b)
+            sigs = scheme_host_signatures(rows, self._hp)
             keys = host_band_keys(sigs, self.params.n_bands)
             out[miss] = index.query_labels(
                 sigs, keys, lambda u: self._gather_reader_sigs(index, u),
