@@ -349,6 +349,17 @@ def main() -> int:
                         "Jaccard on planted pairs, clustering quality, "
                         "and host/device bit-parity across quantization "
                         "rungs + resume (also BENCH_SCHEMES=1)")
+    p.add_argument("--traced", action="store_true",
+                   default=os.environ.get("BENCH_TRACED", "")
+                   not in ("", "0"),
+                   help="run the serving round under the graftrace "
+                        "lockset race detector (tse1m_tpu/trace) and a "
+                        "bounded deterministic-schedule explorer sweep; "
+                        "emits trace_schedules_explored / "
+                        "trace_races_found into the bench JSON and fails "
+                        "the round on any detected race (also "
+                        "BENCH_TRACED=1; explorer size via "
+                        "BENCH_TRACE_SCHEDULES, default 40)")
     p.add_argument("--sanitize", action="store_true",
                    default=os.environ.get("BENCH_SANITIZE", "")
                    not in ("", "0"),
@@ -991,8 +1002,43 @@ def main() -> int:
                                                   seed=args.seed))
 
     serve_stats = {}
-    if args.serve:
+    trace_races = 0
+    if args.serve and args.traced:
+        # The whole serving round (populate + daemon + TCP clients)
+        # under the graftrace lockset detector: every instrumented
+        # shared-state access is checked against the held-lock set.
+        from tse1m_tpu.trace import traced
+
+        with traced(raise_on_race=False) as tracer:
+            serve_stats = bench_serve()
+        trace_races = len(tracer.lockset.races)
+        if trace_races:
+            raise AssertionError(
+                f"graftrace: {trace_races} data race(s) in the serving "
+                "round:\n" + "\n".join(r.describe()
+                                       for r in tracer.lockset.races))
+    elif args.serve:
         serve_stats = bench_serve()
+
+    trace_stats = {}
+    if args.traced:
+        # Bounded deterministic-schedule sweep over the serve/store
+        # critical sections (seeded PCT + small-bound exhaustive); any
+        # invariant violation raises with a replayable schedule string.
+        from tse1m_tpu.trace.explore import explore as trace_explore
+
+        n_sched = int(os.environ.get("BENCH_TRACE_SCHEDULES", "40"))
+        explored = trace_explore("serve", n_seeded=n_sched,
+                                 exhaustive_bound=3)
+        explored_store = trace_explore("store",
+                                       n_seeded=max(10, n_sched // 2),
+                                       exhaustive_bound=3)
+        trace_stats = {
+            "trace_schedules_explored":
+                explored["trace_schedules_explored"]
+                + explored_store["trace_schedules_explored"],
+            "trace_races_found": trace_races,
+        }
 
     scheme_stats = {}
     if args.schemes_round:
@@ -1044,6 +1090,7 @@ def main() -> int:
         result["wire_drift_bytes"] = wire_drift
     result.update(warm_stats)
     result.update(serve_stats)
+    result.update(trace_stats)
     result.update(scheme_stats)
     result["scheme"] = params.scheme
     if sanitizer is not None:

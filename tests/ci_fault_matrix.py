@@ -382,6 +382,39 @@ def seat_serve_kill(store: str) -> dict:
             "store_scrub_quarantined": 0}
 
 
+def seat_schedule_replay(store: str) -> dict:
+    """graftrace: replay the committed adversarial schedule strings
+    (tests/test_trace.py ADVERSARIAL_SCHEDULES) against the real
+    serve/store planes — the thread-interleaving analogue of replaying
+    a committed fault plan.  Each replay re-runs the exact decision
+    sequence deterministically and asserts label parity, snapshot
+    monotonicity and torn-free probe views; a regression prints the
+    failing ``v1:fix:...`` string for local replay.  A bounded seeded
+    sweep on top catches schedules the committed strings no longer
+    reach after code drift."""
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    sys.path.insert(0, REPO)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from test_trace import ADVERSARIAL_SCHEDULES
+
+    from tse1m_tpu.trace.explore import explore, replay
+
+    replayed = 0
+    for scenario, sched in ADVERSARIAL_SCHEDULES.items():
+        out = replay(sched, scenario)
+        assert out.races == 0, (scenario, sched)
+        replayed += 1
+    stats = explore("serve", n_seeded=30, exhaustive_bound=3)
+    store_stats = explore("store", n_seeded=20, exhaustive_bound=3)
+    explored = (stats["trace_schedules_explored"]
+                + store_stats["trace_schedules_explored"])
+    return {"ari_vs_planted": 1.0, "degradation_events": 0,
+            "degradation_counts": {"schedule_replays": replayed,
+                                   "schedules_explored": explored},
+            "chunk_halvings": 0, "store_scrub_corrupt": 0,
+            "store_scrub_quarantined": 0}
+
+
 def seat_scheme_smoke(store: str) -> dict:
     """Signature-scheme family smoke (tier-1 speed): the sanitized 2k
     bench under ``--scheme cminhash`` with the scheme-comparison round
@@ -419,7 +452,8 @@ SEATS = {"stall": seat_stall, "oom": seat_oom, "kill": seat_kill,
          "zombie": seat_zombie,
          "leader-loss-promote": seat_leader_loss_promote,
          "serve-kill": seat_serve_kill,
-         "scheme-smoke": seat_scheme_smoke}
+         "scheme-smoke": seat_scheme_smoke,
+         "schedule-replay": seat_schedule_replay}
 
 
 def main() -> int:

@@ -1,0 +1,20 @@
+"""Fixture: mutates published snapshots — every seat must flag."""
+import numpy as np
+
+from .index import Snap
+
+
+def patch_labels(snap: Snap, row: int, lab: int) -> None:
+    snap.labels[row] = lab  # in-place write on an annotated snapshot
+
+
+class Serve:
+    def __init__(self) -> None:
+        self._snap = Snap(0, np.zeros(4, np.int64))
+
+    def absorb_in_place(self, row: int, lab: int) -> None:
+        snap = self._snap
+        snap.labels[row] = lab               # element write via alias
+        snap.labels.sort()                   # mutating method call
+        np.minimum.at(snap.labels, row, lab)  # numpy in-place sink
+        patch_labels(self._snap, row, lab)   # mutation one call away

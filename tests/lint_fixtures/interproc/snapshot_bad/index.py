@@ -1,0 +1,10 @@
+"""Fixture: a published snapshot type (immutable-after-publish)."""
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class Snap:
+    generation: int
+    labels: np.ndarray

@@ -1,0 +1,16 @@
+"""Fixture: builds fresh arrays and publishes by one reference swap."""
+import numpy as np
+
+from .index import Snap
+
+
+class Serve:
+    __publish_slots__ = ("_snap",)
+
+    def __init__(self) -> None:
+        self._snap = Snap(0, np.zeros(4, np.int64))
+
+    def absorb(self, row: int, lab: int) -> None:
+        labels = self._snap.labels.copy()   # private copy, mutate freely
+        labels[row] = lab
+        self._snap = Snap(self._snap.generation + 1, labels)  # ONE swap

@@ -1,0 +1,10 @@
+"""Fixture: the same snapshot type, used with the publish discipline."""
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class Snap:
+    generation: int
+    labels: np.ndarray

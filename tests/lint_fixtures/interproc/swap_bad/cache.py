@@ -1,0 +1,6 @@
+"""Fixture: the value type behind a published reference."""
+
+
+class Run:
+    def __init__(self) -> None:
+        self.rows = 0

@@ -1,0 +1,22 @@
+"""Fixture: read-modify-write of published references — all must flag."""
+from .cache import Run
+
+
+class Store:
+    __publish_slots__ = ("_view", "_runs")
+
+    def __init__(self) -> None:
+        self._view = Run()
+        self._runs = []
+
+    def push_bad(self, r) -> None:
+        self._runs.append(r)      # in-place mutator on the slot
+        self._runs += [r]         # augmented write to the slot
+        self._view.rows = 5       # store through the published reference
+
+    def push_alias(self, r) -> None:
+        runs = self._runs
+        runs.append(r)            # same mutation, laundered via an alias
+
+    def swap_two(self, a, b) -> None:
+        self._view, self._runs = a, b   # multi-target (non-atomic pair)

@@ -1,0 +1,16 @@
+"""Fixture: published references updated only by whole rebinds."""
+from .cache import Run
+
+
+class Store:
+    __publish_slots__ = ("_view", "_runs")
+
+    def __init__(self) -> None:
+        self._view = Run()
+        self._runs = ()
+
+    def push_good(self, r) -> None:
+        self._runs = self._runs + (r,)   # rebind: old or new, never mid
+
+    def swap(self, v) -> None:
+        self._view = v                   # one reference store

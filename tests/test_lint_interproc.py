@@ -48,10 +48,40 @@ def run_fixture(subdir: str, rule: str | None = None):
     ("lease-fence", "fence_bad", "fence_good"),
     ("lock-order", "locks_bad", "locks_good"),
     ("fault-seat-drift", "seats_bad", "seats_good"),
+    ("snapshot-publish", "snapshot_bad", "snapshot_good"),
+    ("atomic-swap", "swap_bad", "swap_good"),
 ])
 def test_pass_bad_fires_good_silent(rule, bad, good):
     assert run_fixture(bad, rule), f"{rule} missed {bad}"
     assert not run_fixture(good, rule), f"{rule} flagged {good}"
+
+
+def test_snapshot_publish_finding_classes():
+    """The planted mutation-after-publish fixture: every mutation shape
+    is caught — in-place element write, mutating method call, numpy
+    in-place sink, and the interprocedural helper mutation with its
+    witness chain down to the seat."""
+    found = run_fixture("snapshot_bad", "snapshot-publish")
+    msgs = " | ".join(f.message for f in found)
+    assert "element write" in msgs
+    assert "mutating call" in msgs and "sort" in msgs
+    assert "numpy in-place op" in msgs and "np.minimum.at" in msgs
+    chained = [f for f in found if "call(s) away" in f.message]
+    assert chained, "interprocedural mutation not chased"
+    assert any("patch_labels" in w and "item-writes" in w
+               for w in chained[0].witness)
+
+
+def test_atomic_swap_finding_classes():
+    found = run_fixture("swap_bad", "atomic-swap")
+    msgs = " | ".join(f.message for f in found)
+    assert "in-place mutator `append()`" in msgs
+    assert "aug update" in msgs
+    assert "mutation through published reference" in msgs
+    assert "multi-target" in msgs
+    aliased = [f for f in found
+               if any("aliases" in w for w in f.witness)]
+    assert aliased, "alias-laundered mutation not resolved"
 
 
 def test_taint_findings_anchor_and_witness():
@@ -127,6 +157,8 @@ def test_why_prints_witness_chain(capsys):
     ("lease-fence", "fence_bad", "LeaseSupersededError"),
     ("lock-order", "locks_bad", "_lock"),
     ("fault-seat-drift", "seats_bad", "fault_point"),
+    ("snapshot-publish", "snapshot_bad", "item-writes"),
+    ("atomic-swap", "swap_bad", "aliases"),
 ])
 def test_why_works_for_every_pass(capsys, rule, subdir, expect):
     """Acceptance: each seeded bad fixture is detected AND its --why
@@ -243,6 +275,34 @@ def test_real_tree_fault_seats_match_matrix():
     # the matrix's own plan builder refuses undeclared sites
     with pytest.raises(AssertionError):
         m.plan_rule("store.not.a.seat", kind="kill")
+
+
+def test_real_tree_publication_discipline_clean():
+    """The acceptance gate for graftrace's static layer: the real tree
+    passes snapshot-publish and atomic-swap with ZERO findings (no
+    baseline entries, no suppressions needed) — and the passes do see
+    real protected classes and publish slots, so the silence is not a
+    no-op."""
+    from tse1m_tpu.lint.engine import default_targets, repo_root
+    from tse1m_tpu.lint.interproc import (_protected_classes,
+                                          _publish_slots,
+                                          atomic_swap_pass,
+                                          snapshot_publish_pass)
+
+    root = repo_root()
+    graph = build_graph(default_targets(root), root=root, use_cache=False)
+    protected = _protected_classes(graph)
+    assert "tse1m_tpu.cluster.incremental.LiveClusterIndex" in protected
+    assert "tse1m_tpu.cluster.store._IndexSnapshot" in protected
+    slots = _publish_slots(graph)
+    assert "_snap" in slots.get("tse1m_tpu.cluster.store.SignatureStore",
+                                set())
+    assert "_index" in slots.get("tse1m_tpu.serve.daemon.ServeDaemon",
+                                 set())
+    for pass_fn in (snapshot_publish_pass, atomic_swap_pass):
+        findings = pass_fn(graph)
+        assert findings == [], [(f.location(), f.message)
+                                for f in findings]
 
 
 # -- suppression attaches across decorated defs (ride-along bugfix) ----------

@@ -235,7 +235,12 @@ def test_daemon_recovers_acked_rows_without_state(tmp_path):
 def test_concurrent_ingest_query_consistency(tmp_path):
     """Queries DURING ingest: acked rows are always known and their
     answers agree with the final partition; after quiesce the whole
-    sequence equals the cold batch labels elementwise."""
+    sequence equals the cold batch labels elementwise.  Runs under the
+    graftrace lockset detector (``traced()``, the tier-1 race-check
+    wiring): any instrumented shared-state access whose candidate
+    lockset goes empty fails the test with both stacks."""
+    from tse1m_tpu.trace import traced
+
     items = _items(800, seed=5)
     dm = _start_daemon(tmp_path)
     acked = [0]
@@ -259,18 +264,19 @@ def test_concurrent_ingest_query_consistency(tmp_path):
             errors.append(e)
 
     threads = [threading.Thread(target=querier) for _ in range(2)]
-    try:
-        for t in threads:
-            t.start()
-        for lo in range(0, 800, 80):
-            dm.ingest(items[lo:lo + 80], timeout=300)
-            acked[0] = lo + 80
-        dm.quiesce(timeout=300)
-    finally:
-        done.set()
-        for t in threads:
-            t.join(timeout=60)
-        dm.stop()
+    with traced():
+        try:
+            for t in threads:
+                t.start()
+            for lo in range(0, 800, 80):
+                dm.ingest(items[lo:lo + 80], timeout=300)
+                acked[0] = lo + 80
+            dm.quiesce(timeout=300)
+        finally:
+            done.set()
+            for t in threads:
+                t.join(timeout=60)
+            dm.stop()
     assert not errors, errors[0]
     assert observed, "queriers never ran"
     cold = cluster_sessions(items, PARAMS)

@@ -90,8 +90,11 @@ print(json.dumps(marks))
     delta_kb = marks["end_kb"] - marks["warm_kb"]
     # Anonymous growth per ingested row must stay inside the LIVE-INDEX
     # envelope (~160 B/row of labels/locator/digest-map/band-tables plus
-    # allocator churn; measured ~410 B/row) — NOT the ~512 B/row of
-    # signature bytes, which live in the LRU-bounded file-backed store.
-    # If signatures (or unbounded probe indexes) accreted on the heap,
-    # per-row growth would at least double past this bound.
-    assert delta_kb < grown_rows * 0.5, (delta_kb, grown_rows, marks)
+    # allocator churn; ~450-580 B/row measured under the suite's
+    # 8-virtual-device XLA_FLAGS, incl. the graftrace seat constants) —
+    # NOT the ~512 B/row of signature bytes, which live in the
+    # LRU-bounded file-backed store.  If signatures (or unbounded probe
+    # indexes) accreted on the heap, per-row growth would land at
+    # >= ~0.95 KB/row — well past this bound, so the pin keeps its
+    # detection power with headroom for allocator variance.
+    assert delta_kb < grown_rows * 0.7, (delta_kb, grown_rows, marks)

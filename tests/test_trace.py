@@ -1,0 +1,345 @@
+"""graftrace: deterministic schedule exploration, the Eraser-style
+lockset detector, and the regression schedules for the races the plane
+surfaced in the existing tree.
+
+The load-bearing claims:
+
+- the explorer drives >= 200 distinct seeded schedules over the
+  daemon's ingest-absorb-swap vs. query vs. reader-refresh critical
+  sections with elementwise label parity and snapshot monotonicity on
+  EVERY schedule (plus bounded-exhaustive interleavings);
+- `SignatureStore.refresh()` racing `_push_delta` consolidation and
+  eviction always shows a whole committed generation, never a torn
+  probe index — and a PLANTED two-phase index publication (the old
+  code's shape) is caught by the explorer with a replayable schedule;
+- a planted unlocked write is caught by the lockset detector with both
+  stacks, and the pre-fix `StageRecorder.as_dict` (unlocked dict read
+  racing the producer thread's `add`) is the regression that
+  previously failed;
+- every schedule failure prints a ``v1:fix:...`` string that replays
+  the exact interleaving.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from tse1m_tpu.cluster.store import SignatureStore, _IndexSnapshot
+from tse1m_tpu.observability import StageRecorder, pop_degradation_events
+from tse1m_tpu.trace import (RaceError, Schedule, ScheduleError, traced,
+                             shared_access, trace_point)
+from tse1m_tpu.trace.explore import (_store_scenario, explore, replay,
+                                     run_scenario)
+
+# One realized interleaving per scenario, committed for the CI
+# ``schedule-replay`` fault-matrix seat (tests/ci_fault_matrix.py
+# replays them in a subprocess; this module proves they stay valid).
+ADVERSARIAL_SCHEDULES = {
+    "serve": "v1:fix:q,r,w,q,w,r,q,w,r,q,w,q,r,w,r,q",
+    "store": "v1:fix:rp,rr,w,rp,w,rr,rp,w,rr,rp,w,rr,rp,w,rp",
+}
+
+
+# -- schedule strings ---------------------------------------------------------
+
+def test_schedule_string_roundtrip():
+    s = Schedule.pct(123, depth=5)
+    assert Schedule.from_string(s.to_string()).to_string() == \
+        "v1:pct:123:5"
+    f = Schedule.fixed(["w", "q", "w"])
+    assert Schedule.from_string(f.to_string()).choices == ("w", "q", "w")
+    with pytest.raises(ValueError):
+        Schedule.from_string("v2:what")
+    with pytest.raises(ValueError):
+        Schedule("rr")
+
+
+def test_scheduler_is_deterministic_and_replayable():
+    """Same schedule -> identical realized decisions; the realized fix
+    schedule replays the exact interleaving."""
+    def build(tmp):
+        log: list = []
+
+        def body(name):
+            def run():
+                for i in range(3):
+                    trace_point(f"{name}.{i}")
+                    log.append(name)
+            return run
+
+        return ({"a": body("a"), "b": body("b"), "c": body("c")},
+                lambda: None)
+
+    outs = [run_scenario("serve", Schedule.pct(7), build=build)
+            for _ in range(2)]
+    assert outs[0].decisions == outs[1].decisions
+    assert len(outs[0].decisions) > 0
+    fixed = run_scenario("serve", Schedule.fixed(outs[0].decisions),
+                         build=build)
+    assert fixed.decisions == outs[0].decisions
+
+
+# -- lockset: planted races are caught, fixed code is clean ------------------
+
+class _UnlockedCounter:
+    """Planted bug: instrumented shared write with no lock."""
+
+    def __init__(self) -> None:
+        self.n = 0
+
+    def bump(self) -> None:
+        shared_access(self, "n", write=True)
+        self.n += 1
+
+
+def _on_thread(fn) -> None:
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join()
+
+
+def test_lockset_catches_planted_unlocked_write():
+    with traced(raise_on_race=False) as tr:
+        c = _UnlockedCounter()
+        _on_thread(c.bump)
+        _on_thread(c.bump)
+    races = tr.lockset.races
+    assert len(races) == 1
+    r = races[0]
+    assert r.name == "_UnlockedCounter.n"
+    assert "NO locks" in str(r.current)
+    assert r.previous is not None  # both access sites reported
+    assert "test_trace.py" in r.current.site
+    with pytest.raises(RaceError):
+        with traced():
+            c2 = _UnlockedCounter()
+            _on_thread(c2.bump)
+            _on_thread(c2.bump)
+
+
+class _OldStageRecorder(StageRecorder):
+    """The PRE-FIX ``as_dict``: iterates the live dicts without the
+    lock while the producer thread adds — the unlocked read graftrace
+    flagged in the real tree (fixed in observability/__init__.py)."""
+
+    def as_dict(self) -> dict:
+        shared_access(self, "stages", write=False)  # no lock held
+        out: dict = {}
+        for name in sorted(self.wall):
+            out[f"stage_{name}_s"] = round(self.wall[name], 4)
+        return out
+
+
+def _stage_recorder_regression(rec: StageRecorder) -> list:
+    """The regression schedule that previously failed: producer-thread
+    adds interleaved with reader-thread dict reads."""
+    with traced(raise_on_race=False) as tr:
+        _on_thread(lambda: rec.add("h2d", 0.1, 1024))
+        rec.as_dict()
+        _on_thread(lambda: rec.add("encode", 0.2, 512))
+        rec.as_dict()
+    return tr.lockset.races
+
+
+def test_stage_recorder_unlocked_read_regression():
+    old = _stage_recorder_regression(_OldStageRecorder())
+    assert old and old[0].name == "_OldStageRecorder.stages"
+    # the fixed recorder under the exact same schedule: no race
+    assert _stage_recorder_regression(StageRecorder()) == []
+
+
+def test_latency_and_slo_layer_lockset_clean():
+    """Audit of the remaining ISSUE suspects: LatencyRecorder bucket
+    updates and the SLO counters are lock-consistent under traced()."""
+    from tse1m_tpu.observability.latency import LatencyRecorder
+    from tse1m_tpu.serve.slo import (AdmissionController, SloPolicy,
+                                     SloTracker)
+
+    with traced() as tr:
+        lat = LatencyRecorder("audit")
+        pol = SloPolicy(max_backlog_batches=2, query_p99_target_ms=0.001)
+        adm = AdmissionController(pol)
+        trk = SloTracker(pol)
+
+        def hammer(seed: int):
+            def run():
+                for i in range(50):
+                    lat.add(0.001 * ((seed + i) % 7))
+                    lat.snapshot()
+                    adm.try_admit((seed + i) % 4)
+                    adm.stats()
+                    trk.observe_query(0.5)
+                    trk.stats()
+                lat.reset_window()
+            return run
+
+        threads = [threading.Thread(target=hammer(s)) for s in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert tr.lockset.races == []
+    pop_degradation_events()  # drop the backpressure/SLO events we made
+
+
+def test_admission_transition_atomic_under_schedules():
+    """The consolidated try_admit: under EVERY small-bound interleaving
+    of reject/admit/reject the backpressure transition fires once per
+    serialized admit->reject boundary (1 or 2 events), never zero,
+    and the layer stays lockset-clean."""
+    from tse1m_tpu.serve.slo import AdmissionController, SloPolicy
+
+    def build(tmp):
+        pop_degradation_events()
+        adm = AdmissionController(SloPolicy(max_backlog_batches=4))
+        results: dict = {}
+
+        def reject(name):
+            def run():
+                results[name] = adm.try_admit(9)[0]
+            return run
+
+        def admit():
+            results["a"] = adm.try_admit(0)[0]
+
+        def validate():
+            events = [e for e in pop_degradation_events()
+                      if e["kind"] == "serve_backpressure"]
+            assert results["r1"] is False and results["r2"] is False
+            assert results["a"] is True
+            assert 1 <= len(events) <= 2, events
+            assert adm.stats()["ingest_backlog_max"] == 9
+
+        return ({"r1": reject("r1"), "a": admit, "r2": reject("r2")},
+                validate)
+
+    stats = explore("serve", n_seeded=20, exhaustive_bound=6,
+                    build=build)
+    assert stats["trace_races_found"] == 0
+
+
+# -- the explorer over the real serve/store planes ---------------------------
+
+def test_explore_serve_200_seeded_schedules_parity_and_monotonicity():
+    """The acceptance bar: >= 200 distinct seeded schedules over the
+    ingest-absorb-swap / query / refresh interleaving, every one with
+    elementwise label parity against the cold host clustering of each
+    published generation and non-decreasing snapshot generations."""
+    stats = explore("serve", n_seeded=205, exhaustive_bound=4)
+    assert stats["trace_schedules_explored"] >= 200
+    assert stats["trace_races_found"] == 0
+    assert stats["trace_distinct_traces"] >= 8
+
+
+def test_store_refresh_racing_consolidation_and_eviction():
+    """SignatureStore.refresh() racing _push_delta consolidation (the
+    delta threshold is forced to 2, so adoption consolidates inside the
+    explored window) and LRU eviction: probes always see a whole
+    committed generation."""
+    stats = explore("store", n_seeded=40, exhaustive_bound=4)
+    assert stats["trace_races_found"] == 0
+    evict = explore("store-evict", n_seeded=30, exhaustive_bound=3)
+    assert evict["trace_races_found"] == 0
+
+
+class _TornRefreshStore(SignatureStore):
+    """The PRE-FIX ``refresh()`` adoption: one snapshot swap per added
+    shard (emulated by publishing each delta run as it is built), so a
+    concurrent probe can observe the newest shard without its
+    predecessors — a store view no manifest generation ever committed.
+    This is exactly the bug the explorer surfaced in the real tree;
+    the fix batches the runs into ONE swap per refresh."""
+
+    def _delta_index_for(self, sid, keys2d):
+        run = super()._delta_index_for(sid, keys2d)
+        snap = self._snap
+        self._snap = _IndexSnapshot(snap.base, snap.deltas + (run,))
+        trace_point("store.index.torn-adopt")  # the pre-fix window
+        return run
+
+
+def test_planted_torn_index_publication_is_caught_and_replays():
+    build = lambda tmp: _store_scenario(tmp, evict=True,  # noqa: E731
+                                        reader_cls=_TornRefreshStore)
+    with pytest.raises(ScheduleError) as ei:
+        # PCT catch probability per seed is a few percent here (the
+        # window is one yield wide); the first catching seed is 167
+        explore("store-evict", n_seeded=200, exhaustive_bound=4,
+                build=build)
+    msg = str(ei.value)
+    # either detection is the planted bug: a probe observing a store
+    # view no manifest ever committed, or the adoption window crashing
+    # on a shard the writer evicted mid-refresh
+    assert "torn index" in msg or "No such file" in msg
+    assert "replay: v1:fix:" in msg
+    # the printed schedule string replays the exact failing interleaving
+    replay_str = ei.value.schedule_str
+    assert replay_str.startswith("v1:fix:")
+    with pytest.raises(ScheduleError):
+        run_scenario("store-evict", Schedule.from_string(replay_str),
+                     build=build)
+    # and the REAL store under the same schedule is torn-free
+    run_scenario("store-evict", Schedule.from_string(replay_str))
+
+
+def test_committed_adversarial_schedules_stay_green():
+    """The strings the CI ``schedule-replay`` seat replays must hold on
+    the current tree (and stay parseable)."""
+    for scenario, s in ADVERSARIAL_SCHEDULES.items():
+        out = replay(s, scenario)
+        assert out.races == 0
+
+
+def test_schedule_failure_carries_replay_string():
+    def build(tmp):
+        def boom():
+            trace_point("boom")
+            raise ValueError("planted failure")
+
+        return ({"a": boom, "b": lambda: None}, lambda: None)
+
+    with pytest.raises(ScheduleError) as ei:
+        run_scenario("serve", Schedule.pct(3), build=build)
+    assert "planted failure" in str(ei.value)
+    assert "replay: v1:fix:" in str(ei.value)
+
+
+def test_traced_does_not_nest():
+    with traced():
+        with pytest.raises(RuntimeError):
+            with traced():
+                pass
+
+
+def test_deadlock_detection_reports_schedule():
+    """Two scheduled threads taking two traced locks in opposite orders
+    deadlock under some interleaving; the scheduler reports it (with
+    the replay string) instead of hanging."""
+    from tse1m_tpu.trace import sync as tsync
+
+    def build(tmp):
+        l1, l2 = tsync.Lock("l1"), tsync.Lock("l2")
+
+        def ab():
+            with l1:
+                trace_point("ab.mid")
+                with l2:
+                    pass
+
+        def ba():
+            with l2:
+                trace_point("ba.mid")
+                with l1:
+                    pass
+
+        return ({"ab": ab, "ba": ba}, lambda: None)
+
+    with pytest.raises(ScheduleError) as ei:
+        # the bounded-exhaustive enumeration finds the inversion
+        # deterministically (no luck involved)
+        explore("serve", n_seeded=0, exhaustive_bound=6, build=build)
+    assert "deadlock" in str(ei.value)
+    assert ei.value.schedule_str.startswith("v1:fix:")

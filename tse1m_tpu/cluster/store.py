@@ -80,6 +80,7 @@ import numpy as np
 
 from ..observability import record_degradation
 from ..resilience import fault_point, io_retry_policy, retry_call
+from ..trace.hooks import shared_access, trace_point
 from ..utils.atomic import atomic_write
 from ..utils.logging import get_logger
 
@@ -216,6 +217,7 @@ class _ProbeIndex:
     readers snapshot it with one reference read."""
 
     __slots__ = ("mode", "keys", "keys2d", "shard", "row")
+    __immutable_after_publish__ = True
 
     def __init__(self, mode, keys, keys2d, shard, row):
         self.mode = mode
@@ -223,6 +225,33 @@ class _ProbeIndex:
         self.keys2d = keys2d
         self.shard = shard
         self.row = row
+
+
+class _IndexSnapshot:
+    """The store's WHOLE probe view — base index plus the LSM delta
+    runs — as one immutable object behind one reference
+    (``SignatureStore._snap``).  Base and deltas used to live in two
+    attributes; ``_build_index`` cleared the delta list *before*
+    publishing the consolidated base, so a `bulk_probe` racing a
+    `refresh()` consolidation could read the old base with the already-
+    emptied deltas and miss every delta-resident row — the torn probe
+    index graftrace's store scenario catches (tests/test_trace.py
+    plants the old two-phase publication and the explorer flags it).
+    Now every layout change constructs a fresh snapshot and swaps the
+    one reference; graftlint's ``snapshot-publish`` / ``atomic-swap``
+    passes prove nothing mutates it after the swap."""
+
+    __slots__ = ("base", "deltas")
+    __immutable_after_publish__ = True
+
+    def __init__(self, base: "_ProbeIndex", deltas: tuple = ()) -> None:
+        self.base = base
+        self.deltas = tuple(deltas)
+
+    @property
+    def n_rows(self) -> int:
+        return int(self.base.keys.shape[0]) + sum(
+            int(d.keys.shape[0]) for d in self.deltas)
 
 
 def _as_struct(digests: np.ndarray) -> np.ndarray:
@@ -252,6 +281,10 @@ class SignatureStore:
     the range's single writer.  A shard that fails its frame still reads
     as absent (in-memory drop + degradation event); the owner quarantines
     it for real on its next open."""
+
+    # graftlint atomic-swap: the probe view may only be REBOUND whole
+    # (one `_IndexSnapshot` per layout change), never mutated in place.
+    __publish_slots__ = ("_snap",)
 
     def __init__(self, directory: str, policy: dict,
                  max_bytes: int | None = None,
@@ -538,58 +571,78 @@ class SignatureStore:
         return int(os.environ.get("TSE1M_SIG_STORE_DELTA_SHARDS", 48))
 
     def _push_delta(self, sid: int, keys2d: np.ndarray) -> None:
-        self._idx_delta = self._idx_delta + [
-            self._delta_index_for(sid, keys2d)]
-        if len(self._idx_delta) > self._delta_max():
+        snap = self._snap
+        if len(snap.deltas) >= self._delta_max():
             self._build_index()
+            return
+        trace_point("store.index.delta")
+        # One swap: readers see the old snapshot or (base, deltas+run),
+        # never a half-extended view.
+        shared_access(self, "_snap", write=True, atomic=True)
+        self._snap = _IndexSnapshot(
+            snap.base, snap.deltas + (self._delta_index_for(sid, keys2d),))
 
     def _build_index(self) -> None:
         """(Re)build the sorted probe index and publish it as ONE
-        snapshot object (`self._idx`) — `bulk_probe` reads the snapshot
-        reference once, so a concurrent `refresh()` swapping in a newer
-        generation can never hand a probe keys from one generation and
-        locators from another.  Consolidates: the delta layer empties."""
-        self._idx_delta: list[_ProbeIndex] = []
+        snapshot object (`self._snap`: base + delta runs together) —
+        `bulk_probe` reads the snapshot reference once, so a concurrent
+        `refresh()` swapping in a newer generation can never hand a
+        probe keys from one generation and locators from another, and a
+        consolidation can never expose a cleared delta list against the
+        pre-consolidation base.  Consolidates: the delta layer empties."""
         total = sum(int(s["rows"]) for s in self.shards)
         if total == 0:
-            self._idx = _ProbeIndex("ram", np.empty(0, _DIG_DT),
-                                    np.empty((0, 2), np.uint64),
-                                    np.empty(0, np.int32),
-                                    np.empty(0, np.int32))
-            return
-        if total < self._idx_mmap_rows():
+            base = _ProbeIndex("ram", np.empty(0, _DIG_DT),
+                               np.empty((0, 2), np.uint64),
+                               np.empty(0, np.int32),
+                               np.empty(0, np.int32))
+        elif total < self._idx_mmap_rows():
             keys2d, loc = self._gather_index_arrays()
-            self._idx = _ProbeIndex("ram", _as_struct(keys2d), keys2d,
-                                    np.ascontiguousarray(loc[:, 0]),
-                                    np.ascontiguousarray(loc[:, 1]))
-            return
-        # Bounded-memory mode: materialize the sorted index once per
-        # shard-list generation, then PROBE VIA MMAP — steady-state RSS
-        # is O(touched pages), not O(total keys).  Hits are re-verified
-        # against the CRC-framed key shards below (`_verify_hits`), so a
-        # rotted index byte downgrades to a miss, never a wrong gather.
-        keys_path, loc_path = self._index_paths()
-        if not (os.path.exists(keys_path) and os.path.exists(loc_path)):
-            keys2d, loc = self._gather_index_arrays()
-            for path, arr in ((keys_path, keys2d), (loc_path, loc)):
-                tmp = path + ".tmp.npy"
-                np.save(tmp, arr)
-                os.replace(tmp, path)
-            del keys2d, loc
-        keys2d_mm = np.load(keys_path, mmap_mode="r")
-        loc_mm = np.load(loc_path, mmap_mode="r")
-        self._idx = _ProbeIndex("mmap",
-                                keys2d_mm.view(_DIG_DT).reshape(-1),
-                                keys2d_mm, loc_mm[:, 0], loc_mm[:, 1])
+            base = _ProbeIndex("ram", _as_struct(keys2d), keys2d,
+                               np.ascontiguousarray(loc[:, 0]),
+                               np.ascontiguousarray(loc[:, 1]))
+        else:
+            # Bounded-memory mode: materialize the sorted index once per
+            # shard-list generation, then PROBE VIA MMAP — steady-state
+            # RSS is O(touched pages), not O(total keys).  Hits are
+            # re-verified against the CRC-framed key shards below
+            # (`_verify_hits`), so a rotted index byte downgrades to a
+            # miss, never a wrong gather.
+            keys_path, loc_path = self._index_paths()
+            if not (os.path.exists(keys_path)
+                    and os.path.exists(loc_path)):
+                keys2d, loc = self._gather_index_arrays()
+                for path, arr in ((keys_path, keys2d), (loc_path, loc)):
+                    tmp = path + ".tmp.npy"
+                    np.save(tmp, arr)
+                    os.replace(tmp, path)
+                del keys2d, loc
+            keys2d_mm = np.load(keys_path, mmap_mode="r")
+            loc_mm = np.load(loc_path, mmap_mode="r")
+            base = _ProbeIndex("mmap",
+                               keys2d_mm.view(_DIG_DT).reshape(-1),
+                               keys2d_mm, loc_mm[:, 0], loc_mm[:, 1])
+        trace_point("store.index.publish")
+        shared_access(self, "_snap", write=True, atomic=True)
+        self._snap = _IndexSnapshot(base)
 
     @property
     def n_rows(self) -> int:
-        return int(self._idx.keys.shape[0]) + sum(
-            int(d.keys.shape[0]) for d in self._idx_delta)
+        return self._snap.n_rows
+
+    @property
+    def _idx(self) -> "_ProbeIndex":
+        """Base index of the current snapshot (tests/diagnostics)."""
+        return self._snap.base
+
+    @property
+    def _idx_delta(self) -> list:
+        """Delta runs of the current snapshot (tests/diagnostics)."""
+        return list(self._snap.deltas)
 
     @property
     def _idx_mode(self) -> str:
-        return self._idx.mode
+        return self._snap.base.mode
 
     def refresh(self) -> bool:
         """Adopt shard-list changes committed by this directory's single
@@ -603,6 +656,23 @@ class SignatureStore:
         and swapped in as one atomic snapshot — a probe running in
         another thread keeps its old consistent view.  Returns True when
         the view changed."""
+        for attempt in range(3):
+            try:
+                return self._refresh_once()
+            except OSError as e:
+                # A cross-process writer evicted/compacted between our
+                # manifest read and the shard loads (found by graftrace's
+                # planted pre-fix adoption schedule): re-read the
+                # manifest — it now reflects the removal — rather than
+                # surfacing a missing committed file to the reader.
+                if not self.read_only or attempt == 2:
+                    raise
+                log.warning("refresh: shard vanished mid-adoption (%s); "
+                            "re-reading the manifest", e)
+        return False  # pragma: no cover — loop always returns/raises
+
+    def _refresh_once(self) -> bool:
+        trace_point("store.refresh")
         meta = self._load_json(self._manifest_path)
         if meta is None:
             return False
@@ -649,9 +719,24 @@ class SignatureStore:
             # Append-only delta adoption: per-shard sorted indexes, no
             # O(total) re-sort — the serving reader refreshes once per
             # ingest generation and must stay cheap at millions of rows.
-            for sid in added:
-                self._push_delta(
+            # ALL adopted runs are built first and published in ONE
+            # snapshot swap: pushing per shard exposed intermediate
+            # views (e.g. the newest shard without its predecessor
+            # after an eviction skip) that never existed as a committed
+            # manifest generation — found by the graftrace store-evict
+            # schedule explorer (tests/test_trace.py).
+            snap = self._snap
+            runs = tuple(
+                self._delta_index_for(
                     sid, np.asarray(np.load(self._key_path(sid))))
+                for sid in added)
+            if len(snap.deltas) + len(runs) > self._delta_max():
+                self._build_index()
+            else:
+                trace_point("store.index.delta")
+                shared_access(self, "_snap", write=True, atomic=True)
+                self._snap = _IndexSnapshot(snap.base,
+                                            snap.deltas + runs)
         return True
 
     @property
@@ -692,8 +777,11 @@ class SignatureStore:
     def _touch_probed(self, shard: np.ndarray, hit: np.ndarray) -> None:
         """Stamp the shards this probe actually hit with a fresh probe
         generation (the LRU recency signal; persisted with the next
-        manifest write)."""
-        if not hit.any():
+        manifest write).  Read-only handles skip it (graftrace audit):
+        their stamps could never reach the manifest, and concurrent
+        query-thread probes mutating the shard entries under a racing
+        ``refresh()`` was the reader plane's one unlocked shared write."""
+        if self.read_only or not hit.any():
             return
         self._probe_gen += 1
         hot = set(int(s) for s in np.unique(shard[hit]))
@@ -709,9 +797,12 @@ class SignatureStore:
         shard = np.full(n, -1, np.int32)
         row = np.full(n, -1, np.int32)
         hit = np.zeros(n, bool)
-        # ONE snapshot read each; append/refresh swap them atomically.
-        idx = self._idx
-        deltas = self._idx_delta
+        # ONE snapshot reference read; append/refresh/consolidation swap
+        # `_snap` whole, so base and deltas can never be torn apart.
+        shared_access(self, "_snap", write=False, atomic=True)
+        snap = self._snap
+        idx = snap.base
+        deltas = snap.deltas
         if n == 0 or (idx.keys.shape[0] == 0 and not deltas):
             return hit, shard, row
         d2 = np.ascontiguousarray(digests, dtype="<u8")
@@ -774,6 +865,7 @@ class SignatureStore:
         CRC-framed, and runs under the shared retry engine (a torn write
         — or an injected one — rewrites the temp files from scratch)."""
         self._require_writable("append")
+        trace_point("store.append")
         if digests.shape[0] == 0:
             return 0
         hit, _, _ = self.bulk_probe(digests)
@@ -832,6 +924,7 @@ class SignatureStore:
             victim = min(candidates,
                          key=lambda e: (int(e.get("probe_gen", 0)),
                                         int(e["id"])))
+            trace_point("store.evict")
             self.shards.remove(victim)
             self._write_manifest()
             self._mmaps.pop(int(victim["id"]), None)
@@ -859,6 +952,7 @@ class SignatureStore:
         any append; a SIGKILL mid-write leaves temps the next open
         sweeps and the old shards untouched."""
         self._require_writable("compact")
+        trace_point("store.compact")
         if len(self.shards) < max(2, min_shards):
             return 0
         old = list(self.shards)

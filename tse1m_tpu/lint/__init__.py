@@ -21,7 +21,11 @@ b-bit-minwise argument applied to correctness tooling):
 - :mod:`interproc` — the whole-program passes: cross-file
   sql-interp/retry-bypass taint, ``lease-fence`` protocol dominance +
   LeaseSupersededError exception flow, ``lock-order`` cycle detection,
-  ``fault-seat-drift`` matrix cross-check.
+  ``fault-seat-drift`` matrix cross-check, and graftrace's static
+  layer — ``snapshot-publish`` (immutable-after-publish classes are
+  never mutated post-construction, chased across calls) and
+  ``atomic-swap`` (``__publish_slots__`` references only rebound
+  whole, never read-modify-written).
 - :mod:`runtime` — the runtime half: ``jax.transfer_guard`` wiring and a
   jit compile counter, asserting the cluster hot loop performs zero
   implicit host->device transfers within a bounded compile budget.

@@ -10,9 +10,13 @@ module builds the shared substrate the interprocedural passes
 - **FileFacts** — one JSON-serializable summary per target file: module
   name, import table, per-function call sites (with receiver/arg facts,
   lock context, try/except context, statement order), class symbol
-  tables (methods, lock attrs, ``self.x = ClassName(...)`` types),
-  direct raises, and ``fault_point(...)`` seats.  Facts are everything
-  the fixed-point passes need; the AST itself is never kept.
+  tables (methods, lock attrs, ``self.x = ClassName(...)`` types,
+  publication markers: ``frozen=True`` dataclasses,
+  ``__immutable_after_publish__``, ``__publish_slots__``), attribute
+  writes (store/item/aug, multi-target), name->attribute aliases,
+  parameter annotations, direct raises, and ``fault_point(...)``
+  seats.  Facts are everything the fixed-point passes need; the AST
+  itself is never kept.
 - **Symbol resolution** — dotted call strings resolve to fully
   qualified function names across modules: plain names through the
   import table (following one re-export hop), ``self.meth`` through the
@@ -42,7 +46,7 @@ import os
 from dataclasses import dataclass, field
 
 CACHE_BASENAME = ".graftlint_cache.json"
-_CACHE_VERSION = 2  # bump when the FileFacts shape changes
+_CACHE_VERSION = 3  # bump when the FileFacts shape changes
 
 _SQL_EXEC_ATTRS = ("execute", "executemany", "executescript")
 _SQL_TOKENS = ("SELECT", "INSERT", "UPDATE", "DELETE", "CREATE", "DROP",
@@ -112,6 +116,42 @@ def _all_params(args: ast.arguments) -> list:
     return [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
 
 
+def _ann_dotted(node: ast.AST) -> str:
+    """Best-effort dotted name of a parameter annotation: plain names,
+    quoted forward refs, and the useful half of ``X | None``."""
+    if node is None:
+        return ""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value.strip()
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.BitOr):
+        left = _ann_dotted(node.left)
+        return left or _ann_dotted(node.right)
+    if isinstance(node, ast.Subscript):  # Optional[X] / list[X]: outer
+        return _dotted(node.value)
+    return _dotted(node)
+
+
+def _attr_write_of(target: ast.AST):
+    """Decompose an assignment target into (recv dotted, attr, kind):
+    ``obj.attr = ...`` -> (obj, attr, 'store'); ``obj.attr[...] = ...``
+    -> (obj, attr, 'item'); ``name[...] = ...`` -> (name, '', 'item')
+    — the alias shape the atomic-swap pass resolves.  None otherwise."""
+    node = target
+    kind = "store"
+    while isinstance(node, ast.Starred):
+        node = node.value
+    while isinstance(node, ast.Subscript):
+        kind = "item"
+        node = node.value
+    if isinstance(node, ast.Attribute):
+        recv = _dotted(node.value)
+        if recv:
+            return recv, node.attr, kind
+    elif isinstance(node, ast.Name) and kind == "item":
+        return node.id, "", kind
+    return None
+
+
 class _FactsVisitor:
     """Source-order DFS over one parsed file, extracting FileFacts.
 
@@ -145,7 +185,8 @@ class _FactsVisitor:
                 "params": params, "decorators": decorators, "calls": [],
                 "raises": [], "broad_handlers": [], "lock_sites": [],
                 "var_types": {}, "returns_call": None,
-                "param_defaults": {}, "_env": env}
+                "param_defaults": {}, "param_annotations": {},
+                "attr_writes": [], "var_aliases": {}, "_env": env}
 
     def _fn(self) -> dict:
         return self._fn_stack[-1] if self._fn_stack else self._module_fn
@@ -210,6 +251,33 @@ class _FactsVisitor:
                         "lock_kinds": {}, "attr_types": {},
                         "line": node.lineno})
         entry["bases"] = bases
+        # Publication-discipline markers (graftrace's static layer):
+        # @dataclass(frozen=True), __immutable_after_publish__, and the
+        # __publish_slots__ tuple (lint/interproc.py snapshot-publish /
+        # atomic-swap passes).
+        for dec in node.decorator_list:
+            if isinstance(dec, ast.Call) and _dotted(dec.func).rsplit(
+                    ".", 1)[-1] == "dataclass":
+                for kw in dec.keywords:
+                    if (kw.arg == "frozen"
+                            and isinstance(kw.value, ast.Constant)
+                            and kw.value.value is True):
+                        entry["frozen"] = True
+        for stmt in node.body:
+            if not (isinstance(stmt, ast.Assign)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)):
+                continue
+            tname = stmt.targets[0].id
+            if tname == "__immutable_after_publish__" and isinstance(
+                    stmt.value, ast.Constant):
+                entry["immutable_after_publish"] = bool(stmt.value.value)
+            elif tname == "__publish_slots__" and isinstance(
+                    stmt.value, (ast.Tuple, ast.List)):
+                entry["publish_slots"] = [
+                    e.value for e in stmt.value.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)]
         # Pre-scan lock/type attrs so every method sees them regardless
         # of definition order relative to __init__.
         for inner in ast.walk(node):
@@ -264,6 +332,10 @@ class _FactsVisitor:
         for a, d in zip(args.kwonlyargs, args.kw_defaults):
             if isinstance(d, ast.Constant):
                 fn["param_defaults"][a.arg] = d.value
+        for a in pos + args.kwonlyargs:
+            ann = _ann_dotted(a.annotation)
+            if ann:
+                fn["param_annotations"][a.arg] = ann
         self.functions.append(fn)
         for dec in node.decorator_list:
             self._visit(dec)
@@ -278,8 +350,42 @@ class _FactsVisitor:
 
     # statements ------------------------------------------------------------
 
+    def _record_attr_writes(self, node, targets: list,
+                            kind_override: str | None = None) -> None:
+        fn = self._fn()
+        multi = len(targets) > 1
+        flat = []
+        for t in targets:
+            if isinstance(t, (ast.Tuple, ast.List)):
+                multi = True
+                flat.extend(t.elts)
+            else:
+                flat.append(t)
+        for t in flat:
+            rec = _attr_write_of(t)
+            if rec is None:
+                continue
+            recv, attr, kind = rec
+            fn["attr_writes"].append(
+                {"recv": recv, "attr": attr,
+                 "kind": kind_override or kind, "multi": multi,
+                 "line": node.lineno, "col": node.col_offset})
+
+    def _v_AugAssign(self, node: ast.AugAssign) -> None:
+        self._record_attr_writes(node, [node.target], kind_override="aug")
+        self._generic(node)
+
     def _v_Assign(self, node: ast.Assign) -> None:
         fn = self._fn()
+        self._record_attr_writes(node, node.targets)
+        if len(node.targets) == 1 and isinstance(node.targets[0],
+                                                 ast.Name) \
+                and isinstance(node.value, ast.Attribute):
+            src = _dotted(node.value)
+            if src:
+                # Alias fact: `idx = self._index` — the snapshot-publish
+                # and atomic-swap passes chase mutations through it.
+                fn["var_aliases"][node.targets[0].id] = src
         if isinstance(node.value, ast.Call):
             callee = _dotted(node.value.func)
             for t in node.targets:
@@ -489,6 +595,10 @@ class _FactsVisitor:
                 vt = fn["var_types"].get(a.id)
                 if vt:
                     fact["type"] = vt
+        elif isinstance(a, ast.Attribute):
+            expr = _dotted(a)
+            if expr:
+                fact = {"kind": "attr", "expr": expr}
         elif isinstance(a, ast.Call):
             fact = {"kind": "call", "callee": _dotted(a.func)}
         if self._sql_tainted(a, fn):

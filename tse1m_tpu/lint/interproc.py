@@ -714,6 +714,381 @@ def fault_seat_drift_pass(graph: ProjectGraph,
     return findings
 
 
+# -- snapshot-publish / atomic-swap (graftrace's static layer) ---------------
+#
+# The serve/store planes' lock-free reads are safe only under a
+# publish-then-never-mutate discipline: a snapshot (LiveClusterIndex,
+# the store's _IndexSnapshot) is fully constructed, published by ONE
+# reference store, and never touched again.  The runtime layers
+# (trace/explore.py schedules, the lockset detector) validate what a
+# run happens to execute; these passes prove the discipline statically:
+#
+# - ``snapshot-publish``: classes marked immutable-after-publish
+#   (``@dataclass(frozen=True)`` or ``__immutable_after_publish__``)
+#   must never be mutated outside their own constructors — no attribute
+#   store, no in-place array op (``obj.arr[i] = ...``, ``+=``), no
+#   mutating method call (``.sort()``/``.append()``/``.fill()``), no
+#   numpy in-place sink (``np.minimum.at(obj.arr, ...)``, ``out=``).
+#   Mutation through a helper is chased across calls: a function that
+#   mutates a parameter makes every call site passing a protected
+#   object a finding, with the witness chain down to the mutation seat.
+# - ``atomic-swap``: attributes declared ``__publish_slots__`` (or
+#   holding a protected class) may only be REBOUND whole — never
+#   ``.append``-ed, item-assigned, aug-assigned, multi-target-assigned,
+#   or mutated through an alias (``d = self._snap; d.base = ...``).
+
+_INPLACE_MUTATORS = frozenset((
+    "append", "extend", "insert", "remove", "clear", "sort", "reverse",
+    "fill", "put", "itemset", "resize", "partition", "setdefault",
+    "update", "popitem", "add", "discard", "setflags"))
+_NP_HEADS = ("np", "numpy", "jnp")
+
+
+def _protected_classes(graph: ProjectGraph) -> set:
+    return {cq for cq, crec in graph.classes.items()
+            if crec.get("frozen") or crec.get("immutable_after_publish")}
+
+
+def _class_of_ctor(graph: ProjectGraph, module: str,
+                   dotted: str) -> str | None:
+    """Resolve a constructor / classmethod-constructor dotted expression
+    (``LiveClusterIndex(...)``, ``LiveClusterIndex.empty(...)``) to the
+    class qual it instantiates."""
+    q = graph._resolve_dotted(module, dotted)
+    if q is None:
+        return None
+    if q in graph.classes:
+        return q
+    owner = q.rsplit(".", 1)[0]
+    return owner if owner in graph.classes else None
+
+
+def _own_class(graph: ProjectGraph, fn: dict) -> str | None:
+    cls = fn.get("cls")
+    if cls is None and fn.get("parent"):
+        cls = graph.functions.get(fn["parent"], {}).get("cls")
+    if cls is None:
+        return None
+    return f"{graph.module_of(fn['qual'])}.{cls}"
+
+
+def _recv_class(graph: ProjectGraph, fn: dict, recv: str,
+                depth: int = 0) -> str | None:
+    """Class qual of the object a dotted receiver expression denotes
+    (best effort: self, self.attr, annotated params, ctor-typed vars,
+    one alias hop)."""
+    if depth > 3 or not recv:
+        return None
+    module = graph.module_of(fn["qual"])
+    head, _, rest = recv.partition(".")
+    if rest and rest.count(".") >= 1:
+        return None  # deeper chains stay opaque
+    if head == "self":
+        own = _own_class(graph, fn)
+        if own is None:
+            return None
+        if not rest:
+            return own
+        at = graph.classes.get(own, {}).get("attr_types", {}).get(rest)
+        if at:
+            return _class_of_ctor(graph, own.rsplit(".", 1)[0], at)
+        return None
+    if rest:
+        base = _recv_class(graph, fn, head, depth + 1)
+        if base is None:
+            return None
+        at = graph.classes.get(base, {}).get("attr_types", {}).get(rest)
+        if at:
+            return _class_of_ctor(graph, base.rsplit(".", 1)[0], at)
+        return None
+    ann = fn.get("param_annotations", {}).get(head)
+    if ann:
+        c = _class_of_ctor(graph, module, ann)
+        if c:
+            return c
+    vt = fn["var_types"].get(head)
+    if vt:
+        c = _class_of_ctor(graph, module, vt)
+        if c:
+            return c
+    alias = fn.get("var_aliases", {}).get(head)
+    if alias and alias != recv:
+        return _recv_class(graph, fn, alias, depth + 1)
+    return None
+
+
+def _is_ctor(qual: str, cls_qual: str) -> bool:
+    return qual in {f"{cls_qual}.{m}"
+                    for m in ("__init__", "__post_init__", "__new__")}
+
+
+def _mut_call_targets(fn: dict):
+    """(call, obj_expr, attr) for in-place mutator calls: ``obj.attr
+    .sort()`` -> (obj, attr); ``obj.update()`` -> (obj, '')."""
+    for call in fn["calls"]:
+        callee = call["callee"]
+        if callee.startswith("<call:"):
+            continue
+        parts = callee.split(".")
+        if len(parts) < 2 or parts[-1] not in _INPLACE_MUTATORS:
+            continue
+        if len(parts) >= 3:
+            yield call, ".".join(parts[:-2]), parts[-2]
+        yield call, ".".join(parts[:-1]), ""
+
+
+def snapshot_publish_pass(graph: ProjectGraph) -> list:
+    findings: list[Finding] = []
+    protected = _protected_classes(graph)
+    if not protected:
+        return findings
+
+    def flag(qual, line, col, what, witness):
+        findings.append(_finding(
+            graph, "snapshot-publish", qual, line, col,
+            f"{what} — this class is immutable-after-publish (lock-free "
+            "readers hold references to published snapshots); build new "
+            "arrays and publish a fresh instance by one reference swap",
+            witness=witness))
+
+    # ---- direct mutations + per-function param-mutation summaries ----
+    # mut_params[qual][param] = {"seat": ..., "next": (target, param)}
+    mut_params: dict[str, dict] = {}
+    for qual, fn in graph.functions.items():
+        eff = set(_effective_params(fn))
+
+        def note_param(recv: str, seat: str) -> None:
+            head = recv.split(".")[0]
+            if head in eff:
+                mut_params.setdefault(qual, {}).setdefault(
+                    head, {"seat": seat, "next": None})
+
+        for w in fn["attr_writes"]:
+            recv, attr, kind = w["recv"], w["attr"], w["kind"]
+            target = recv if not attr else f"{recv}.{attr}"
+            seat = f"{graph.fn_file[qual]}:{w['line']} " \
+                f"{_cls_leaf(qual)} {kind}-writes `{target}`"
+            cls = _recv_class(graph, fn, recv)
+            if cls in protected and not _is_ctor(qual, cls):
+                what = {"store": f"attribute store on published "
+                                 f"`{recv}.{attr}`",
+                        "item": f"in-place element write to "
+                                f"`{target}[...]`",
+                        "aug": f"in-place augmented write to `{target}`"}
+                flag(qual, w["line"], w["col"], what[kind], [seat])
+            if attr and kind in ("store", "item", "aug"):
+                note_param(recv, seat)
+        for call, obj, attr in _mut_call_targets(fn):
+            cls = _recv_class(graph, fn, obj)
+            if cls in protected and not _is_ctor(qual, cls):
+                tgt = f"{obj}.{attr}" if attr else obj
+                flag(qual, call["line"], call["col"],
+                     f"mutating call `{call['callee']}(...)` on "
+                     f"published `{tgt}`",
+                     [f"{graph.site(qual, call)} {_cls_leaf(qual)} calls "
+                      f"{call['callee']}(...)"])
+            if attr:
+                note_param(obj, f"{graph.site(qual, call)} "
+                                f"{_cls_leaf(qual)} calls "
+                                f"{call['callee']}(...)")
+        # numpy in-place sinks: ufunc .at(...) and out= kwargs
+        for call in fn["calls"]:
+            callee = call["callee"]
+            facts = []
+            if callee.split(".")[0] in _NP_HEADS \
+                    and callee.rsplit(".", 1)[-1] == "at" \
+                    and call.get("args"):
+                facts.append(call["args"][0])
+            out_fact = call.get("kwargs", {}).get("out")
+            if out_fact is not None and (callee.split(".")[0] in _NP_HEADS
+                                         or "." in callee):
+                facts.append(out_fact)
+            for fact in facts:
+                if fact.get("kind") != "attr":
+                    continue
+                expr = fact["expr"]
+                obj = expr.rsplit(".", 1)[0] if "." in expr else expr
+                cls = _recv_class(graph, fn, obj)
+                if cls in protected and not _is_ctor(qual, cls):
+                    flag(qual, call["line"], call["col"],
+                         f"numpy in-place op `{callee}` targets "
+                         f"published `{expr}`",
+                         [f"{graph.site(qual, call)} {_cls_leaf(qual)} "
+                          f"calls {callee}(...)"])
+
+    # ---- interprocedural: protected objects entering mutating params ----
+    changed = True
+    while changed:
+        changed = False
+        for qual, fn in graph.functions.items():
+            for target, call in graph.calls.get(qual, ()):
+                tparams = mut_params.get(target)
+                callee_fn = graph.functions.get(target)
+                if not tparams or callee_fn is None:
+                    continue
+                for tparam in list(tparams):
+                    fact = _arg_for_param(callee_fn, call, tparam)
+                    if fact is None:
+                        continue
+                    if fact.get("kind") == "param":
+                        mine = mut_params.setdefault(qual, {})
+                        if fact["name"] not in mine:
+                            mine[fact["name"]] = {
+                                "seat": None,
+                                "next": (target, tparam, call)}
+                            changed = True
+
+    def mut_witness(start_qual: str, param: str) -> list:
+        out: list = []
+        qual, p = start_qual, param
+        for _ in range(12):
+            info = mut_params.get(qual, {}).get(p)
+            if info is None:
+                break
+            if info["next"] is None:
+                out.append(info["seat"])
+                break
+            nq, np_, ncall = info["next"]
+            out.append(f"{graph.site(qual, ncall)} {_cls_leaf(qual)} "
+                       f"passes `{p}` -> {_cls_leaf(nq)}(`{np_}`)")
+            qual, p = nq, np_
+        return out
+
+    for qual, fn in graph.functions.items():
+        for target, call in graph.calls.get(qual, ()):
+            tparams = mut_params.get(target)
+            callee_fn = graph.functions.get(target)
+            if not tparams or callee_fn is None:
+                continue
+            for tparam in tparams:
+                fact = _arg_for_param(callee_fn, call, tparam)
+                if fact is None:
+                    continue
+                expr = None
+                if fact.get("kind") == "attr":
+                    expr = fact["expr"]
+                elif fact.get("kind") == "var":
+                    expr = fact["name"]
+                if expr is None:
+                    continue
+                cls = _recv_class(graph, fn, expr)
+                if cls in protected and not _is_ctor(qual, cls):
+                    wit = [f"{graph.site(qual, call)} {_cls_leaf(qual)} "
+                           f"passes published `{expr}` -> "
+                           f"{_cls_leaf(target)}(`{tparam}`)"]
+                    wit += mut_witness(target, tparam)
+                    flag(qual, call["line"], call["col"],
+                         f"published `{expr}` flows into "
+                         f"`{_cls_leaf(target)}({tparam}=...)`, which "
+                         f"mutates it {len(wit) - 1} call(s) away",
+                         wit)
+    return findings
+
+
+def _publish_slots(graph: ProjectGraph) -> dict:
+    """class qual -> slot attr set: declared ``__publish_slots__`` plus
+    attrs whose constructor-assigned type is a protected class."""
+    protected = _protected_classes(graph)
+    slots: dict[str, set] = {}
+    for cq, crec in graph.classes.items():
+        s = set(crec.get("publish_slots", []))
+        module = cq.rsplit(".", 1)[0]
+        for attr, t in crec.get("attr_types", {}).items():
+            if _class_of_ctor(graph, module, t) in protected:
+                s.add(attr)
+        if s:
+            slots[cq] = s
+    return slots
+
+
+def atomic_swap_pass(graph: ProjectGraph) -> list:
+    findings: list[Finding] = []
+    slots = _publish_slots(graph)
+    if not slots:
+        return findings
+
+    def flag(qual, line, col, what, witness):
+        findings.append(_finding(
+            graph, "atomic-swap", qual, line, col,
+            f"{what} — published references are updated by rebinding "
+            "the one attribute to a freshly built value (`self.x = "
+            "new`), never read-modify-write: a concurrent reader must "
+            "see the old snapshot or the new one, nothing in between",
+            witness=witness))
+
+    def slot_of(fn: dict, expr: str):
+        """(owner class, slot, via-alias) when ``expr`` denotes a
+        publish slot: 'self._snap', 'obj._snap', or an alias var."""
+        resolved = expr
+        via = None
+        head = expr.split(".")[0]
+        if "." not in expr:
+            alias = fn.get("var_aliases", {}).get(head)
+            if alias:
+                resolved, via = alias, expr
+        if "." not in resolved:
+            return None
+        base, attr = resolved.rsplit(".", 1)
+        cls = _recv_class(graph, fn, base)
+        if cls in slots and attr in slots[cls]:
+            return cls, attr, via
+        return None
+
+    for qual, fn in graph.functions.items():
+        for w in fn["attr_writes"]:
+            recv, attr, kind = w["recv"], w["attr"], w["kind"]
+            target = recv if not attr else f"{recv}.{attr}"
+            seat = f"{graph.fn_file[qual]}:{w['line']} " \
+                f"{_cls_leaf(qual)} {kind}-writes `{target}`"
+            # (a) non-atomic update OF the slot itself
+            owner = _recv_class(graph, fn, recv) if attr else None
+            if owner in slots and attr in slots[owner]:
+                if kind in ("aug", "item"):
+                    flag(qual, w["line"], w["col"],
+                         f"in-place {kind} update of published "
+                         f"reference `{target}`", [seat])
+                elif w.get("multi"):
+                    flag(qual, w["line"], w["col"],
+                         f"multi-target assignment publishes `{target}` "
+                         "non-atomically", [seat])
+            # (b) mutation THROUGH the slot (or an alias of it)
+            hit = slot_of(fn, recv)
+            if hit is not None:
+                cls, slot, via = hit
+                wit = [seat]
+                if via is not None:
+                    wit.append(f"`{via}` aliases "
+                               f"`{_cls_leaf(cls)}.{slot}` "
+                               "(published reference)")
+                flag(qual, w["line"], w["col"],
+                     f"mutation through published reference "
+                     f"`{_cls_leaf(cls)}.{slot}`", wit)
+        seen_mut: set = set()
+        for call, obj, attr in _mut_call_targets(fn):
+            # mutator on the slot (`self._snap.append(...)`), through it
+            # (`self._snap.deltas.append(...)`), or via an alias var.
+            hit = slot_of(fn, obj)
+            if hit is None and attr:
+                hit = slot_of(fn, f"{obj}.{attr}")
+            if hit is None:
+                continue
+            cls, slot, via = hit
+            key = (call["line"], cls, slot)
+            if key in seen_mut:
+                continue
+            seen_mut.add(key)
+            wit = [f"{graph.site(qual, call)} {_cls_leaf(qual)} calls "
+                   f"{call['callee']}(...)"]
+            if via is not None:
+                wit.append(f"`{via}` aliases `{_cls_leaf(cls)}.{slot}` "
+                           "(published reference)")
+            flag(qual, call["line"], call["col"],
+                 f"in-place mutator `{call['callee'].rsplit('.', 1)[-1]}"
+                 f"()` on published reference `{_cls_leaf(cls)}.{slot}`",
+                 wit)
+    return findings
+
+
 # -- registry ----------------------------------------------------------------
 
 # pass name -> (rules it emits, callable(graph, matrix_path) -> findings)
@@ -728,10 +1103,17 @@ PROJECT_PASSES = {
                    lock_order_pass(graph)),
     "fault-seat-drift": (("fault-seat-drift",),
                          fault_seat_drift_pass),
+    "snapshot-publish": (("snapshot-publish",),
+                         lambda graph, matrix_path=None:
+                         snapshot_publish_pass(graph)),
+    "atomic-swap": (("atomic-swap",),
+                    lambda graph, matrix_path=None:
+                    atomic_swap_pass(graph)),
 }
 
 PROJECT_RULES = ("sql-interp", "retry-bypass", "lease-fence",
-                 "lock-order", "fault-seat-drift")
+                 "lock-order", "fault-seat-drift", "snapshot-publish",
+                 "atomic-swap")
 
 
 def run_project_passes(graph: ProjectGraph,
@@ -751,5 +1133,6 @@ def run_project_passes(graph: ProjectGraph,
 
 
 __all__ = ["MATRIX_DEFAULT", "PROJECT_PASSES", "PROJECT_RULES",
-           "fault_seat_drift_pass", "lease_fence_pass", "lock_order_pass",
-           "run_project_passes", "taint_pass"]
+           "atomic_swap_pass", "fault_seat_drift_pass",
+           "lease_fence_pass", "lock_order_pass", "run_project_passes",
+           "snapshot_publish_pass", "taint_pass"]

@@ -35,9 +35,11 @@ codec's win).
 from __future__ import annotations
 
 import contextlib
-import threading
 import time
 from collections import defaultdict
+
+from ..trace import sync as tsync
+from ..trace.hooks import shared_access
 
 STAGES = ("encode", "h2d", "compute", "d2h", "probe", "load",
           "prefilter", "entropy")
@@ -47,17 +49,23 @@ class StageRecorder:
     """Accumulates (wall seconds, payload bytes) per pipeline stage.
 
     Thread-safe: the streaming pipeline's producer thread records encode
-    and h2d concurrently with the main thread's compute.
-    """
+    and h2d concurrently with the main thread's compute — and the
+    readers (``as_dict`` / ``h2d_overlap_fraction``) snapshot under the
+    same lock.  They used to iterate the live dicts unlocked, which the
+    graftrace lockset detector flagged: a producer adding a NEW stage
+    key mid-``sum(self.wall.values())`` is a dict-changed-size crash,
+    and even without one the reader could tear a wall against its
+    bytes (regression schedule: tests/test_trace.py)."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = tsync.Lock("StageRecorder")
         self.wall: dict[str, float] = defaultdict(float)
         self.nbytes: dict[str, int] = defaultdict(int)
         self.total_wall_s: float = 0.0
 
     def add(self, stage: str, seconds: float, nbytes: int = 0) -> None:
         with self._lock:
+            shared_access(self, "stages", write=True)
             self.wall[stage] += seconds
             self.nbytes[stage] += nbytes
 
@@ -74,7 +82,21 @@ class StageRecorder:
         # can still be adding its last h2d record when the main thread
         # closes out the run (caught by graftlint unlocked-shared-state).
         with self._lock:
+            shared_access(self, "stages", write=True)
             self.total_wall_s = seconds
+
+    def _snapshot_locked(self) -> tuple[dict, dict, float]:
+        with self._lock:
+            shared_access(self, "stages", write=False)
+            return dict(self.wall), dict(self.nbytes), self.total_wall_s
+
+    @staticmethod
+    def _overlap(walls: dict, total: float) -> float:
+        h2d = walls.get("h2d", 0.0)
+        if h2d <= 0.0 or total <= 0.0:
+            return 0.0
+        hidden = sum(walls.values()) - total
+        return round(min(1.0, max(0.0, hidden / h2d)), 4)
 
     def h2d_overlap_fraction(self) -> float:
         """Fraction of H2D seconds hidden behind other stages.
@@ -84,22 +106,20 @@ class StageRecorder:
         H2D wall answers the question the double-buffer exists for: how
         much of the wire time did compute/encode absorb?
         """
-        h2d = self.wall.get("h2d", 0.0)
-        if h2d <= 0.0 or self.total_wall_s <= 0.0:
-            return 0.0
-        hidden = sum(self.wall.values()) - self.total_wall_s
-        return round(min(1.0, max(0.0, hidden / h2d)), 4)
+        walls, _, total = self._snapshot_locked()
+        return self._overlap(walls, total)
 
     def as_dict(self) -> dict:
         """Flat bench-JSON form: stage_<name>_s / stage_<name>_mb keys."""
+        walls, nbytes, total = self._snapshot_locked()
         out: dict = {}
-        for name in sorted(self.wall):
-            out[f"stage_{name}_s"] = round(self.wall[name], 4)
-            if self.nbytes.get(name):
-                out[f"stage_{name}_mb"] = round(self.nbytes[name] / 2**20, 2)
-        if self.total_wall_s:
-            out["stage_total_wall_s"] = round(self.total_wall_s, 4)
-        out["h2d_overlap_fraction"] = self.h2d_overlap_fraction()
+        for name in sorted(walls):
+            out[f"stage_{name}_s"] = round(walls[name], 4)
+            if nbytes.get(name):
+                out[f"stage_{name}_mb"] = round(nbytes[name] / 2**20, 2)
+        if total:
+            out["stage_total_wall_s"] = round(total, 4)
+        out["h2d_overlap_fraction"] = self._overlap(walls, total)
         return out
 
 
@@ -110,7 +130,7 @@ class StageRecorder:
 # slot, not an API: one producer at a time, same contract as
 # cluster.pipeline.last_run_info.
 _last_stages: dict | None = None
-_last_lock = threading.Lock()
+_last_lock = tsync.Lock("observability._last_lock")
 
 
 def record_last_stages(stages: dict) -> None:
@@ -147,7 +167,7 @@ def pop_last_stages() -> dict | None:
 # wall-clock): ``seq`` orders them within a process.
 
 _degradations: list = []
-_degradation_lock = threading.Lock()
+_degradation_lock = tsync.Lock("observability._degradation_lock")
 _degradation_seq = 0
 
 

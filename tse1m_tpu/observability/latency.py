@@ -19,9 +19,10 @@ swings the SLO layer acts on.
 from __future__ import annotations
 
 import math
-import threading
 
 from ..resilience.watchdog import deadline_clock
+from ..trace import sync as tsync
+from ..trace.hooks import shared_access
 
 _BUCKETS_PER_DECADE = 20
 _N_BUCKETS = 9 * _BUCKETS_PER_DECADE  # 1e-6 s .. 1e3 s
@@ -52,7 +53,7 @@ class LatencyRecorder:
 
     def __init__(self, name: str) -> None:
         self.name = name
-        self._lock = threading.Lock()
+        self._lock = tsync.Lock(f"LatencyRecorder.{name}")
         self._counts = [0] * _N_BUCKETS
         self._n = 0
         self._total_s = 0.0
@@ -62,6 +63,7 @@ class LatencyRecorder:
     def add(self, seconds: float) -> None:
         b = _bucket_of(seconds)
         with self._lock:
+            shared_access(self, "buckets", write=True)
             self._counts[b] += 1
             self._n += 1
             self._total_s += seconds
@@ -90,6 +92,7 @@ class LatencyRecorder:
 
     def snapshot(self) -> dict:
         with self._lock:
+            shared_access(self, "buckets", write=False)
             if self._n == 0:
                 return {"count": 0, "p50_ms": 0.0, "p99_ms": 0.0,
                         "max_ms": 0.0, "mean_ms": 0.0, "qps": 0.0}
@@ -111,6 +114,7 @@ class LatencyRecorder:
         """Restart the qps window (and counts) — bench rounds measure a
         steady-state window, not the warmup."""
         with self._lock:
+            shared_access(self, "buckets", write=True)
             self._counts = [0] * _N_BUCKETS
             self._n = 0
             self._total_s = 0.0

@@ -53,6 +53,7 @@ from ..observability import StageRecorder, record_degradation
 from ..observability.latency import LatencyRecorder
 from ..resilience import (StageWatchdog, fault_point, reraise_if_fault)
 from ..resilience.watchdog import deadline_clock
+from ..trace.hooks import shared_access, trace_point
 from ..utils.logging import get_logger
 from .slo import AdmissionController, SloPolicy, SloTracker
 
@@ -107,13 +108,30 @@ class ServeDaemon:
     any thread; everything that WRITES (store appends, state commits,
     index swaps) happens on the one ingest thread — the same
     single-writer discipline the pod plane enforces with leases, here
-    enforced by construction."""
+    enforced by construction.
+
+    ``signer`` picks the signature backend for content-novel rows:
+    ``"device"`` (default) streams them through the degraded device
+    pipeline; ``"host"`` uses the numpy mirror
+    (`cluster.schemes.scheme_host_signatures` — bit-identical to the
+    device kernels, CI-asserted), for device-free serving hosts and the
+    graftrace schedule explorer."""
+
+    # graftlint atomic-swap: the live index is published by ONE
+    # reference swap per ingest generation; the snapshot itself is a
+    # frozen dataclass (immutable-after-publish, snapshot-publish pass).
+    __publish_slots__ = ("_index",)
 
     def __init__(self, store_dir: str,
                  params: ClusterParams | None = None,
                  slo: SloPolicy | None = None,
-                 state_commit_every: int = 8) -> None:
+                 state_commit_every: int = 8,
+                 signer: str = "device") -> None:
         from ..cluster.store import ShardedSignatureStore
+
+        if signer not in ("device", "host"):
+            raise ValueError(f"unknown signer {signer!r}; expected "
+                             "'device' or 'host'")
 
         if ShardedSignatureStore.is_sharded_root(store_dir):
             raise ValueError(
@@ -121,6 +139,7 @@ class ServeDaemon:
                 "daemon is single-host — serve one range directory, or "
                 "run one daemon per range owner")
         self.params = params or ClusterParams()
+        self.signer = signer
         self.slo = slo or SloPolicy.from_env()
         self.state_commit_every = max(1, int(state_commit_every))
         policy = self._resolve_policy(store_dir)
@@ -277,6 +296,8 @@ class ServeDaemon:
             np.ascontiguousarray(digests, np.uint64))
         # THE publication point: one reference swap; concurrent queries
         # keep whichever snapshot they already grabbed.
+        trace_point("serve.index.swap")
+        shared_access(self, "_index", write=True, atomic=True)
         self._index = new_index
 
     def _all_digests(self) -> np.ndarray:
@@ -286,6 +307,7 @@ class ServeDaemon:
                 else np.empty((0, 2), np.uint64))
 
     def _commit_state(self) -> None:
+        trace_point("serve.state.commit")
         index = self._index
         if index.n_rows == 0:
             return
@@ -310,6 +332,7 @@ class ServeDaemon:
         if not admitted:
             raise IngestRejected(depth, retry_after)
         t = _Ticket(np.ascontiguousarray(items, np.uint32))
+        trace_point("serve.queue.put")
         self._q.put(t)
         return t
 
@@ -320,6 +343,7 @@ class ServeDaemon:
     def _ingest_loop(self) -> None:
         while not self._stop.is_set():
             try:
+                trace_point("serve.queue.get")
                 t = self._q.get(timeout=0.1)
             except queue.Empty:
                 continue
@@ -374,9 +398,7 @@ class ServeDaemon:
         miss = ~s_hit
         novel = int(miss.sum())
         if novel:
-            sigs[miss] = minhash_novel_rows(
-                items[miss], self.params, self.qbits,
-                rec=self.rec, wd=self.watchdog)
+            sigs[miss] = self._sign_novel(items[miss])
         # Durability point: the ack below is only sent once this commit
         # (tmp+rename shard + manifest) has happened — a SIGKILL anywhere
         # after it loses zero acknowledged rows.
@@ -395,6 +417,15 @@ class ServeDaemon:
                 "generation": new_index.generation,
                 "labels": new_index.labels[gr].astype(int).tolist(),
                 "rows": gr.tolist()}
+
+    def _sign_novel(self, rows: np.ndarray) -> np.ndarray:
+        """[K, S] raw rows -> [K, H] uint32 signatures under the store
+        policy, via the configured backend (see class docstring)."""
+        if self.signer == "host":
+            sub = quantize_ids(rows, self.qbits) if self.qbits else rows
+            return scheme_host_signatures(sub, self._hp)
+        return minhash_novel_rows(rows, self.params, self.qbits,
+                                  rec=self.rec, wd=self.watchdog)
 
     # -- queries (any thread) ------------------------------------------------
 
@@ -422,6 +453,7 @@ class ServeDaemon:
         singleton cluster"."""
         t0 = deadline_clock()
         vectors = np.ascontiguousarray(vectors, np.uint32)
+        shared_access(self, "_index", write=False, atomic=True)
         index = self._index  # ONE snapshot reference for the whole query
         n = int(vectors.shape[0])
         digests = row_digests(vectors)

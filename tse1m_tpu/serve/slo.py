@@ -18,11 +18,12 @@ from ``resilience.watchdog.request_budget_s`` (one monotonic clock, the
 from __future__ import annotations
 
 import os
-import threading
 from dataclasses import dataclass, field
 
 from ..observability import record_degradation
 from ..resilience.watchdog import request_budget_s
+from ..trace import sync as tsync
+from ..trace.hooks import shared_access
 
 
 @dataclass(frozen=True)
@@ -64,25 +65,35 @@ class AdmissionController:
 
     def __init__(self, policy: SloPolicy) -> None:
         self.policy = policy
-        self._lock = threading.Lock()
+        self._lock = tsync.Lock("AdmissionController")
         self._rejected = 0
         self._in_backpressure = False
         self._backlog_max = 0
 
     def note_depth(self, depth: int) -> None:
         with self._lock:
+            shared_access(self, "backlog", write=True)
             if depth > self._backlog_max:
                 self._backlog_max = depth
 
     def try_admit(self, depth: int) -> tuple[bool, float]:
         """(admitted, retry_after_s).  Depth counts batches queued ahead
-        of this one."""
-        self.note_depth(depth)
-        if depth < self.policy.max_backlog_batches:
-            with self._lock:
-                self._in_backpressure = False
-            return True, 0.0
+        of this one.
+
+        ONE critical section for the whole decision (graftrace audit):
+        the old shape took the lock three times — max-depth update,
+        admit reset, reject transition — so two admitting threads
+        straddling a rejector could clear ``_in_backpressure`` between
+        its counter bump and its transition read and double-fire the
+        ``serve_backpressure`` degradation for one sustained incident
+        (regression schedule: tests/test_trace.py)."""
         with self._lock:
+            shared_access(self, "backlog", write=True)
+            if depth > self._backlog_max:
+                self._backlog_max = depth
+            if depth < self.policy.max_backlog_batches:
+                self._in_backpressure = False
+                return True, 0.0
             self._rejected += 1
             fresh = not self._in_backpressure
             self._in_backpressure = True
@@ -98,6 +109,7 @@ class AdmissionController:
 
     def stats(self) -> dict:
         with self._lock:
+            shared_access(self, "backlog", write=False)
             return {"ingest_rejected": self._rejected,
                     "ingest_backlog_max": self._backlog_max,
                     "in_backpressure": self._in_backpressure}
@@ -114,13 +126,14 @@ class SloTracker:
 
     def __init__(self, policy: SloPolicy) -> None:
         self.policy = policy
-        self._lock = threading.Lock()
+        self._lock = tsync.Lock("SloTracker")
         self._violations = 0
 
     def observe_query(self, wall_s: float) -> None:
         if wall_s * 1e3 <= self.policy.query_p99_target_ms:
             return
         with self._lock:
+            shared_access(self, "violations", write=True)
             self._violations += 1
             first = self._violations == 1
         if first:
@@ -131,6 +144,7 @@ class SloTracker:
 
     def stats(self) -> dict:
         with self._lock:
+            shared_access(self, "violations", write=False)
             return {"query_slo_violations": self._violations,
                     "query_p99_target_ms": self.policy.query_p99_target_ms}
 

@@ -1,0 +1,62 @@
+"""graftrace — the concurrency-correctness plane.
+
+Three layers over the serve/store planes' thread concurrency, the
+analogue of what graftlint does for fault transparency:
+
+1. **Deterministic schedule exploration** (`sched`, `explore`):
+   production concurrency seats (`hooks.trace_point`,
+   `hooks.shared_access`, `sync.Lock`) become yield points under an
+   installed tracer; the explorer serializes the daemon's
+   writer/query/refresh critical sections onto one scheduler token and
+   drives seeded PCT schedules plus bounded-exhaustive interleavings,
+   asserting label parity and snapshot monotonicity on every schedule.
+   Failures print a replayable ``v1:fix:...`` schedule string (the
+   ``TSE1M_FAULT_PLAN`` idiom for thread interleavings).
+2. **Eraser-style lockset race detection** (`lockset`): `traced()`
+   wraps any test/bench block the way ``lint.runtime.sanitized()``
+   wraps the transfer guard — every instrumented shared-state access
+   (StageRecorder, LatencyRecorder, SLO/admission counters, ...) is
+   checked against the held-lock set; a shared-modified location whose
+   candidate lockset goes empty raises :class:`~.lockset.RaceError`
+   with both access sites.
+3. **Static publication discipline** (graftlint's ``snapshot-publish``
+   and ``atomic-swap`` interprocedural passes, lint/interproc.py):
+   classes marked immutable-after-publish (frozen dataclasses, or
+   ``__immutable_after_publish__ = True``) must never be mutated after
+   construction, and declared ``__publish_slots__`` references may only
+   be rebound whole — never ``.append``-ed, item-assigned or
+   aug-assigned.  The runtime layers validate the schedules; the static
+   pass proves the swap discipline those schedules rely on.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from .hooks import (Tracer, active_tracer, clear_tracer, install_tracer,
+                    shared_access, trace_point)
+from .lockset import LocksetChecker, Race, RaceError
+from .sched import DeterministicScheduler, Schedule, ScheduleError
+
+
+@contextlib.contextmanager
+def traced(raise_on_race: bool = True):
+    """Run the block under the lockset race detector (the ``traced()``
+    tier-1 wiring): production code runs unmodified, every instrumented
+    shared-state access is checked against the held-lock set, and on
+    exit any detected race raises :class:`RaceError` (or is left on
+    ``tracer.lockset.races`` when ``raise_on_race=False``)."""
+    tracer = Tracer(lockset=LocksetChecker())
+    install_tracer(tracer)
+    try:
+        yield tracer
+    finally:
+        clear_tracer()
+    if raise_on_race and tracer.lockset.races:
+        raise RaceError(tracer.lockset.races)
+
+
+__all__ = ["DeterministicScheduler", "LocksetChecker", "Race",
+           "RaceError", "Schedule", "ScheduleError", "Tracer",
+           "active_tracer", "clear_tracer", "install_tracer",
+           "shared_access", "trace_point", "traced"]

@@ -1,0 +1,328 @@
+"""Schedule exploration over the serve/store concurrency surface.
+
+Each *scenario* builds the production objects fresh in a temp directory
+and hands the scheduler a set of named thread bodies exercising the
+real critical sections — no mocks, no test-only branches:
+
+- ``serve`` — the daemon's ingest-absorb-swap path (``_ingest_batch``
+  on a ``ServeDaemon`` with the host signature backend) racing
+  membership queries and an independent read-only store handle doing
+  ``refresh()`` + probes.  Invariants: every query answers from ONE
+  published snapshot (its labels for acknowledged rows equal the cold
+  host clustering of exactly that generation's row prefix,
+  elementwise), generations observed by each thread never decrease
+  (snapshot monotonicity), and reader probe coverage is always a whole
+  committed generation.
+- ``store`` — ``SignatureStore.append`` (with the LSM delta threshold
+  forced low so appends consolidate) racing a shared read-only handle's
+  ``refresh()`` and ``bulk_probe`` from two more threads.  Invariants:
+  a probe sees either the pre- or post-consolidation generation, never
+  a torn index (coverage is exactly the committed shard set of some
+  manifest generation), and gathered signatures match what was
+  appended.
+- ``store-evict`` — the same with a byte cap so appends evict LRU
+  shards; probe coverage must equal a committed (possibly evicted)
+  shard view, never a mix.
+
+:func:`explore` drives N seeded PCT schedules plus a bounded exhaustive
+enumeration of decision prefixes; every failure raises
+:class:`~tse1m_tpu.trace.sched.ScheduleError` whose message carries the
+exact replay string (``v1:fix:...``), and :func:`replay` re-runs one.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+
+from .hooks import Tracer, clear_tracer, install_tracer
+from .lockset import LocksetChecker
+from .sched import DeterministicScheduler, Schedule, ScheduleError
+
+_POLICY = {"n_hashes": 16, "seed": 0, "quant_bits": 0}
+_BATCH = 4
+_N_ROWS = 12
+
+
+# -- scenario: serve ----------------------------------------------------------
+
+
+def _serve_scenario(tmp: str):
+    import numpy as np
+
+    from ..cluster import ClusterParams, host_cluster
+    from ..cluster.store import SignatureStore, row_digests
+    from ..data.synth import synth_session_sets
+    from ..serve.daemon import ServeDaemon
+
+    params = ClusterParams(n_hashes=_POLICY["n_hashes"], n_bands=4,
+                           seed=_POLICY["seed"], use_pallas="never")
+    items = synth_session_sets(_N_ROWS, set_size=16, seed=5,
+                               dup_fraction=0.0)[0]
+    digests = row_digests(items)
+    expected = {0: np.empty(0, np.int32)}
+    for k in range(_BATCH, _N_ROWS + 1, _BATCH):
+        expected[k] = host_cluster(items[:k], n_hashes=params.n_hashes,
+                                   n_bands=params.n_bands,
+                                   seed=params.seed)
+    daemon = ServeDaemon(os.path.join(tmp, "store"), params=params,
+                         signer="host")
+    reader = SignatureStore(os.path.join(tmp, "store"),
+                            daemon.store.policy, read_only=True)
+    query_obs: list = []
+    probe_obs: list = []
+
+    def writer() -> None:
+        for lo in range(0, _N_ROWS, _BATCH):
+            daemon._ingest_batch(items[lo:lo + _BATCH])
+            idx = daemon._index
+            k = idx.n_rows
+            if not np.array_equal(idx.labels, expected[k]):
+                raise AssertionError(
+                    f"absorb broke label parity at generation "
+                    f"{idx.generation}: {idx.labels.tolist()} != "
+                    f"{expected[k].tolist()}")
+
+    def querier() -> None:
+        for _ in range(4):
+            resp = daemon.query(items)
+            query_obs.append((int(resp["generation"]),
+                              np.asarray(resp["known"]).copy(),
+                              np.asarray(resp["labels"]).copy()))
+
+    def refresher() -> None:
+        for _ in range(3):
+            reader.refresh()
+            hit, _, _ = reader.bulk_probe(digests)
+            probe_obs.append(np.asarray(hit).copy())
+
+    def validate() -> None:
+        last_gen = -1
+        for gen, known, labels in query_obs:
+            if gen < last_gen:
+                raise AssertionError(
+                    f"query generations regressed: {gen} after {last_gen}")
+            last_gen = gen
+            k = gen * _BATCH
+            if not (known[:k].all() and not known[k:].any()):
+                raise AssertionError(
+                    f"membership at generation {gen} is not the row "
+                    f"prefix of that snapshot: {known.tolist()}")
+            if not np.array_equal(labels[:k], expected[k]):
+                raise AssertionError(
+                    f"query labels at generation {gen} do not match the "
+                    f"cold clustering of its {k}-row prefix: "
+                    f"{labels[:k].tolist()} != {expected[k].tolist()}")
+        for hit in probe_obs:
+            k = int(hit.sum())
+            if k % _BATCH or not hit[:k].all():
+                raise AssertionError(
+                    "reader probe saw a torn store view: hits "
+                    f"{np.flatnonzero(hit).tolist()} are not a whole "
+                    "committed generation")
+
+    bodies = {"w": writer, "q": querier, "r": refresher}
+    return bodies, validate
+
+
+# -- scenario: store ----------------------------------------------------------
+
+
+def _store_scenario(tmp: str, evict: bool, reader_cls=None):
+    import numpy as np
+
+    from ..cluster.store import SignatureStore
+
+    if reader_cls is None:
+        reader_cls = SignatureStore
+    rng = np.random.default_rng(11)
+    n_batches, rows = 5, 3
+    digests = rng.integers(1, 2**63, size=(n_batches * rows, 2),
+                           dtype=np.uint64)
+    sigs = rng.integers(0, 2**32, size=(n_batches * rows,
+                                        _POLICY["n_hashes"]),
+                        dtype=np.uint64).astype(np.uint32)
+    max_bytes = (2 * rows * _POLICY["n_hashes"] * 4 + 1) if evict else None
+    writer_store = SignatureStore(os.path.join(tmp, "store"), _POLICY,
+                                  max_bytes=max_bytes)
+    reader = reader_cls(os.path.join(tmp, "store"), _POLICY,
+                        read_only=True)
+    probe_obs: list = []
+    batch_of = np.repeat(np.arange(n_batches), rows)
+    # Every manifest state the writer will commit, in order: the append
+    # commit (shard added, eviction pending) and each single-victim
+    # eviction step write the manifest, and all of them are views a
+    # reader may legitimately adopt.  Victim order is lowest shard id
+    # (probe_gen never advances here: the append dedup-probe misses).
+    committed: list = [frozenset()]
+    shard_sets: list = [set()]
+    live: set = set()
+    for b in range(n_batches):
+        live = live | {b}
+        shard_sets.append(set(live))
+        while evict and len(live) > 2:
+            live = live - {min(live)}
+            shard_sets.append(set(live))
+    for s in shard_sets:
+        committed.append(frozenset(
+            i for i in range(n_batches * rows) if int(batch_of[i]) in s))
+
+    def writer() -> None:
+        for b in range(n_batches):
+            blk = slice(b * rows, (b + 1) * rows)
+            writer_store.append(digests[blk], sigs[blk])
+
+    def refresher() -> None:
+        for _ in range(4):
+            reader.refresh()
+            live = {int(e["id"]) for e in reader.shards}
+            hit, _, _ = reader.bulk_probe(digests)
+            view = frozenset(int(i) for i in np.flatnonzero(hit))
+            want = frozenset(i for i in range(n_batches * rows)
+                             if int(batch_of[i]) in live)
+            if view != want:
+                raise AssertionError(
+                    f"refresh adopted shards {sorted(live)} but probe "
+                    f"coverage is {sorted(view)} (want {sorted(want)}) "
+                    "— torn probe index")
+
+    def prober() -> None:
+        for _ in range(6):
+            hit, shard, row = reader.bulk_probe(digests)
+            view = frozenset(int(i) for i in np.flatnonzero(hit))
+            probe_obs.append(view)
+            if not evict and hit.any():
+                got = reader.load_signatures(shard[hit], row[hit])
+                if not np.array_equal(got, sigs[hit]):
+                    raise AssertionError(
+                        "probe locators gathered wrong signatures "
+                        "(torn index published mid-consolidation)")
+
+    def validate() -> None:
+        valid = set(committed)
+        for view in probe_obs:
+            if view not in valid:
+                raise AssertionError(
+                    "probe saw a store view that was never committed "
+                    f"(torn index): rows {sorted(view)}; committed "
+                    f"views: {[sorted(v) for v in valid]}")
+
+    bodies = {"w": writer, "rp": prober, "rr": refresher}
+    return bodies, validate
+
+
+SCENARIOS = {
+    "serve": lambda tmp: _serve_scenario(tmp),
+    "store": lambda tmp: _store_scenario(tmp, evict=False),
+    "store-evict": lambda tmp: _store_scenario(tmp, evict=True),
+}
+
+# Env forced during a scenario run: a tiny LSM delta threshold makes
+# appends/refreshes consolidate inside the explored window (the
+# interleaving under test), and a low consolidation bound on the live
+# index exercises its delta-run path too.
+_SCENARIO_ENV = {"TSE1M_SIG_STORE_DELTA_SHARDS": "2",
+                 "TSE1M_LIVE_DELTA_RUNS": "2"}
+
+
+class RunOutcome:
+    """One schedule's realized trace (for dedup + exhaustive branching)."""
+
+    __slots__ = ("decisions", "alternatives", "schedule_str", "races")
+
+    def __init__(self, decisions, alternatives, schedule_str, races):
+        self.decisions = tuple(decisions)
+        self.alternatives = tuple(alternatives)
+        self.schedule_str = schedule_str
+        self.races = races
+
+
+def run_scenario(scenario: str, schedule: Schedule,
+                 timeout_s: float = 60.0,
+                 build=None) -> RunOutcome:
+    """Run one scenario under one schedule; raises ScheduleError (with
+    the replay string) on any invariant violation, deadlock, hang or
+    detected race.  ``build`` overrides the scenario factory (the
+    planted-bug tests inject broken subclasses through it)."""
+    if build is None:
+        if scenario not in SCENARIOS:
+            raise ValueError(f"unknown scenario {scenario!r}; have "
+                             f"{sorted(SCENARIOS)}")
+        build = SCENARIOS[scenario]
+    tmp = tempfile.mkdtemp(prefix=f"graftrace_{scenario.replace('-', '_')}_")
+    saved = {k: os.environ.get(k) for k in _SCENARIO_ENV}
+    os.environ.update(_SCENARIO_ENV)
+    sched = DeterministicScheduler(schedule, timeout_s=timeout_s)
+    lockset = LocksetChecker()
+    try:
+        bodies, validate = build(tmp)
+        install_tracer(Tracer(lockset=lockset, scheduler=sched))
+        try:
+            sched.run(bodies)
+        finally:
+            clear_tracer()
+        try:
+            validate()
+        except AssertionError as e:
+            raise ScheduleError(str(e),
+                                sched.realized().to_string()) from e
+        if lockset.races:
+            raise ScheduleError(
+                "lockset race(s) under this schedule:\n" + "\n".join(
+                    r.describe() for r in lockset.races),
+                sched.realized().to_string())
+        return RunOutcome(sched.decisions, sched.alternatives,
+                          schedule.to_string(), len(lockset.races))
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def explore(scenario: str, n_seeded: int = 200, exhaustive_bound: int = 4,
+            base_seed: int = 0, pct_depth: int = 3,
+            build=None) -> dict:
+    """N seeded PCT schedules plus bounded-exhaustive prefix
+    enumeration; returns summary stats, raises on the first failing
+    schedule (message carries the replay string)."""
+    traces: set = set()
+    runs = 0
+    for i in range(n_seeded):
+        out = run_scenario(scenario, Schedule.pct(base_seed + i,
+                                                  depth=pct_depth),
+                           build=build)
+        traces.add(out.decisions)
+        runs += 1
+    # Bounded exhaustive: branch every alternative at the first
+    # ``exhaustive_bound`` decision points, depth-first over realized
+    # traces (stateless model checking over the yield-point graph).
+    frontier: list[tuple] = [()]
+    seen_prefix: set = set()
+    while frontier:
+        prefix = frontier.pop()
+        if prefix in seen_prefix:
+            continue
+        seen_prefix.add(prefix)
+        out = run_scenario(scenario, Schedule.fixed(prefix), build=build)
+        runs += 1
+        traces.add(out.decisions)
+        for i in range(len(prefix),
+                       min(len(out.decisions), exhaustive_bound)):
+            for alt in out.alternatives[i]:
+                if alt != out.decisions[i]:
+                    frontier.append(out.decisions[:i] + (alt,))
+    return {"trace_schedules_explored": runs,
+            "trace_distinct_traces": len(traces),
+            "trace_races_found": 0}
+
+
+def replay(schedule_str: str, scenario: str = "serve") -> RunOutcome:
+    """Re-run one committed/reported schedule string exactly."""
+    return run_scenario(scenario, Schedule.from_string(schedule_str))
+
+
+__all__ = ["RunOutcome", "SCENARIOS", "explore", "replay", "run_scenario"]

@@ -1,0 +1,164 @@
+"""graftrace's production seats: one global tracer slot, zero test-only
+branches.
+
+Mirrors the fault plane (`resilience.faults`): production concurrency
+seats call :func:`trace_point` / :func:`shared_access`, and with no
+tracer installed — the production default — each call is a module-global
+read and a ``None`` check.  Installing a :class:`Tracer` (what
+``trace.traced()`` and the schedule explorer do) turns the *production*
+code paths into instrumented ones:
+
+- ``trace_point("dotted.site")`` — a scheduling yield point at a named
+  concurrency seat (queue ops, snapshot swaps, store append / refresh /
+  consolidation).  Under a deterministic scheduler the calling thread
+  may be descheduled here; without one the seat is inert.
+- ``shared_access(obj, field, write=...)`` — an instrumented
+  shared-state access for the Eraser-style lockset detector
+  (`trace.lockset`), keyed per instance.  ``atomic=True`` marks the
+  publish-then-never-mutate discipline (one-reference snapshot swaps):
+  those accesses still serve as scheduling points but are exempt from
+  lockset checking — their correctness is proven by the schedule
+  explorer's invariants and the static ``snapshot-publish`` lint pass,
+  not by lock discipline.
+- `trace.sync.Lock` / `RLock` (the traced lock primitives the audited
+  classes create) report acquire/release through the same slot, so the
+  detector knows the held-lock set at every instrumented access and the
+  scheduler can interleave threads *around* lock boundaries without
+  ever blocking the token on a real mutex.
+
+Instrumented sites (grep for ``trace_point(`` / ``shared_access(`` to
+audit): serve/daemon.py (queue put/get, index swap, state commit),
+cluster/store.py (append, refresh, probe-index delta push /
+consolidation / publication, evict, compact), observability
+(StageRecorder, LatencyRecorder, degradation/stage handoff slots),
+serve/slo.py (admission + SLO counters).
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+
+
+class Tracer:
+    """The installed instrumentation: an optional lockset checker plus
+    an optional deterministic scheduler, and the per-thread held-lock
+    bookkeeping both share."""
+
+    def __init__(self, lockset=None, scheduler=None) -> None:
+        self.lockset = lockset
+        self.scheduler = scheduler
+        self._held = threading.local()
+
+    # -- held-lock bookkeeping ----------------------------------------------
+
+    def _held_list(self) -> list:
+        lst = getattr(self._held, "locks", None)
+        if lst is None:
+            lst = []
+            self._held.locks = lst
+        return lst
+
+    def held_keys(self) -> frozenset:
+        return frozenset(k for k, _ in self._held_list())
+
+    def held_names(self) -> tuple:
+        return tuple(n for _, n in self._held_list())
+
+    # -- seat callbacks ------------------------------------------------------
+
+    def on_point(self, site: str) -> None:
+        if self.scheduler is not None:
+            self.scheduler.yield_point(site)
+
+    def on_shared_access(self, obj, field: str, write: bool,
+                         atomic: bool) -> None:
+        name = f"{type(obj).__name__}.{field}"
+        if self.scheduler is not None:
+            self.scheduler.yield_point(
+                f"{'write' if write else 'read'}:{name}")
+        if self.lockset is not None and not atomic:
+            self.lockset.on_access(
+                key=(id(obj), field), name=name, write=write,
+                held=self.held_keys(), held_names=self.held_names(),
+                site=_caller_site())
+
+    # -- traced-lock callbacks (trace.sync) ----------------------------------
+
+    def lock_acquire(self, lock, blocking: bool = True,
+                     timeout: float = -1) -> bool:
+        sched = self.scheduler
+        if sched is not None and sched.owns_current_thread():
+            sched.acquire(lock)
+        else:
+            if not lock._real.acquire(blocking, timeout):
+                return False
+        self._held_list().append((id(lock), lock.name))
+        return True
+
+    def lock_release(self, lock) -> None:
+        lst = self._held_list()
+        for i in range(len(lst) - 1, -1, -1):
+            if lst[i][0] == id(lock):
+                del lst[i]
+                break
+        lock._real.release()
+        if self.scheduler is not None:
+            self.scheduler.released(lock)
+
+
+def _caller_site(skip_prefixes: tuple = ("tse1m_tpu/trace/",)) -> str:
+    """'path:line in func' of the nearest frame outside the trace
+    plane — the access seat the report should point at."""
+    f = sys._getframe(2)
+    for _ in range(8):
+        if f is None:
+            break
+        fname = f.f_code.co_filename.replace("\\", "/")
+        if not any(p in fname for p in skip_prefixes):
+            short = "/".join(fname.rsplit("/", 3)[-3:])
+            return f"{short}:{f.f_lineno} in {f.f_code.co_name}"
+        f = f.f_back
+    return "<unknown>"
+
+
+# -- process-global tracer ----------------------------------------------------
+
+_tracer: Tracer | None = None
+
+
+def install_tracer(tracer: Tracer) -> None:
+    global _tracer
+    if _tracer is not None:
+        raise RuntimeError("a graftrace tracer is already installed "
+                           "(traced()/the explorer do not nest)")
+    _tracer = tracer
+
+
+def clear_tracer() -> None:
+    global _tracer
+    _tracer = None
+
+
+def active_tracer() -> Tracer | None:
+    return _tracer
+
+
+def trace_point(site: str) -> None:
+    """The scheduling seat production concurrency code calls.  No
+    tracer: a global read and a None check."""
+    t = _tracer
+    if t is not None:
+        t.on_point(site)
+
+
+def shared_access(obj, field: str, write: bool = False,
+                  atomic: bool = False) -> None:
+    """An instrumented shared-state access (see module docstring)."""
+    t = _tracer
+    if t is not None:
+        t.on_shared_access(obj, field, write, atomic)
+
+
+__all__ = ["Tracer", "active_tracer", "clear_tracer", "install_tracer",
+           "shared_access", "trace_point"]

@@ -1,0 +1,74 @@
+"""Traced lock primitives for the audited shared-state classes.
+
+``Lock`` / ``RLock`` wrap the real ``threading`` primitives behind the
+graftrace seat check: with no tracer installed (production default) an
+acquire is one global read, a ``None`` check and the real C acquire —
+cheap enough for the latency-histogram hot path.  With a tracer
+installed, every acquire/release updates the per-thread held-lock set
+(the Eraser lockset detector's input) and, under a deterministic
+scheduler, becomes a yield point that never blocks the scheduler token
+on a real mutex (the scheduler try-acquires and deschedules the thread
+instead, so a descheduled lock holder cannot deadlock the exploration).
+
+Classes whose state the lockset detector audits create their locks from
+this module (``self._lock = tsync.Lock()``); the ``Lock``/``RLock``
+constructor leaf is what graftlint's ``unlocked-shared-state`` and
+``lock-order`` passes already key on, so the lint planes see these
+exactly like raw ``threading`` locks.  The trace plane's own internals
+use raw ``threading`` primitives — instrumenting the instrumentation
+would recurse.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from . import hooks
+
+
+class Lock:
+    """Traced non-reentrant mutex (context-manager capable)."""
+
+    _factory = staticmethod(threading.Lock)
+
+    def __init__(self, name: str | None = None) -> None:
+        self._real = self._factory()
+        self.name = name or "anon"
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        t = hooks.active_tracer()
+        if t is None:
+            return self._real.acquire(blocking, timeout)
+        return t.lock_acquire(self, blocking, timeout)
+
+    def release(self) -> None:
+        t = hooks.active_tracer()
+        if t is None:
+            self._real.release()
+            return
+        t.lock_release(self)
+
+    def locked(self) -> bool:
+        return self._real.locked()
+
+    def __enter__(self) -> "Lock":
+        self.acquire()
+        return self
+
+    def __exit__(self, et, ev, tb) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<trace.sync.{type(self).__name__} {self.name}>"
+
+
+class RLock(Lock):
+    """Traced reentrant mutex.
+
+    The real RLock handles reentrancy; the held-set sees one entry per
+    nesting level, which keeps release bookkeeping symmetric."""
+
+    _factory = staticmethod(threading.RLock)
+
+
+__all__ = ["Lock", "RLock"]
