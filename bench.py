@@ -401,6 +401,12 @@ def main() -> int:
     from tse1m_tpu.cluster import (ClusterParams, adjusted_rand_index,
                                    cluster_sessions)
     from tse1m_tpu.data.synth import synth_session_sets
+    from tse1m_tpu.observability.tracing import adopt_trace, new_trace_id
+
+    # Pin one trace id for the whole bench round: every span any layer
+    # opens below (client, daemon, store append, retry attempts) roots
+    # under it, and the result JSON reports it as `trace_id`.
+    adopt_trace(new_trace_id())
 
     items, truth = synth_session_sets(args.n, set_size=args.set_size,
                                       seed=args.seed)
@@ -437,17 +443,23 @@ def main() -> int:
             from tse1m_tpu.lint.runtime import sanitized
 
             sanitize_ctx = sanitized(args.compile_budget)
+        from tse1m_tpu.observability.tracing import span
+
         runs = []
         with sanitize_ctx as san:
-            for i in range(iters):
-                ctx = contextlib.nullcontext()
-                if profile_dir and i == 0:
-                    ctx = jax.profiler.trace(
-                        os.path.join(profile_dir, "cluster"))
-                t0 = time.perf_counter()
-                with ctx:
-                    labels = cluster_sessions(items, prm)
-                runs.append(time.perf_counter() - t0)
+            # Root span for the timed window: even a storeless, serveless
+            # round records at least this one span under the pinned
+            # trace (one ring append per run — noise-level overhead).
+            with span("bench.cluster", n=int(args.n), iters=int(iters)):
+                for i in range(iters):
+                    ctx = contextlib.nullcontext()
+                    if profile_dir and i == 0:
+                        ctx = jax.profiler.trace(
+                            os.path.join(profile_dir, "cluster"))
+                    t0 = time.perf_counter()
+                    with ctx:
+                        labels = cluster_sessions(items, prm)
+                    runs.append(time.perf_counter() - t0)
         return labels, runs, san
 
     try:
@@ -837,6 +849,34 @@ def main() -> int:
                     f"the degraded cold run (cold 2^{cold_qb}, serve "
                     f"2^{base_qb})")
             parity = f"ari:{round(cross, 5)}"
+        # Tracing-overhead gate (telemetry plane): post-quiesce the
+        # daemon is query-only, so alternating untraced/traced windows
+        # over the same single-vector queries isolate the span plane's
+        # cost on the hot path.  Best-of-3 per mode absorbs scheduler
+        # noise; CI asserts the traced p99 stays within 10% of untraced.
+        from tse1m_tpu.observability.tracing import set_tracing
+
+        probe_idx = np.random.default_rng(11).integers(0, args.n, size=200)
+
+        def _query_window() -> float:
+            walls = []
+            with ServeClient(port=server.port) as c:
+                for i in probe_idx:
+                    t0 = time.perf_counter()
+                    c.query(items[int(i):int(i) + 1])
+                    walls.append(time.perf_counter() - t0)
+            return round(
+                float(np.percentile(np.asarray(walls), 99)) * 1e3, 3)
+
+        overhead: dict = {"untraced": [], "traced": []}
+        try:
+            for _ in range(3):
+                set_tracing(False)
+                overhead["untraced"].append(_query_window())
+                set_tracing(True)
+                overhead["traced"].append(_query_window())
+        finally:
+            set_tracing(True)
         with ServeClient(port=server.port) as c:
             c.shutdown()
         daemon.stop()
@@ -867,6 +907,8 @@ def main() -> int:
             "serve_slo_violations": int(status["query_slo_violations"]),
             "serve_parity": parity,
             "serve_sanitized": bool(args.sanitize),
+            "serve_untraced_p99_ms": min(overhead["untraced"]),
+            "serve_traced_p99_ms": min(overhead["traced"]),
         }
 
     def bench_schemes() -> dict:
@@ -1129,6 +1171,15 @@ def main() -> int:
     result["degradation_events"] = len(events)
     result["degradation_counts"] = counts
     result["chunk_halvings"] = int(counts.get("chunk_halving", 0))
+    # Telemetry-plane contract (CI asserts these keys on every round):
+    # the round's pinned trace id + span count, and a flat scalar view
+    # of the metrics registry (every key prefixed `metrics_`).
+    from tse1m_tpu.observability.export import flat_metrics
+    from tse1m_tpu.observability.tracing import pinned_trace, spans_recorded
+
+    result["trace_id"] = pinned_trace()
+    result["trace_spans_recorded"] = spans_recorded()
+    result.update(flat_metrics())
     print(json.dumps(result))
     return 0
 

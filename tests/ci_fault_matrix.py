@@ -43,7 +43,10 @@ BENCH_KEYS = ("degradation_events", "degradation_counts", "chunk_halvings",
               "store_scrub_shards", "store_scrub_corrupt",
               "store_scrub_quarantined", "store_scrub_state_ok",
               "wire_v3_saved_mb", "prefilter_hit_rate",
-              "prefilter_recall", "stage_entropy_s")
+              "prefilter_recall", "stage_entropy_s",
+              # telemetry plane: pinned trace + flat registry export
+              "trace_id", "trace_spans_recorded",
+              "metrics_stage_seconds_count")
 
 # The machine-checked seat inventory (graftlint ``fault-seat-drift``):
 # every ``fault_point(...)`` seat in production code must have an entry
@@ -310,6 +313,16 @@ def seat_zombie(store: str) -> dict:
             rdir, "run_manifest.p001.json")))
         counts1 = frag["degradation_counts"]
         assert counts1.get("lease_superseded", 0) >= 1, counts1
+        # Flight recorder: the fencing itself leaves a black box next to
+        # the manifest fragments, its terminal span naming the fenced
+        # range — parseable post-mortem evidence beyond the counters.
+        fence_flights = [json.load(open(p)) for p in sorted(
+            glob.glob(os.path.join(rdir, "flight_*.json")))]
+        fenced = [fl for fl in fence_flights
+                  if fl["reason"] == "lease_superseded"]
+        assert fenced, [fl["reason"] for fl in fence_flights]
+        assert fenced[-1]["spans"][-1]["name"] == \
+            "flight.lease_superseded", fenced[-1]["spans"][-1]
         merged = json.load(open(os.path.join(rdir, "run_manifest.json")))
         counts = merged["degradation_counts"]
         for kind in ("host_lost", "pod_failover", "epoch_advance"):

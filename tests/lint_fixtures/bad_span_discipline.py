@@ -1,0 +1,19 @@
+"""Leaky tracing spans: escape the context-manager discipline."""
+from tse1m_tpu.observability.tracing import span, start_span
+
+
+def bad_inline_span(work):
+    sp = span("work")  # never entered: the span object just leaks
+    work()
+    return sp
+
+
+def bad_manual_no_finally(work):
+    sp = start_span("work")
+    work()  # an exception here leaves the span open forever
+    sp.end()
+
+
+def bad_span_as_argument(record, work):
+    record(span("work"))  # handed off, nothing guarantees a close
+    work()

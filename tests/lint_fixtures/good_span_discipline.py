@@ -1,0 +1,30 @@
+"""Span discipline done right: contexts close, escape hatch finalizes."""
+import contextlib
+
+from tse1m_tpu.observability import tracing
+from tse1m_tpu.observability.tracing import span, start_span
+
+
+def good_with(work):
+    with span("work", kind="demo"):
+        work()
+
+
+def good_with_alias(work):
+    with tracing.span("work") as sp:
+        sp.set_tag("rows", 3)
+        work()
+
+
+def good_enter_context(work):
+    with contextlib.ExitStack() as stack:
+        stack.enter_context(span("work"))
+        work()
+
+
+def good_manual_finalized(work):
+    sp = start_span("work")
+    try:
+        work()
+    finally:
+        sp.end(ok=True)

@@ -8,6 +8,7 @@ elementwise."""
 
 from __future__ import annotations
 
+import glob
 import json
 import os
 import signal
@@ -103,6 +104,20 @@ def serve_kill_round(tmp: str, n: int = 900, batch: int = 100,
         f"kill fired at batch {killed_at}, planned {kill_batch} (rc={rc})"
     assert rc == -signal.SIGKILL, f"driver rc={rc}, wanted SIGKILL"
     assert acked_rows == kill_batch * batch
+    # Flight recorder: the kill seat's last words.  The fault plane dumps
+    # the ring + metrics to the daemon's flight dir (its store dir)
+    # BEFORE SIGKILLing itself, and the dump's terminal span names the
+    # seat that fired — the post-mortem contract.
+    flights = sorted(glob.glob(os.path.join(store, "flight_*.json")))
+    assert flights, "kill seat left no flight recorder dump"
+    with open(flights[-1], encoding="utf-8") as f:
+        flight = json.load(f)
+    assert flight["reason"] == "fault.kill", flight["reason"]
+    assert flight["site"] == "serve.ingest.commit", flight["site"]
+    last = flight["spans"][-1]
+    assert last["name"] == "flight.fault.kill", last
+    assert last["tags"].get("site") == "serve.ingest.commit", last
+    assert flight["metrics"]["counters"], "flight dump lost the registry"
     # Restart on the same store, NO fault plan: every acknowledged row
     # must still be served (known=True) — zero lost acked rows.
     os.remove(port_file)

@@ -62,6 +62,10 @@ def _rule_findings(rule: str, filename: str, relpath: str | None = None):
     # (cluster/schemes.py), never call a raw kernel family directly.
     ("scheme-parity", "bad_scheme_parity.py", "good_scheme_parity.py",
      "tse1m_tpu/serve/fixture.py"),
+    # Telemetry plane: spans close via `with` (or enter_context); the
+    # manual start_span escape hatch needs a finally-guaranteed .end().
+    ("span-discipline", "bad_span_discipline.py",
+     "good_span_discipline.py", None),
 ])
 def test_rule_bad_fires_good_silent(rule, bad, good, spoof):
     assert _rule_findings(rule, bad, spoof), f"{rule} missed {bad}"

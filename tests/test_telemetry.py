@@ -1,0 +1,407 @@
+"""Telemetry plane: span tracing, the metrics registry + exporters, and
+the crash-time flight recorder (tse1m_tpu/observability).
+
+Covers the span model (ids, nesting, propagation contexts), the bounded
+span ring under concurrent writers with the lockset detector armed, the
+typed metrics registry and its Prometheus/flat/snapshot exporters, the
+StageRecorder and degradation-counter absorption into the registry, the
+pod-manifest metrics/trace merge, and the flight recorder's dump format.
+The cross-PROCESS propagation proof (one trace id in both pod manifest
+fragments) runs as a slow 2-process integration test; the serve-plane
+wire propagation (client -> daemon -> store append) is asserted against
+a real daemon in-process."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from tse1m_tpu.observability import metrics as obs_metrics
+from tse1m_tpu.observability import tracing
+from tse1m_tpu.observability.export import (flat_metrics, metrics_snapshot,
+                                            prometheus_text)
+from tse1m_tpu.observability.flight import (dump_flight, get_flight_dir,
+                                            set_flight_dir)
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    """Each test starts from an empty ring/registry and no pinned trace
+    or flight dir — telemetry state is process-global by design."""
+    tracing.set_tracing(True)
+    tracing.adopt_trace(None)
+    tracing.clear_spans()
+    obs_metrics.reset_metrics()
+    set_flight_dir(None)
+    yield
+    tracing.set_tracing(True)
+    tracing.adopt_trace(None)
+    tracing.clear_spans()
+    obs_metrics.reset_metrics()
+    set_flight_dir(None)
+
+
+# -- spans --------------------------------------------------------------------
+
+def test_span_records_and_nests():
+    with tracing.span("outer", kind="test") as outer:
+        with tracing.span("inner") as inner:
+            assert inner.trace == outer.trace
+            assert inner.parent == outer.span_id
+    recs = tracing.recent_spans()
+    assert [r["name"] for r in recs] == ["inner", "outer"]
+    inner_rec, outer_rec = recs
+    assert inner_rec["trace"] == outer_rec["trace"]
+    assert inner_rec["parent"] == outer_rec["span"]
+    assert outer_rec["parent"] == ""
+    assert outer_rec["ok"] is True
+    assert outer_rec["tags"] == {"kind": "test"}
+    assert outer_rec["dur_s"] >= inner_rec["dur_s"]
+
+
+def test_span_failure_marks_record_not_ok():
+    with pytest.raises(ValueError):
+        with tracing.span("boom"):
+            raise ValueError("x")
+    assert tracing.recent_spans()[-1]["ok"] is False
+
+
+def test_adopted_trace_roots_all_spans():
+    tid = tracing.new_trace_id()
+    tracing.adopt_trace(tid)
+    with tracing.span("a"):
+        pass
+    with tracing.span("b"):
+        pass
+    assert {r["trace"] for r in tracing.recent_spans()} == {tid}
+    assert tracing.pinned_trace() == tid
+
+
+def test_continue_trace_joins_remote_context():
+    with tracing.span("client") as sp:
+        ctx = tracing.current_trace()
+        assert ctx == {"t": sp.trace, "s": sp.span_id}
+    with tracing.continue_trace(ctx):
+        with tracing.span("server"):
+            pass
+    server = tracing.recent_spans()[-1]
+    assert server["trace"] == ctx["t"]
+    assert server["parent"] == ctx["s"]
+    # falsy context: no-op, spans root normally instead of crashing
+    with tracing.continue_trace(None):
+        with tracing.span("solo"):
+            pass
+    assert tracing.recent_spans()[-1]["parent"] == ""
+
+
+def test_set_tracing_off_records_nothing():
+    tracing.set_tracing(False)
+    with tracing.span("ghost") as sp:
+        sp.set_tag("k", 1)  # the no-op span absorbs the full API
+    assert tracing.spans_recorded() == 0
+
+
+def test_ring_bounded_keeps_most_recent():
+    ring = tracing.SpanRing(capacity=4)
+    for i in range(10):
+        ring.append({"name": f"s{i}"})
+    assert ring.total() == 10
+    assert [r["name"] for r in ring.recent()] == ["s6", "s7", "s8", "s9"]
+    assert [r["name"] for r in ring.recent(2)] == ["s8", "s9"]
+
+
+def test_ring_lockset_clean_under_concurrent_writers():
+    """The ring is telemetry's hottest shared object: hammer it from
+    worker threads under the Eraser lockset detector — its traced lock
+    must cover every buffer access."""
+    from tse1m_tpu.trace import traced
+
+    ring = tracing.SpanRing(capacity=64)
+    with traced() as tracer:
+        def writer(k: int) -> None:
+            for i in range(200):
+                ring.append({"name": f"w{k}.{i}"})
+
+        threads = [threading.Thread(target=writer, args=(k,))
+                   for k in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert ring.total() == 800
+        assert len(ring.recent()) == 64
+    assert not tracer.lockset.races
+
+
+# -- metrics registry + exporters ---------------------------------------------
+
+def test_counter_gauge_histogram_basics():
+    c = obs_metrics.counter("req_total", op="query")
+    c.inc()
+    c.inc(2)
+    assert c.value == 3
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = obs_metrics.gauge("depth")
+    g.set(5)
+    g.set_max(3)   # high-water: lower values don't regress it
+    assert g.value == 5
+    g.set_max(9)
+    assert g.value == 9
+    h = obs_metrics.histogram("lat_s")
+    h.observe(0.010)
+    with h.time():
+        pass
+    snap = h.snapshot()
+    assert snap["count"] == 2
+    assert snap["p99_ms"] >= 0
+
+
+def test_registry_labels_are_distinct_series_and_kinds_checked():
+    obs_metrics.counter("hits", site="a").inc()
+    obs_metrics.counter("hits", site="b").inc(4)
+    names = {(m.name, tuple(sorted(m.labels.items()))): m
+             for m in obs_metrics.get_registry().collect()}
+    assert names[("hits", (("site", "a"),))].value == 1
+    assert names[("hits", (("site", "b"),))].value == 4
+    with pytest.raises(TypeError):
+        obs_metrics.gauge("hits", site="a")  # kind mismatch on one name
+
+
+def test_prometheus_text_format():
+    obs_metrics.counter("req_total", op="query").inc(3)
+    obs_metrics.gauge("depth").set(7)
+    obs_metrics.histogram("lat_s").observe(0.011)
+    text = prometheus_text()
+    assert "# TYPE req_total counter" in text
+    assert 'req_total{op="query"} 3' in text
+    assert "# TYPE depth gauge" in text
+    assert "depth 7" in text
+    assert "# TYPE lat_s histogram" in text
+    assert 'lat_s_bucket{le="+Inf"} 1' in text
+    assert "lat_s_count 1" in text
+
+
+def test_flat_metrics_and_snapshot_shapes():
+    obs_metrics.counter("x_total").inc(3)
+    obs_metrics.gauge("depth").set(7)
+    obs_metrics.histogram("lat_s").observe(0.011)
+    flat = flat_metrics()
+    assert flat["metrics_x_total"] == 3
+    assert flat["metrics_depth"] == 7.0
+    assert flat["metrics_lat_s_count"] == 1
+    assert flat["metrics_lat_s_p99_ms"] > 0
+    snap = metrics_snapshot()
+    assert json.loads(json.dumps(snap)) == snap  # JSON-safe verbatim
+    assert [c["name"] for c in snap["counters"]] == ["x_total"]
+    assert snap["histograms"][0]["count"] == 1
+    assert snap["histograms"][0]["buckets"]
+
+
+def test_stage_recorder_feeds_registry_and_as_dict_unchanged():
+    from tse1m_tpu.observability import StageRecorder
+
+    rec = StageRecorder()
+    rec.add("encode", 0.25)
+    rec.add("encode", 0.05)
+    rec.add("h2d", 0.10, nbytes=1 << 20)
+    d = rec.as_dict()
+    assert d["stage_encode_s"] == 0.3   # legacy output shape intact
+    assert d["stage_h2d_s"] == 0.1
+    assert d["stage_h2d_mb"] == 1.0
+    snap = {(h["name"], tuple(sorted(h["labels"].items()))): h
+            for h in metrics_snapshot()["histograms"]}
+    assert snap[("stage_seconds", (("stage", "encode"),))]["count"] == 2
+    assert snap[("stage_seconds", (("stage", "h2d"),))]["count"] == 1
+
+
+def test_record_degradation_counts_in_registry():
+    from tse1m_tpu.observability import (pop_degradation_events,
+                                         record_degradation)
+
+    record_degradation("stall_retry", site="pipeline.h2d")
+    record_degradation("stall_retry", site="pipeline.h2d")
+    record_degradation("chunk_halving", site="pipeline")
+    pop_degradation_events()
+    flat = flat_metrics()
+    assert flat["metrics_degradations_total"] == 3
+
+
+def test_merge_metric_snapshots_and_trace_ids(tmp_path):
+    from tse1m_tpu.observability.merge import (fragment_manifest_path,
+                                               merge_run_manifests)
+
+    def frag(pid: int, hits: int, depth: float) -> None:
+        payload = {
+            "ok": True, "summary": {"ok": 1}, "steps": [],
+            "degradation_counts": {}, "trace_id": "cafe" * 4,
+            "metrics": {
+                "counters": [{"name": "hits", "labels": {},
+                              "value": hits}],
+                "gauges": [{"name": "depth", "labels": {},
+                            "value": depth}],
+                "histograms": [{"name": "lat_s", "labels": {},
+                                "count": 2, "sum": 0.5, "p50_ms": 1.0,
+                                "p99_ms": float(pid + 1), "max_ms": 9.0,
+                                "buckets": []}],
+            },
+        }
+        with open(fragment_manifest_path(str(tmp_path), pid), "w") as f:
+            json.dump(payload, f)
+
+    frag(0, hits=2, depth=3.0)
+    frag(1, hits=5, depth=1.0)
+    merged = merge_run_manifests(str(tmp_path), 2)
+    assert merged["trace_id"] == "cafe" * 4  # both fragments agree
+    m = merged["metrics"]
+    assert m["counters"][0]["value"] == 7        # counters sum
+    assert m["gauges"][0]["value"] == 3.0        # gauges keep pod max
+    h = m["histograms"][0]
+    assert h["count"] == 4 and h["sum"] == 1.0   # histogram counts sum
+    assert h["p99_ms"] == 2.0                    # worst p99 survives
+
+
+# -- flight recorder ----------------------------------------------------------
+
+def test_dump_flight_noop_without_dir():
+    assert get_flight_dir() is None
+    assert dump_flight("unit_test") is None
+
+
+def test_dump_flight_format_and_numbering(tmp_path):
+    set_flight_dir(str(tmp_path))
+    tracing.adopt_trace("feed" * 4)
+    obs_metrics.counter("boom_total").inc()
+    with tracing.span("work", step="s1"):
+        pass
+    p0 = dump_flight("unit_test", site="seat.x", extra={"k": 1})
+    p1 = dump_flight("unit_test", site="seat.x")
+    assert os.path.basename(p0) == "flight_000.json"
+    assert os.path.basename(p1) == "flight_001.json"
+    flight = json.load(open(p0))
+    assert flight["reason"] == "unit_test"
+    assert flight["site"] == "seat.x"
+    assert flight["trace_id"] == "feed" * 4
+    assert flight["extra"] == {"k": 1}
+    # the terminal span is the dump's own marker, naming the seat; the
+    # preceding span is the work that was in flight
+    assert flight["spans"][-1]["name"] == "flight.unit_test"
+    assert flight["spans"][-1]["tags"]["site"] == "seat.x"
+    assert flight["spans"][-2]["name"] == "work"
+    assert {c["name"] for c in flight["metrics"]["counters"]} \
+        >= {"boom_total"}
+
+
+def test_env_var_seeds_flight_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv("TSE1M_FLIGHT_DIR", str(tmp_path))
+    assert get_flight_dir() == str(tmp_path)
+    assert dump_flight("env_seeded") is not None
+    set_flight_dir(str(tmp_path / "explicit"))  # explicit call wins
+    assert get_flight_dir() == str(tmp_path / "explicit")
+
+
+# -- serve-plane propagation: client -> daemon -> store append ----------------
+
+def test_serve_request_yields_one_correlated_trace(tmp_path):
+    """One ingest request over the real TCP transport produces one
+    trace spanning the client span, the server dispatch, the ingest
+    thread's batch span, and the store append — the acceptance
+    criterion's correlated-trace contract — and the live ``metrics`` /
+    ``trace`` verbs serve the telemetry back."""
+    from tse1m_tpu.cluster import ClusterParams
+    from tse1m_tpu.data.synth import synth_session_sets
+    from tse1m_tpu.serve import ServeClient, ServeDaemon, ServeServer
+
+    items, _ = synth_session_sets(64, set_size=32, seed=3)
+    params = ClusterParams(n_hashes=32, n_bands=4, use_pallas="never")
+    daemon = ServeDaemon(str(tmp_path / "store"), params=params).start()
+    server = ServeServer(daemon)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        with ServeClient(port=server.port) as c:
+            r = c.ingest(items)
+            assert r["ok"]
+            c.quiesce(timeout_s=60)
+            m = c.metrics()
+            t = c.trace()
+        assert m["ok"] and "# TYPE" in m["prometheus"]
+        # content-addressed store: rows reflect UNIQUE contents
+        assert int(m["metrics"]["metrics_serve_store_rows"]) \
+            == daemon.store.n_rows > 0
+        assert t["ok"] and t["spans_recorded"] > 0
+        by_name = {}
+        for rec in t["spans"]:
+            by_name.setdefault(rec["name"], rec)
+        chain = ["client.ingest", "serve.ingest", "serve.ingest.batch",
+                 "store.append"]
+        missing = [n for n in chain if n not in by_name]
+        assert not missing, (missing, sorted(by_name))
+        # one trace id across the whole chain, parents linking through
+        tid = by_name["client.ingest"]["trace"]
+        assert all(by_name[n]["trace"] == tid for n in chain), by_name
+        assert by_name["serve.ingest"]["parent"] == \
+            by_name["client.ingest"]["span"]
+        assert by_name["store.append"]["parent"] == \
+            by_name["serve.ingest.batch"]["span"]
+    finally:
+        daemon.stop()
+        server.server_close()
+
+
+def test_serve_status_surfaces_backlog_history(tmp_path):
+    """Satellite: `--status` used to report queue depth point-in-time
+    only; the registry-backed high-water mark and rejection counter
+    survive the drain."""
+    from tse1m_tpu.cluster import ClusterParams
+    from tse1m_tpu.data.synth import synth_session_sets
+    from tse1m_tpu.serve import ServeDaemon
+
+    items, _ = synth_session_sets(32, set_size=32, seed=5)
+    params = ClusterParams(n_hashes=32, n_bands=4, use_pallas="never")
+    # Submit BEFORE starting the drain loop so the backlog depth is
+    # deterministic: three queued batches = high-water of 2 ahead.
+    daemon = ServeDaemon(str(tmp_path / "store"), params=params)
+    try:
+        for lo in (0, 11, 22):
+            daemon.submit(items[lo:lo + 11])
+        daemon.start()
+        daemon.quiesce(timeout=60)
+        status = daemon.status()
+        assert status["queue_depth"] == 0          # drained by quiesce
+        assert status["queue_depth_hwm"] == 2      # ...but history kept
+        assert status["ingest_rejected_total"] == 0
+    finally:
+        daemon.stop()
+
+
+# -- cross-process pod propagation -------------------------------------------
+
+@pytest.mark.slow
+def test_pod_run_shares_one_trace_across_fragments(tmp_path):
+    """A clean 2-process pod run negotiates one nonce, pins it as the
+    trace id in BOTH worker processes, and each manifest fragment (and
+    the merged manifest) carries that one id."""
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from pod_harness import spawn_pod
+
+    tmp = str(tmp_path)
+    store = os.path.join(tmp, "store")
+    rdir = os.path.join(tmp, "results")
+    res = spawn_pod(tmp, store, rdir, n=400, seed=13,
+                    expect_finish=(0, 1))
+    assert res[0]["rc"] == 0, res[0]["err"][-4000:]
+    assert res[1]["rc"] == 0, res[1]["err"][-4000:]
+    frags = [json.load(open(os.path.join(
+        rdir, f"run_manifest.p{pid:03d}.json"))) for pid in (0, 1)]
+    tids = {f["trace_id"] for f in frags}
+    assert len(tids) == 1 and None not in tids, tids
+    assert all(f["spans_recorded"] > 0 for f in frags), frags
+    merged = json.load(open(os.path.join(rdir, "run_manifest.json")))
+    assert merged["trace_id"] == tids.pop()
+    assert merged["metrics"]["histograms"], merged["metrics"]

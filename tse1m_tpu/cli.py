@@ -805,6 +805,13 @@ def _cmd_serve(args) -> int:
 
     def _graceful(signum, frame):  # noqa: ARG001
         log.warning("serve: signal %d; shutting down", signum)
+        from .observability.flight import dump_flight
+
+        # Operator-initiated teardown still leaves the black box: the
+        # dump distinguishes "we were told to stop" from a crash when
+        # reading a dead deployment's store directory.
+        dump_flight("sigterm", site="serve.shutdown",
+                    extra={"signal": int(signum)})
         server.shutdown()
 
     signal.signal(signal.SIGTERM, _graceful)
@@ -957,16 +964,18 @@ def main(argv=None) -> int:
                         "bounds recovery work after a crash)")
     p.add_argument("--status", action="store_true",
                    help="client mode: print a running daemon's status "
-                        "(index generation, rows, queue depth, SLO "
-                        "counters, last scrub) and record it as a "
-                        "serve_status step in run_manifest.json")
+                        "(index generation, rows, queue depth + backlog "
+                        "high-water/rejection history, SLO counters, "
+                        "last scrub) and record it as a serve_status "
+                        "step in run_manifest.json")
     p.set_defaults(fn=_cmd_serve)
 
     p = sub.add_parser("serve-client",
                        help="one client request against a running serve "
                             "daemon")
     p.add_argument("op", choices=("ping", "status", "query", "ingest",
-                                  "quiesce", "shutdown"))
+                                  "metrics", "trace", "quiesce",
+                                  "shutdown"))
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--port-file", default=None)

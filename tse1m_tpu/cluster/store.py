@@ -79,6 +79,7 @@ import os
 import numpy as np
 
 from ..observability import record_degradation
+from ..observability.tracing import span
 from ..resilience import fault_point, io_retry_policy, retry_call
 from ..trace.hooks import shared_access, trace_point
 from ..utils.atomic import atomic_write
@@ -877,35 +878,38 @@ class SignatureStore:
         _, first = np.unique(_as_struct(d), return_index=True)
         first.sort()
         d, s = d[first], s[first]
-        sid = 1 + max((int(e["id"]) for e in self.shards), default=-1)
-        sig_path, key_path = self._sig_path(sid), self._key_path(sid)
-        sig_tmp, key_tmp = sig_path + ".tmp.npy", key_path + ".tmp.npy"
-        crcs = {}
+        with span("store.append", rows=int(d.shape[0])):
+            sid = 1 + max((int(e["id"]) for e in self.shards), default=-1)
+            sig_path, key_path = self._sig_path(sid), self._key_path(sid)
+            sig_tmp, key_tmp = sig_path + ".tmp.npy", key_path + ".tmp.npy"
+            crcs = {}
 
-        def write_shard() -> None:
-            np.save(sig_tmp, s)
-            np.save(key_tmp, d)
-            # Frame BEFORE the rename: the checksum covers the bytes the
-            # commit publishes, and a torn/injected failure re-frames.
-            crcs["sig"] = file_crc(sig_tmp)
-            crcs["key"] = file_crc(key_tmp)
-            fault_point("store.sig.save", path=sig_tmp)
-            os.replace(sig_tmp, sig_path)
-            os.replace(key_tmp, key_path)
+            def write_shard() -> None:
+                np.save(sig_tmp, s)
+                np.save(key_tmp, d)
+                # Frame BEFORE the rename: the checksum covers the bytes
+                # the commit publishes, and a torn/injected failure
+                # re-frames.
+                crcs["sig"] = file_crc(sig_tmp)
+                crcs["key"] = file_crc(key_tmp)
+                fault_point("store.sig.save", path=sig_tmp)
+                os.replace(sig_tmp, sig_path)
+                os.replace(key_tmp, key_path)
 
-        retry_call(write_shard, policy=io_retry_policy(),
-                   site="store.sig.save")
-        self.shards.append({"id": sid, "rows": int(d.shape[0]),
-                            "sig_crc": crcs["sig"], "key_crc": crcs["key"],
-                            "probe_gen": self._probe_gen})
-        self._write_manifest()
-        n_before = len(self.shards)
-        self._evict(keep_sid=sid)
-        if len(self.shards) != n_before:
-            self._build_index()  # layout shrank: consolidate everything
-        else:
-            self._push_delta(sid, d)
-        return int(d.shape[0])
+            retry_call(write_shard, policy=io_retry_policy(),
+                       site="store.sig.save")
+            self.shards.append({"id": sid, "rows": int(d.shape[0]),
+                                "sig_crc": crcs["sig"],
+                                "key_crc": crcs["key"],
+                                "probe_gen": self._probe_gen})
+            self._write_manifest()
+            n_before = len(self.shards)
+            self._evict(keep_sid=sid)
+            if len(self.shards) != n_before:
+                self._build_index()  # layout shrank: consolidate
+            else:
+                self._push_delta(sid, d)
+            return int(d.shape[0])
 
     def _evict(self, keep_sid: int) -> None:
         """LRU whole-shard eviction down to ``max_bytes`` (never the

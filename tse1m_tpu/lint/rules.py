@@ -24,6 +24,9 @@ invariant some PR actually shipped:
 - ``watchdog-clock``      the supervision plane reads time only through
                           resilience.watchdog.deadline_clock (one
                           monotonic time base for every deadline)
+- ``span-discipline``     tracing spans close deterministically: ``with
+                          span(...)`` (or enter_context), and manual
+                          ``start_span`` only under a finally-``.end()``
 """
 
 from __future__ import annotations
@@ -752,6 +755,72 @@ def watchdog_clock(src: FileSource) -> list[Finding]:
     return out
 
 
+# -- 11. span-discipline (telemetry plane) -----------------------------------
+#
+# A span that never closes is worse than no span: it sits in the ring
+# forever "in flight", its duration is garbage, and every span opened
+# after it misparents under a context that should have popped.  The
+# tracing API is shaped so this cannot happen — ``span()`` is a context
+# manager — and this rule keeps call sites on that shape: ``span(...)``
+# must be the context expression of a ``with`` (or handed to an
+# ExitStack via ``enter_context``), and the manual escape hatch
+# ``start_span(...)`` is legal only inside a function that guarantees
+# ``.end()`` in a ``finally`` (the shape tracing.span itself uses).
+
+_SPAN_CALL_NAMES = {"span", "start_span"}
+
+
+def _fn_finalizes_end(fn: ast.AST) -> bool:
+    """True when ``fn`` contains a Try whose finalbody calls ``.end()``."""
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Try) or not node.finalbody:
+            continue
+        for fin in node.finalbody:
+            for sub in ast.walk(fin):
+                if (isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr == "end"):
+                    return True
+    return False
+
+
+def span_discipline(src: FileSource) -> list[Finding]:
+    out = []
+    parents = None
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _dotted(node.func).rsplit(".", 1)[-1]
+        if name not in _SPAN_CALL_NAMES:
+            continue
+        if parents is None:
+            parents = _parents(src.tree)
+        if name == "span":
+            par = parents.get(node)
+            if isinstance(par, ast.withitem):
+                continue
+            if (isinstance(par, ast.Call)
+                    and _dotted(par.func).rsplit(".", 1)[-1]
+                    == "enter_context"):
+                continue
+            out.append(_f(src, node,
+                          "`span(...)` outside a `with` — a span object "
+                          "that escapes its context can stay open forever "
+                          "(garbage duration, misparented children); use "
+                          "`with span(...)` or "
+                          "`stack.enter_context(span(...))`"))
+        else:
+            fn = _enclosing_function(node, parents)
+            if fn is not None and _fn_finalizes_end(fn):
+                continue
+            out.append(_f(src, node,
+                          "manual `start_span(...)` without a guaranteed "
+                          "close — the enclosing function must call "
+                          "`.end()` in a `finally` (or use `with "
+                          "span(...)`, which cannot leak)"))
+    return out
+
+
 RULES = {
     "broad-except": broad_except,
     "nonatomic-write": nonatomic_write,
@@ -763,6 +832,7 @@ RULES = {
     "retry-bypass": retry_bypass,
     "nondeterminism": nondeterminism,
     "watchdog-clock": watchdog_clock,
+    "span-discipline": span_discipline,
 }
 
 __all__ = ["RULES"]

@@ -68,6 +68,14 @@ class StageRecorder:
             shared_access(self, "stages", write=True)
             self.wall[stage] += seconds
             self.nbytes[stage] += nbytes
+        # Outside the lock: the registry histogram brings its own (the
+        # lock-order pass sees StageRecorder -> LatencyRecorder nowhere
+        # else, so keep the sections disjoint).  Stage walls land in the
+        # same substrate as every other distribution (`metrics.py`)
+        # while `as_dict` keeps emitting the bench-JSON contract keys.
+        from . import metrics
+
+        metrics.histogram("stage_seconds", stage=stage).observe(seconds)
 
     @contextlib.contextmanager
     def stage(self, name: str, nbytes: int = 0):
@@ -180,6 +188,9 @@ def record_degradation(kind: str, site: str = "",
         event = {"seq": _degradation_seq, "kind": kind, "site": site,
                  "detail": dict(detail or {})}
         _degradations.append(event)
+    from . import metrics
+
+    metrics.counter("degradations_total", kind=kind).inc()
     return event
 
 
@@ -204,13 +215,26 @@ def degradation_counts(events: list) -> dict:
     return by
 
 
+from .export import flat_metrics, metrics_snapshot, prometheus_text
+from .flight import dump_flight, get_flight_dir, set_flight_dir
 from .latency import LatencyRecorder
 from .merge import (MERGED_MANIFEST, fragment_manifest_path,
                     merge_run_manifests, sweep_stale_fragments)
+from .metrics import (MetricsRegistry, counter, gauge, get_registry,
+                      histogram, reset_metrics)
+from .tracing import (adopt_trace, continue_trace, current_trace,
+                      new_trace_id, pinned_trace, recent_spans, set_tracing,
+                      span, spans_recorded, tracing_enabled)
 
-__all__ = ["LatencyRecorder", "MERGED_MANIFEST", "STAGES", "StageRecorder",
-           "degradation_counts", "fragment_manifest_path",
-           "merge_run_manifests", "peek_degradation_events",
-           "pop_degradation_events", "record_degradation",
-           "record_last_stages", "peek_last_stages", "pop_last_stages",
-           "sweep_stale_fragments"]
+__all__ = ["LatencyRecorder", "MERGED_MANIFEST", "MetricsRegistry",
+           "STAGES", "StageRecorder", "adopt_trace", "continue_trace",
+           "counter", "current_trace", "degradation_counts", "dump_flight",
+           "flat_metrics", "fragment_manifest_path", "gauge",
+           "get_flight_dir", "get_registry", "histogram",
+           "merge_run_manifests", "metrics_snapshot", "new_trace_id",
+           "peek_degradation_events", "pinned_trace",
+           "pop_degradation_events", "prometheus_text", "recent_spans",
+           "record_degradation", "record_last_stages", "peek_last_stages",
+           "pop_last_stages", "reset_metrics", "set_flight_dir",
+           "set_tracing", "span", "spans_recorded", "sweep_stale_fragments",
+           "tracing_enabled"]

@@ -1,0 +1,115 @@
+"""Crash-time flight recorder: the black box for chaos post-mortems.
+
+A fault-matrix seat that SIGKILLs the serve daemon, fences a zombie
+worker, or breaches a watchdog deadline leaves a process that cannot
+explain itself — the bench JSON never materialises and the manifest
+fragment stops mid-step.  The flight recorder closes that gap: crash
+paths call :func:`dump_flight` and the last N spans, a full metrics
+snapshot, and the recent degradation events land atomically in
+``flight_NNN.json`` next to the manifest (or the store, for the serve
+daemon) *before* the process dies.
+
+The dump prepends a terminal span named ``flight.<reason>`` tagged
+with the firing seat, so the last span in every dump identifies what
+killed the process — the acceptance contract the fault matrix asserts.
+
+``dump_flight`` must never make a crash worse: with no directory
+configured it is a no-op, and any internal failure is swallowed
+(injected faults excepted — the chaos plane stays transparent).
+Triggers wired in this PR: ``kill``-kind fault injection (before the
+SIGKILL), ``LeaseSupersededError`` self-fencing, the serve CLI's
+SIGTERM handler, watchdog deadline breaches, ingest-thread crashes,
+and StepRunner step failures after retries.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from ..resilience.faults import reraise_if_fault
+from ..resilience.watchdog import deadline_clock
+from ..utils.atomic import atomic_write
+from ..utils.logging import get_logger
+from . import tracing
+from .export import metrics_snapshot
+
+log = get_logger("observability.flight")
+
+_FLIGHT_FMT = "flight_{:03d}.json"
+_SPAN_WINDOW = 256
+
+_flight_dir: str | None = None
+
+
+def set_flight_dir(path: str | None) -> None:
+    """Point the recorder at the run's artifact directory (manifest
+    dir for pod workers, store dir for the serve daemon).  The
+    ``TSE1M_FLIGHT_DIR`` env var seeds it across process spawns; an
+    explicit call wins."""
+    global _flight_dir
+    _flight_dir = str(path) if path else None
+
+
+def get_flight_dir() -> str | None:
+    if _flight_dir is not None:
+        return _flight_dir
+    return os.environ.get("TSE1M_FLIGHT_DIR") or None
+
+
+def _next_path(d: str) -> str:
+    n = 0
+    for name in os.listdir(d):
+        if name.startswith("flight_") and name.endswith(".json"):
+            try:
+                n = max(n, int(name[len("flight_"):-len(".json")]) + 1)
+            except ValueError:
+                continue
+    return os.path.join(d, _FLIGHT_FMT.format(n))
+
+
+def dump_flight(reason: str, site: str | None = None,
+                extra: dict | None = None) -> str | None:
+    """Write one flight file; returns its path, or None when no
+    directory is configured or the dump itself failed (a recorder
+    failure must never mask the crash being recorded)."""
+    d = get_flight_dir()
+    if not d:
+        return None
+    try:
+        with tracing.span(f"flight.{reason}", site=site or ""):
+            pass
+        payload = {
+            "reason": str(reason),
+            "site": site,
+            "pid": os.getpid(),
+            "written_at": time.time(),
+            "uptime_s": round(deadline_clock(), 3),
+            "trace_id": tracing.pinned_trace(),
+            "spans": tracing.recent_spans(_SPAN_WINDOW),
+            "metrics": metrics_snapshot(),
+            "degradation_events": _recent_degradations(),
+        }
+        if extra:
+            payload["extra"] = dict(extra)
+        os.makedirs(d, exist_ok=True)
+        path = _next_path(d)
+        with atomic_write(path) as f:
+            json.dump(payload, f, indent=2, default=str)
+        log.warning("flight recorder: %s dumped to %s", reason, path)
+        return path
+    except Exception as e:
+        reraise_if_fault(e)
+        log.error("flight recorder: dump for %s failed (%s: %s)", reason,
+                  type(e).__name__, e)
+        return None
+
+
+def _recent_degradations() -> list:
+    from . import peek_degradation_events
+
+    return peek_degradation_events()
+
+
+__all__ = ["dump_flight", "get_flight_dir", "set_flight_dir"]

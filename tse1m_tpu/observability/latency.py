@@ -110,6 +110,24 @@ class LatencyRecorder:
         """snapshot() keyed for flat JSON: ``<name>_p99_ms`` etc."""
         return {f"{self.name}_{k}": v for k, v in self.snapshot().items()}
 
+    def buckets(self) -> dict:
+        """Cumulative-bucket export for the Prometheus text format:
+        only occupied buckets are emitted (the 180-slot grid would be
+        noise), each as ``{"le": upper_bound_s, "count": cumulative}``,
+        plus the ``sum``/``count`` pair the histogram type requires."""
+        with self._lock:
+            shared_access(self, "buckets", write=False)
+            out = []
+            cum = 0
+            for b, c in enumerate(self._counts):
+                if c == 0:
+                    continue
+                cum += c
+                out.append({"le": round(_bucket_upper_s(b), 9),
+                            "count": cum})
+            return {"buckets": out, "sum": round(self._total_s, 6),
+                    "count": self._n}
+
     def reset_window(self) -> None:
         """Restart the qps window (and counts) — bench rounds measure a
         steady-state window, not the warmup."""

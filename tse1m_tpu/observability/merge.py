@@ -16,7 +16,12 @@ per-process FRAGMENT (``run_manifest.p<NNN>.json``) and the coordinator
   missing — a host that died before writing its fragment shows up as
   ``missing`` rather than silently narrowing the record;
 - ``ok`` is the pod-wide conjunction: any failed step on any host, or
-  any missing fragment, marks the merged run not-ok.
+  any missing fragment, marks the merged run not-ok;
+- ``metrics`` folds the fragments' registry snapshots (counters sum,
+  gauges keep the pod-wide max, histogram counts sum with the worst
+  p99/max), and ``trace_id`` carries the run's shared trace id when
+  every fragment agrees (the negotiated nonce, so they do unless a
+  fragment predates the telemetry plane).
 
 Fragments are merged, never deleted: the per-host originals stay next to
 the merged manifest for post-mortems.
@@ -53,6 +58,48 @@ def _load_fragment(path: str) -> dict | None:
         return None
 
 
+def _merge_metric_snapshots(snapshots: list) -> dict:
+    """Fold per-process registry snapshots (`export.metrics_snapshot`
+    shape) into one pod-wide view.  Counters are additive by nature;
+    gauges here are levels/high-water marks so the pod-wide max is the
+    honest aggregate; histograms cannot be re-bucketed from their
+    summaries, so counts/sums add and the worst p99/max is kept."""
+    counters: dict = {}
+    gauges: dict = {}
+    hists: dict = {}
+    for snap in snapshots:
+        for c in (snap or {}).get("counters", []):
+            key = (c["name"], tuple(sorted((c.get("labels") or {}).items())))
+            if key not in counters:
+                counters[key] = {"name": c["name"],
+                                 "labels": dict(c.get("labels") or {}),
+                                 "value": 0}
+            counters[key]["value"] += int(c.get("value", 0))
+        for g in (snap or {}).get("gauges", []):
+            key = (g["name"], tuple(sorted((g.get("labels") or {}).items())))
+            if key not in gauges:
+                gauges[key] = {"name": g["name"],
+                               "labels": dict(g.get("labels") or {}),
+                               "value": 0.0}
+            gauges[key]["value"] = max(gauges[key]["value"],
+                                       float(g.get("value", 0.0)))
+        for h in (snap or {}).get("histograms", []):
+            key = (h["name"], tuple(sorted((h.get("labels") or {}).items())))
+            if key not in hists:
+                hists[key] = {"name": h["name"],
+                              "labels": dict(h.get("labels") or {}),
+                              "count": 0, "sum": 0.0, "p99_ms": 0.0,
+                              "max_ms": 0.0}
+            agg = hists[key]
+            agg["count"] += int(h.get("count", 0))
+            agg["sum"] = round(agg["sum"] + float(h.get("sum", 0.0)), 6)
+            agg["p99_ms"] = max(agg["p99_ms"], float(h.get("p99_ms", 0.0)))
+            agg["max_ms"] = max(agg["max_ms"], float(h.get("max_ms", 0.0)))
+    return {"counters": [counters[k] for k in sorted(counters)],
+            "gauges": [gauges[k] for k in sorted(gauges)],
+            "histograms": [hists[k] for k in sorted(hists)]}
+
+
 def merge_run_manifests(result_dir: str, n_processes: int,
                         out_path: str | None = None) -> dict:
     """Fold every process's manifest fragment into the merged manifest.
@@ -74,10 +121,16 @@ def merge_run_manifests(result_dir: str, n_processes: int,
     steps: list[dict] = []
     summary: dict[str, int] = {}
     epochs: dict[str, int] = {}
+    metric_snaps: list = []
+    trace_ids: set = set()
     started = None
     wall = 0.0
     for pid in sorted(fragments):
         frag = fragments[pid]
+        if frag.get("metrics"):
+            metric_snaps.append(frag["metrics"])
+        if frag.get("trace_id"):
+            trace_ids.add(str(frag["trace_id"]))
         # Each fragment's degradation events are popped destructively
         # into exactly one step record by its own StepRunner, so summing
         # the per-fragment counts here counts every event exactly once —
@@ -106,6 +159,11 @@ def merge_run_manifests(result_dir: str, n_processes: int,
                and all(f.get("ok", False) for f in fragments.values())),
         "summary": summary,
         "degradation_counts": counts,
+        # One shared id means the pod really ran as one trace; multiple
+        # ids are preserved verbatim (a diagnostic in themselves).
+        "trace_id": (trace_ids.pop() if len(trace_ids) == 1
+                     else sorted(trace_ids) or None),
+        "metrics": _merge_metric_snapshots(metric_snaps),
         "pod": {
             "n_processes": int(n_processes),
             "merged_from": sorted(fragments),

@@ -1,0 +1,193 @@
+"""Typed metrics registry: one instrumentation substrate for the repo.
+
+Before this module every plane kept its own ad-hoc tallies —
+degradation counts in a module list, admission rejections inside the
+controller, retry hits in a per-call stats dict, stage walls in
+StageRecorder — each with its own snapshot shape and none queryable
+while the process runs.  The registry absorbs them behind the three
+Prometheus-shaped types:
+
+- :class:`Counter` — monotonically increasing event tallies
+  (``degradations_total{kind=...}``, ``retries_total{site=...}``,
+  ``fault_injections_total{site=...,kind=...}``,
+  ``lease_superseded_total``, ``serve_ingest_rejected_total``)
+- :class:`Gauge` — point-in-time or high-water levels
+  (``serve_queue_depth``, ``serve_ingest_backlog_max``,
+  ``serve_store_generation``, ``serve_store_rows``)
+- :class:`Histogram` — distributions on the log-bucketed
+  :class:`~.latency.LatencyRecorder` core
+  (``stage_seconds{stage=...}``, the serve latency classes)
+
+Metrics are get-or-create keyed by ``(name, sorted labels)``, so an
+instrumentation site never checks existence — it asks the registry and
+increments.  ``export.py`` renders the registry as Prometheus text
+(the TCP ``metrics`` verb), a structured snapshot (``run_manifest``),
+and flat ``metrics_*`` keys (bench JSON); ``merge.py`` folds fragment
+snapshots across a pod.
+
+Every type is thread-safe behind the traced-lock primitives, so the
+lockset detector audits the metrics plane like production state.
+"""
+
+from __future__ import annotations
+
+from ..trace import sync as tsync
+from ..trace.hooks import shared_access
+from .latency import LatencyRecorder
+
+
+def _label_key(labels: dict) -> tuple:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class Counter:
+    """Monotonic event counter.  ``inc`` only goes up; a decrement is a
+    modelling error (use a Gauge)."""
+
+    def __init__(self, name: str, labels: dict) -> None:
+        self.name = name
+        self.labels = dict(labels)
+        self._lock = tsync.Lock(f"Counter.{name}")
+        self._value = 0
+
+    def inc(self, n: int = 1) -> None:
+        if n < 0:
+            raise ValueError(f"counter {self.name} cannot decrease")
+        with self._lock:
+            shared_access(self, "value", write=True)
+            self._value += int(n)
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            shared_access(self, "value", write=False)
+            return self._value
+
+
+class Gauge:
+    """Settable level.  ``set_max`` keeps the high-water mark — the
+    shape backlog/queue-depth telemetry wants (a backpressure episode
+    must stay visible after the queue drains)."""
+
+    def __init__(self, name: str, labels: dict) -> None:
+        self.name = name
+        self.labels = dict(labels)
+        self._lock = tsync.Lock(f"Gauge.{name}")
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            shared_access(self, "value", write=True)
+            self._value = float(v)
+
+    def set_max(self, v: float) -> None:
+        with self._lock:
+            shared_access(self, "value", write=True)
+            if float(v) > self._value:
+                self._value = float(v)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            shared_access(self, "value", write=False)
+            return self._value
+
+
+class Histogram:
+    """Distribution on the log-bucketed LatencyRecorder core (values
+    are seconds unless the name says otherwise).  The recorder brings
+    its own traced lock; this wrapper only adds the registry shape."""
+
+    def __init__(self, name: str, labels: dict) -> None:
+        self.name = name
+        self.labels = dict(labels)
+        self._rec = LatencyRecorder(name)
+
+    def observe(self, value_s: float) -> None:
+        self._rec.add(float(value_s))
+
+    def time(self):
+        return self._rec.time()
+
+    def snapshot(self) -> dict:
+        return self._rec.snapshot()
+
+    def buckets(self) -> dict:
+        return self._rec.buckets()
+
+
+class MetricsRegistry:
+    """Get-or-create registry over the three metric types.
+
+    One process-global default instance backs the module-level helpers;
+    tests that need isolation construct their own or call
+    :func:`reset_metrics`."""
+
+    def __init__(self) -> None:
+        self._lock = tsync.Lock("MetricsRegistry")
+        self._metrics: dict = {}
+
+    def _get(self, kind, name: str, labels: dict):
+        key = (name, _label_key(labels))
+        with self._lock:
+            shared_access(self, "metrics", write=True)
+            m = self._metrics.get(key)
+            if m is None:
+                m = kind(name, labels)
+                self._metrics[key] = m
+            elif not isinstance(m, kind):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, not {kind.__name__}")
+            return m
+
+    def counter(self, name: str, **labels) -> Counter:
+        return self._get(Counter, name, labels)
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        return self._get(Gauge, name, labels)
+
+    def histogram(self, name: str, **labels) -> Histogram:
+        return self._get(Histogram, name, labels)
+
+    def collect(self) -> list:
+        """All registered metrics, sorted by (name, labels) so every
+        export is deterministic."""
+        with self._lock:
+            shared_access(self, "metrics", write=False)
+            items = sorted(self._metrics.items())
+        return [m for _, m in items]
+
+    def clear(self) -> None:
+        with self._lock:
+            shared_access(self, "metrics", write=True)
+            self._metrics = {}
+
+
+_registry = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    return _registry
+
+
+def counter(name: str, **labels) -> Counter:
+    return _registry.counter(name, **labels)
+
+
+def gauge(name: str, **labels) -> Gauge:
+    return _registry.gauge(name, **labels)
+
+
+def histogram(name: str, **labels) -> Histogram:
+    return _registry.histogram(name, **labels)
+
+
+def reset_metrics() -> None:
+    """Drop every registered metric (test isolation; a fresh process
+    starts empty anyway)."""
+    _registry.clear()
+
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "counter",
+           "gauge", "get_registry", "histogram", "reset_metrics"]

@@ -1,0 +1,271 @@
+"""Span-based tracing on the watchdog plane's one blessed clock.
+
+The span model is Dapper's: a trace is a tree of timed spans sharing
+one 16-hex trace id, each span naming one unit of work (a pipeline
+step, a serve request, a retry attempt).  Everything here rides
+``deadline_clock`` — the same monotonic base every watchdog deadline
+and latency histogram compares against — so a span duration and the
+budget that would have reaped it are always on one time axis.
+
+Propagation is explicit and JSON-friendly: ``current_trace()`` returns
+a tiny ``{"t": trace_id, "s": span_id}`` context that rides the serve
+envelope, a ticket, a heartbeat payload or an ``fs_exchange`` array,
+and ``continue_trace(ctx)`` adopts it on the far side so the remote
+work lands in the same trace.  A pod run pins one process-wide trace
+id derived from the negotiated run nonce (``adopt_trace``), which is
+how two worker processes end up in one cross-process trace without a
+collector.
+
+Completed spans land in a bounded ring buffer (:class:`SpanRing`)
+guarded by the traced-lock primitives, so the lockset detector and the
+deterministic scheduler audit the telemetry plane like any other
+shared-state class.  The ring is the flight recorder's span source and
+the TCP ``trace`` verb's backing store.
+
+Discipline: spans are opened with ``with span(name): ...`` (or an
+``ExitStack.enter_context``).  The manual ``start_span``/``Span.end``
+pair exists for the rare cross-callback shape and must sit in a
+``try/finally`` — graftlint's ``span-discipline`` rule enforces both.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+
+from ..resilience.watchdog import deadline_clock
+from ..trace import sync as tsync
+from ..trace.hooks import shared_access, trace_point
+
+_DEFAULT_RING = 512
+
+
+def _hex_id() -> str:
+    return os.urandom(8).hex()
+
+
+def new_trace_id() -> str:
+    return _hex_id()
+
+
+# -- the span ring ------------------------------------------------------------
+
+
+class SpanRing:
+    """Bounded ring of completed span records (thread-safe).
+
+    Overwrite-oldest semantics: a long run keeps the most recent N
+    spans, which is exactly the window a post-mortem wants.  Records
+    are plain JSON-safe dicts so the flight recorder and the ``trace``
+    verb serialise them without translation."""
+
+    def __init__(self, capacity: int | None = None) -> None:
+        if capacity is None:
+            capacity = int(os.environ.get("TSE1M_TRACE_RING",
+                                          _DEFAULT_RING))
+        self.capacity = max(1, int(capacity))
+        self._lock = tsync.Lock("SpanRing")
+        self._buf: list = [None] * self.capacity
+        self._next = 0
+        self._total = 0
+
+    def append(self, record: dict) -> None:
+        trace_point("tracing.ring.append")
+        with self._lock:
+            shared_access(self, "buf", write=True)
+            self._buf[self._next] = record
+            self._next = (self._next + 1) % self.capacity
+            self._total += 1
+
+    def recent(self, n: int | None = None) -> list:
+        """Last ``n`` completed spans, oldest first."""
+        with self._lock:
+            shared_access(self, "buf", write=False)
+            if self._total < self.capacity:
+                out = list(self._buf[:self._next])
+            else:
+                out = self._buf[self._next:] + self._buf[:self._next]
+        if n is not None:
+            out = out[-int(n):]
+        return out
+
+    def total(self) -> int:
+        with self._lock:
+            shared_access(self, "buf", write=False)
+            return self._total
+
+    def clear(self) -> None:
+        with self._lock:
+            shared_access(self, "buf", write=True)
+            self._buf = [None] * self.capacity
+            self._next = 0
+            self._total = 0
+
+
+_ring = SpanRing()
+
+
+def span_ring() -> SpanRing:
+    return _ring
+
+
+def recent_spans(n: int | None = None) -> list:
+    return _ring.recent(n)
+
+
+def spans_recorded() -> int:
+    return _ring.total()
+
+
+def clear_spans() -> None:
+    return _ring.clear()
+
+
+# -- enable gate + process-pinned trace ---------------------------------------
+
+_enabled = os.environ.get("TSE1M_TRACING", "1") != "0"
+_pinned: str | None = None
+
+
+def tracing_enabled() -> bool:
+    return _enabled
+
+
+def set_tracing(on: bool) -> None:
+    """Runtime gate — the bench's untraced control window flips this
+    off around its measurement loop.  Disabled means ``span()`` hands
+    back a shared no-op and nothing touches the ring."""
+    global _enabled
+    _enabled = bool(on)
+
+
+def adopt_trace(trace_id: str | None) -> None:
+    """Pin a process-wide trace id: root spans opened with no active
+    parent join this trace instead of minting their own.  The pod
+    plane derives it from the negotiated run nonce, so every worker
+    process pins the same id."""
+    global _pinned
+    _pinned = str(trace_id) if trace_id else None
+
+
+def pinned_trace() -> str | None:
+    return _pinned
+
+
+# -- span context -------------------------------------------------------------
+
+_current: contextvars.ContextVar = contextvars.ContextVar(
+    "tse1m_current_span", default=None)
+
+
+def current_trace() -> dict | None:
+    """The propagation context of the innermost active span:
+    ``{"t": trace_id, "s": span_id}``, or None outside any span."""
+    cur = _current.get()
+    if cur is None:
+        return None
+    return {"t": cur[0], "s": cur[1]}
+
+
+class Span:
+    """One in-flight span.  ``end()`` is idempotent; the record only
+    reaches the ring on the first call."""
+
+    __slots__ = ("trace", "span_id", "parent", "name", "tags",
+                 "_start", "_token", "_done")
+
+    def __init__(self, trace: str, span_id: str, parent: str,
+                 name: str, tags: dict, token) -> None:
+        self.trace = trace
+        self.span_id = span_id
+        self.parent = parent
+        self.name = name
+        self.tags = tags
+        self._start = deadline_clock()
+        self._token = token
+        self._done = False
+
+    def set_tag(self, key: str, value) -> None:
+        self.tags[str(key)] = value
+
+    def end(self, ok: bool = True) -> None:
+        if self._done:
+            return
+        self._done = True
+        dur = deadline_clock() - self._start
+        if self._token is not None:
+            with contextlib.suppress(ValueError):
+                _current.reset(self._token)
+        _ring.append({"trace": self.trace, "span": self.span_id,
+                      "parent": self.parent, "name": self.name,
+                      "start_s": round(self._start, 6),
+                      "dur_s": round(dur, 6), "ok": bool(ok),
+                      "tags": dict(self.tags), "pid": os.getpid()})
+
+
+class _NoopSpan:
+    __slots__ = ()
+
+    def set_tag(self, key: str, value) -> None:
+        pass
+
+    def end(self, ok: bool = True) -> None:
+        pass
+
+
+_NOOP = _NoopSpan()
+
+
+def start_span(name: str, **tags):
+    """Open a span manually.  Pair with ``end()`` in a ``finally`` —
+    ``span-discipline`` flags anything looser.  Prefer ``span()``."""
+    if not _enabled:
+        return _NOOP
+    cur = _current.get()
+    if cur is not None:
+        trace, parent = cur
+    else:
+        trace, parent = (_pinned or _hex_id()), ""
+    span_id = _hex_id()
+    token = _current.set((trace, span_id))
+    return Span(trace, span_id, parent, str(name), dict(tags), token)
+
+
+@contextlib.contextmanager
+def span(name: str, **tags):
+    """The blessed way to open a span: closes on every exit path and
+    marks the record failed when the body raised."""
+    sp = start_span(name, **tags)
+    ok = True
+    try:
+        yield sp
+    except BaseException:
+        ok = False
+        raise
+    finally:
+        sp.end(ok=ok)
+
+
+@contextlib.contextmanager
+def continue_trace(ctx: dict | None):
+    """Adopt a remote propagation context (``current_trace()`` output
+    that rode an envelope/ticket/heartbeat): spans opened inside
+    become children of the remote span.  A falsy ctx is a no-op, so
+    call sites never branch on whether the peer traced."""
+    if not ctx or not ctx.get("t"):
+        yield
+        return
+    token = _current.set((str(ctx["t"]), str(ctx.get("s") or "")))
+    try:
+        yield
+    finally:
+        with contextlib.suppress(ValueError):
+            _current.reset(token)
+
+
+__all__ = ["Span", "SpanRing", "adopt_trace", "clear_spans",
+           "continue_trace", "current_trace", "new_trace_id",
+           "pinned_trace", "recent_spans", "set_tracing", "span",
+           "span_ring", "spans_recorded", "start_span",
+           "tracing_enabled"]

@@ -266,6 +266,7 @@ def fs_exchange(xch_dir: str, tag: str, payload: dict,
     exchange doubles as a barrier: returning implies every process
     reached ``tag``.  ``xch_dir`` must be per-run (see
     resilience/coordinator.exchange_dir) — names carry no run identity."""
+    from ..observability.tracing import pinned_trace, span
     from ..resilience.watchdog import deadline_clock
 
     # Explicit identity (the pod plane, which never brings up
@@ -278,28 +279,37 @@ def fs_exchange(xch_dir: str, tag: str, payload: dict,
     def _path(p: int) -> str:
         return os.path.join(xch_dir, f"{tag}.p{p:03d}.npz")
 
+    wire = {k: np.ascontiguousarray(v) for k, v in payload.items()}
+    # Trace context rides the exchange file itself: consumers index the
+    # keys they asked for, so the extra array is invisible to them, but a
+    # post-mortem on the npz ties it to the run's trace id.
+    trace = pinned_trace()
+    if trace and "__trace__" not in wire:
+        wire["__trace__"] = np.frombuffer(bytes.fromhex(trace),
+                                          dtype=np.uint8)
     tmp = _path(pid) + ".tmp"
     with open(tmp, "wb") as f:
-        np.savez(f, **{k: np.ascontiguousarray(v)
-                       for k, v in payload.items()})
+        np.savez(f, **wire)
     os.replace(tmp, _path(pid))  # atomic: a peer never reads a torn file
     out: dict[int, dict] = {pid: {k: np.ascontiguousarray(v)
                                   for k, v in payload.items()}}
     deadline = deadline_clock() + float(timeout_s)
     pending = set(range(nproc)) - {pid}
-    while pending:
-        for p in sorted(pending):
-            if os.path.exists(_path(p)):
-                with np.load(_path(p)) as z:
-                    out[p] = {k: z[k] for k in z.files}
-                pending.discard(p)
-        if not pending:
-            break
-        if monitor is not None:
-            monitor.check(site=f"pod.exchange:{tag}")
-        if deadline_clock() > deadline:
-            raise TimeoutError(
-                f"pod exchange '{tag}': no payload from process(es) "
-                f"{sorted(pending)} within {timeout_s:.0f}s")
-        time.sleep(0.1)
+    with span(f"pod.exchange.{tag}", peers=nproc - 1):
+        while pending:
+            for p in sorted(pending):
+                if os.path.exists(_path(p)):
+                    with np.load(_path(p)) as z:
+                        out[p] = {k: z[k] for k in z.files
+                                  if k != "__trace__"}
+                    pending.discard(p)
+            if not pending:
+                break
+            if monitor is not None:
+                monitor.check(site=f"pod.exchange:{tag}")
+            if deadline_clock() > deadline:
+                raise TimeoutError(
+                    f"pod exchange '{tag}': no payload from process(es) "
+                    f"{sorted(pending)} within {timeout_s:.0f}s")
+            time.sleep(0.1)
     return [out[p] for p in range(nproc)]

@@ -113,6 +113,18 @@ class LeaseSupersededError(RuntimeError):
             "range was re-dealt while this process was wedged; demoting "
             "to read-only (zero further appends) instead of double-"
             "writing")
+        # Fencing is a crash-class event for this writer: count it and
+        # leave a flight dump while the process can still explain
+        # itself (a fenced zombie typically exits soon after).
+        # Function-level imports — the telemetry plane sits above this
+        # module in the import graph.
+        from ..observability import metrics as obs_metrics
+        from ..observability.flight import dump_flight
+
+        obs_metrics.counter("lease_superseded_total").inc()
+        dump_flight("lease_superseded",
+                    site=f"lease.range{self.range_id}",
+                    extra={"held": self.held, "current": self.current})
 
 
 # The fault plane's hostloss kind flips this: a wedged host stays alive
@@ -205,13 +217,19 @@ class HeartbeatWriter:
         return self._run_id
 
     def beat_once(self) -> int:
+        from ..observability.tracing import pinned_trace
+
         with self._lock:
             self._seq += 1
             seq = self._seq
         with atomic_write(heartbeat_path(self.directory,
                                          self.process_id)) as f:
+            # The pod-wide trace id rides every beat: a heartbeat file
+            # found after a crash names the trace its process belonged
+            # to (readers ignore unknown keys).
             json.dump({"process_id": self.process_id, "seq": seq,
-                       "run": self._run_id}, f)
+                       "run": self._run_id,
+                       "trace": pinned_trace()}, f)
         return seq
 
     def _run(self) -> None:
@@ -463,7 +481,21 @@ def negotiate_run_nonce(supervisor: "PodSupervisor | None" = None,
     nonce file whose stamp matches the leader's live heartbeat, checking
     the monitor between polls so a leader that dies pre-publish raises
     :class:`HostLostError` instead of a bare timeout.  Single-process
-    runs mint a local nonce."""
+    runs mint a local nonce.
+
+    The nonce doubles as the run's trace id: every process pins it
+    (``observability.tracing.adopt_trace``), so spans from all workers —
+    and the trace context stamped into heartbeats and ``fs_exchange``
+    payloads — share one id without any collector."""
+    nonce = _negotiate_run_nonce(supervisor, pod_dir)
+    from ..observability.tracing import adopt_trace
+
+    adopt_trace(nonce)
+    return nonce
+
+
+def _negotiate_run_nonce(supervisor: "PodSupervisor | None",
+                         pod_dir: str | None) -> str:
     if supervisor is None or supervisor.n_processes == 1:
         return os.urandom(8).hex()
     pod_dir = pod_dir or supervisor.directory

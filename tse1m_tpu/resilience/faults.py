@@ -154,6 +154,12 @@ class FaultPlan:
             self.fired.append((site, rule.kind))
             log.warning("fault plane: %s at %s (fire %d)", rule.kind, site,
                         rule._fired)
+            # Function-level import: the metrics plane imports the
+            # watchdog clock from this package.
+            from ..observability import metrics as obs_metrics
+
+            obs_metrics.counter("fault_injections_total", site=site,
+                                kind=rule.kind).inc()
             self._apply(rule, site, path)
             return  # at most one rule fires per call
 
@@ -186,6 +192,13 @@ class FaultPlan:
                         "(heartbeats resumed)", site)
             return
         if rule.kind == "kill":
+            # Last words: SIGKILL leaves no handler to run, so the
+            # flight recorder writes its dump BEFORE the signal — the
+            # terminal span names this seat, which is how a post-mortem
+            # identifies what killed the process.
+            from ..observability.flight import dump_flight
+
+            dump_flight("fault.kill", site=site)
             os.kill(os.getpid(), signal.SIGKILL)
             # SIGKILL delivery can be asynchronous; never fall through
             # and surface some *other* fault kind as a catchable

@@ -18,6 +18,7 @@ from dataclasses import dataclass, field
 from typing import Callable
 
 from ..utils.logging import get_logger
+from .faults import InjectedFault
 from .watchdog import deadline_clock
 
 log = get_logger("resilience.retry")
@@ -85,9 +86,14 @@ def retry_call(
 
     ``sleep``/``rng``/``clock`` are injectable for deterministic tests.
     """
+    # Function-level import: the telemetry plane sits above this module
+    # in the import graph (observability.latency reads deadline_clock).
+    from ..observability import tracing
+
     policy = policy or RetryPolicy()
     rng = rng or random
     start = clock()
+    label = site or getattr(fn, "__name__", "call")
     last: BaseException | None = None
     attempts = 0
     for attempt in range(policy.max_attempts):
@@ -95,7 +101,18 @@ def retry_call(
         if stats is not None:
             stats.attempts = attempts
         try:
-            return fn(*args, **kwargs)
+            # Each attempt is its own child span, so a trace shows the
+            # retry ladder (and which attempt an injected fault hit)
+            # instead of one opaque wall.
+            with tracing.span(f"attempt.{label}",
+                              attempt=attempts) as sp:
+                try:
+                    return fn(*args, **kwargs)
+                except BaseException as e:
+                    sp.set_tag("error", type(e).__name__)
+                    if isinstance(e, InjectedFault):
+                        sp.set_tag("fault", "injected")
+                    raise
         except policy.retry_on as e:
             if should_retry is not None and not should_retry(e):
                 raise
@@ -117,8 +134,11 @@ def retry_call(
         elif attempt + 1 >= policy.max_attempts:
             break
         log.warning("%s: attempt %d/%d failed (%s: %s); retrying in %.2fs",
-                    site or getattr(fn, "__name__", "call"), attempts,
+                    label, attempts,
                     policy.max_attempts, type(last).__name__, last, delay)
+        from ..observability import metrics as obs_metrics
+
+        obs_metrics.counter("retries_total", site=label).inc()
         if on_retry is not None:
             on_retry(last, attempt)
         if stats is not None:

@@ -62,6 +62,13 @@ class StepRunner:
         self.policy = policy or RetryPolicy(max_attempts=1)
         self.steps: list[StepRecord] = []
         self.started_at = time.time()
+        if manifest_path:
+            # Crash dumps land next to the manifest they annotate (an
+            # explicit set_flight_dir / TSE1M_FLIGHT_DIR still wins).
+            from ..observability.flight import get_flight_dir, set_flight_dir
+
+            if get_flight_dir() is None:
+                set_flight_dir(os.path.dirname(manifest_path) or ".")
         # Extra top-level manifest fields (e.g. the pod path's membership
         # "epoch" — observability/merge.py tags each fragment's steps
         # with it so a mid-run membership change stays attributable).
@@ -76,6 +83,8 @@ class StepRunner:
         """Run one step isolated; never raises (the record carries the
         failure)."""
         from ..observability import pop_degradation_events, pop_last_stages
+        from ..observability.flight import dump_flight
+        from ..observability.tracing import span
 
         rec = StepRecord(name=name, status="running")
         self.steps.append(rec)
@@ -90,7 +99,9 @@ class StepRunner:
             return fn(*args, **kwargs)
 
         try:
-            ret = retry_call(attempt, policy=self.policy, site=f"step:{name}")
+            with span(f"step.{name}"):
+                ret = retry_call(attempt, policy=self.policy,
+                                 site=f"step:{name}")
             rec.status = "ok"
             if isinstance(ret, dict):
                 rec.result = ret
@@ -104,6 +115,9 @@ class StepRunner:
             rec.traceback = traceback.format_exc()
             log.error("step %s failed after %d attempt(s): %s", name,
                       attempts[0], rec.error)
+            dump_flight("step_failed", site=f"step:{name}",
+                        extra={"error": rec.error,
+                               "attempts": attempts[0]})
             if isinstance(e, KeyboardInterrupt):
                 rec.wall_s = round(time.time() - t0, 3)
                 rec.attempts = attempts[0]
@@ -151,6 +165,8 @@ class StepRunner:
         if not self.manifest_path:
             return
         from ..observability import degradation_counts
+        from ..observability.export import metrics_snapshot
+        from ..observability.tracing import pinned_trace, spans_recorded
 
         events = [e for s in self.steps for e in (s.degradations or [])]
         payload = {
@@ -161,6 +177,13 @@ class StepRunner:
             # kind -> count over every step: the one-glance answer to
             # "what did the supervision plane absorb this run".
             "degradation_counts": degradation_counts(events),
+            # Telemetry plane: the run's trace id (pod runs pin the
+            # negotiated nonce, so every fragment carries the same id)
+            # and this process's metrics registry — merge.py folds the
+            # fragments' snapshots into the merged manifest.
+            "trace_id": pinned_trace(),
+            "spans_recorded": spans_recorded(),
+            "metrics": metrics_snapshot(),
             **self.meta,
             "steps": [asdict(s) for s in self.steps],
         }

@@ -34,6 +34,7 @@ watchdog's recovery reproduces the uninterrupted run's labels.
 
 from __future__ import annotations
 
+import contextvars
 import os
 import threading
 import time
@@ -95,10 +96,15 @@ def run_with_deadline(fn: Callable, budget_s: float, site: str):
     if budget_s is None or budget_s <= 0:
         return fn()
     box: dict = {}
+    # The worker joins the caller's contextvars (a copy — cheap, and
+    # writes stay thread-local): the guarded work keeps the caller's
+    # active trace span, so a watchdog-guarded serve request still
+    # lands in the client's trace.
+    ctx = contextvars.copy_context()
 
     def worker() -> None:
         try:
-            box["result"] = fn()
+            box["result"] = ctx.run(fn)
         except BaseException as e:  # graftlint: disable=broad-except -- relayed verbatim (incl. InjectedFault) via `raise box["error"]` below
             box["error"] = e
 
@@ -270,6 +276,14 @@ class StageWatchdog:
                     detail={"budget_s": round(e.budget_s, 3),
                             "attempt": stalls, "nbytes": int(nbytes)})
                 if stalls > self.max_stalls:
+                    # Terminal breach — the stall ladder is exhausted
+                    # and the error will climb to failover/abort; leave
+                    # the black box while this thread still can.
+                    from ..observability.flight import dump_flight
+
+                    dump_flight("deadline_breach", site=site,
+                                extra={"budget_s": round(e.budget_s, 3),
+                                       "stalls": stalls})
                     raise
                 log.warning("%s: stalled attempt %d cancelled (budget "
                             "%.2fs); retrying", site, stalls, e.budget_s)

@@ -16,6 +16,7 @@ import socket
 
 import numpy as np
 
+from ..observability.tracing import current_trace, span
 from ..resilience import RetryPolicy, retry_call
 from ..resilience.watchdog import request_budget_s
 from .server import decode_vectors, encode_vectors, read_msg, write_msg
@@ -74,20 +75,32 @@ class ServeClient:
     def request(self, op: str, timeout_s: float | None = None,
                 **payload) -> dict:
         """One request/response on the pinned connection; connection
-        failures drop the socket and retry through the shared engine."""
+        failures drop the socket and retry through the shared engine.
 
-        def attempt() -> dict:
-            sock = self._connect()
-            sock.settimeout(timeout_s or _CONNECT_TIMEOUT_S)
-            try:
-                write_msg(sock, {"op": op, **payload})
-                return read_msg(sock)
-            except (ConnectionError, socket.timeout, OSError):
-                self.close()
-                raise
+        The whole exchange runs inside a ``client.<op>`` span whose
+        trace context rides the envelope, so the daemon-side spans for
+        this request land in the same trace as the client-perceived
+        wall (retries included)."""
 
-        resp = retry_call(attempt, policy=self._retry,
-                          site=f"serve.client.{op}")
+        with span(f"client.{op}") as sp:
+            msg = {"op": op, **payload}
+            ctx = current_trace()
+            if ctx:
+                msg["trace"] = ctx
+
+            def attempt() -> dict:
+                sock = self._connect()
+                sock.settimeout(timeout_s or _CONNECT_TIMEOUT_S)
+                try:
+                    write_msg(sock, msg)
+                    return read_msg(sock)
+                except (ConnectionError, socket.timeout, OSError):
+                    self.close()
+                    raise
+
+            resp = retry_call(attempt, policy=self._retry,
+                              site=f"serve.client.{op}")
+            sp.set_tag("ok", bool(resp.get("ok", False)))
         if not resp.get("ok", False):
             if resp.get("error") == "backpressure":
                 raise Backpressure(resp)
@@ -124,6 +137,18 @@ class ServeClient:
             "ingest",
             timeout_s=timeout_s or request_budget_s("ingest") or None,
             **encode_vectors(vectors))
+
+    def metrics(self) -> dict:
+        """Live registry pull: ``prometheus`` (text exposition format)
+        plus the flat ``metrics_*`` aggregation."""
+        return self.request("metrics", timeout_s=request_budget_s("status")
+                            or None)
+
+    def trace(self, n: int | None = None) -> dict:
+        """Recent completed spans from the daemon's ring buffer."""
+        payload = {"n": int(n)} if n else {}
+        return self.request("trace", timeout_s=request_budget_s("status")
+                            or None, **payload)
 
     def quiesce(self, timeout_s: float | None = None) -> dict:
         return self.request(

@@ -50,7 +50,10 @@ from ..cluster.schemes import make_params, scheme_host_signatures
 from ..cluster.encode import quantize_ids
 from ..cluster.store import SignatureStore, row_digests
 from ..observability import StageRecorder, record_degradation
+from ..observability import metrics as obs_metrics
+from ..observability.flight import dump_flight, get_flight_dir, set_flight_dir
 from ..observability.latency import LatencyRecorder
+from ..observability.tracing import continue_trace, current_trace, span
 from ..resilience import (StageWatchdog, fault_point, reraise_if_fault)
 from ..resilience.watchdog import deadline_clock
 from ..trace.hooks import shared_access, trace_point
@@ -75,7 +78,7 @@ class IngestRejected(RuntimeError):
 
 
 class _Ticket:
-    __slots__ = ("items", "op", "event", "result", "error")
+    __slots__ = ("items", "op", "event", "result", "error", "trace")
 
     def __init__(self, items=None, op: str = "ingest") -> None:
         self.items = items
@@ -83,6 +86,9 @@ class _Ticket:
         self.event = threading.Event()
         self.result: dict | None = None
         self.error: BaseException | None = None
+        # Trace context captured at submit: the ingest thread adopts it
+        # so the store append lands in the submitting client's trace.
+        self.trace: dict | None = current_trace()
 
     def fail(self, e: BaseException) -> None:
         self.error = e
@@ -176,6 +182,11 @@ class ServeDaemon:
         self._last_committed_gen = self._index.generation
         self._ingest_error: BaseException | None = None
         self._thread: threading.Thread | None = None
+        # The store directory is the daemon's manifest-equivalent: crash
+        # dumps land next to the data they describe (an explicit
+        # set_flight_dir / TSE1M_FLIGHT_DIR still wins).
+        if get_flight_dir() is None:
+            set_flight_dir(store_dir)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -299,6 +310,9 @@ class ServeDaemon:
         trace_point("serve.index.swap")
         shared_access(self, "_index", write=True, atomic=True)
         self._index = new_index
+        obs_metrics.gauge("serve_store_generation").set(
+            self.store.generation)
+        obs_metrics.gauge("serve_store_rows").set(self.store.n_rows)
 
     def _all_digests(self) -> np.ndarray:
         if len(self._digest_parts) > 1:
@@ -328,6 +342,7 @@ class ServeDaemon:
             raise RuntimeError("serve ingest loop is down") \
                 from self._ingest_error
         depth = self._q.qsize()
+        obs_metrics.gauge("serve_queue_depth").set(depth)
         admitted, retry_after = self.admission.try_admit(depth)
         if not admitted:
             raise IngestRejected(depth, retry_after)
@@ -354,8 +369,11 @@ class ServeDaemon:
                     t.done({"ok": True,
                             "generation": self._index.generation})
                 else:
-                    with self.lat_ingest.time():
-                        t.done(self._ingest_batch(t.items))
+                    with continue_trace(t.trace):
+                        with span("serve.ingest.batch",
+                                  rows=int(t.items.shape[0])):
+                            with self.lat_ingest.time():
+                                t.done(self._ingest_batch(t.items))
                     gen = self._index.generation
                     if (gen - self._last_committed_gen
                             >= self.state_commit_every):
@@ -366,9 +384,13 @@ class ServeDaemon:
                     reraise_if_fault(e)
                 except BaseException:
                     self._ingest_error = e
+                    dump_flight("serve.ingest_crash", site="serve.ingest",
+                                extra={"error": f"{type(e).__name__}: {e}"})
                     raise
                 if isinstance(e, (KeyboardInterrupt, SystemExit)):
                     self._ingest_error = e
+                    dump_flight("serve.ingest_exit", site="serve.ingest",
+                                extra={"error": type(e).__name__})
                     raise
                 log.error("serve: ingest batch failed (%s: %s); daemon "
                           "continues", type(e).__name__, e)
@@ -496,6 +518,13 @@ class ServeDaemon:
             "store_generation": int(self.store.generation),
             "store_rows": int(self.store.n_rows),
             "queue_depth": int(self._q.qsize()),
+            # Registry-backed history, not a point-in-time read: a
+            # backpressure episode that drained before this status call
+            # still shows in the high-water mark and rejection counter.
+            "queue_depth_hwm": int(obs_metrics.gauge(
+                "serve_ingest_backlog_max").value),
+            "ingest_rejected_total": int(obs_metrics.counter(
+                "serve_ingest_rejected_total").value),
             "uncommitted_generations": int(index.generation
                                            - self._last_committed_gen),
             "last_scrub": dict(self.last_scrub),

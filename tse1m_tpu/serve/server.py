@@ -33,6 +33,9 @@ import threading
 
 import numpy as np
 
+from ..observability.export import flat_metrics, prometheus_text
+from ..observability.tracing import (continue_trace, recent_spans, span,
+                                     spans_recorded)
 from ..resilience import reraise_if_fault
 from ..resilience.watchdog import request_budget_s, run_with_deadline
 from ..utils.logging import get_logger
@@ -120,46 +123,71 @@ class ServeServer(socketserver.ThreadingTCPServer):
         return int(self.server_address[1])
 
     def dispatch(self, msg: dict) -> dict:
+        """Route one request.  The envelope's ``trace`` key (stamped by
+        ``ServeClient``) is adopted before the per-op span opens, so the
+        daemon-side work lands in the caller's trace; the trace id is
+        echoed on the response so the client can correlate without a
+        collector."""
         op = str(msg.get("op", ""))
+        ctx = msg.pop("trace", None)
         try:
-            if op == "ping":
-                return {"ok": True, "op": "ping",
-                        "generation": self.daemon._index.generation,
-                        "rows": self.daemon._index.n_rows}
-            if op == "status":
-                return {"ok": True, **self._guarded(
-                    "status", self.daemon.status)}
-            if op == "query":
-                vectors = decode_vectors(msg)
-                res = self.daemon.query(vectors)
-                return {"ok": True,
-                        "labels": res["labels"].astype(int).tolist(),
-                        "known": res["known"].astype(bool).tolist(),
-                        "generation": int(res["generation"])}
-            if op == "ingest":
-                vectors = decode_vectors(msg)
-                return self._guarded(
-                    "ingest", lambda: self.daemon.ingest(
-                        vectors, timeout=request_budget_s("ingest") or None))
-            if op == "quiesce":
-                return self._guarded(
-                    "ingest", lambda: self.daemon.quiesce(
-                        timeout=request_budget_s("ingest") or None))
-            if op == "shutdown":
-                self._shutdown_requested.set()
-                threading.Thread(target=self.shutdown,
-                                 daemon=True).start()
-                return {"ok": True, "op": "shutdown"}
-            return {"ok": False, "error": f"unknown op {op!r}"}
+            with continue_trace(ctx):
+                with span(f"serve.{op}"):
+                    resp = self._dispatch_op(op, msg)
         except IngestRejected as e:
-            return {"ok": False, "error": "backpressure",
+            resp = {"ok": False, "error": "backpressure",
                     "retry_after_s": round(e.retry_after_s, 3),
                     "depth": e.depth}
         except Exception as e:
             reraise_if_fault(e)
             log.error("serve: %s request failed (%s: %s)", op,
                       type(e).__name__, e)
-            return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+            resp = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+        if ctx and isinstance(ctx, dict) and ctx.get("t"):
+            resp.setdefault("trace", str(ctx["t"]))
+        return resp
+
+    def _dispatch_op(self, op: str, msg: dict) -> dict:
+        if op == "ping":
+            return {"ok": True, "op": "ping",
+                    "generation": self.daemon._index.generation,
+                    "rows": self.daemon._index.n_rows}
+        if op == "status":
+            return {"ok": True, **self._guarded(
+                "status", self.daemon.status)}
+        if op == "query":
+            vectors = decode_vectors(msg)
+            res = self.daemon.query(vectors)
+            return {"ok": True,
+                    "labels": res["labels"].astype(int).tolist(),
+                    "known": res["known"].astype(bool).tolist(),
+                    "generation": int(res["generation"])}
+        if op == "ingest":
+            vectors = decode_vectors(msg)
+            return self._guarded(
+                "ingest", lambda: self.daemon.ingest(
+                    vectors, timeout=request_budget_s("ingest") or None))
+        if op == "quiesce":
+            return self._guarded(
+                "ingest", lambda: self.daemon.quiesce(
+                    timeout=request_budget_s("ingest") or None))
+        if op == "metrics":
+            # Live registry pull (the Prometheus shape plus the flat
+            # bench-JSON aggregation) — the telemetry-plane analogue of
+            # `status`, queryable mid-run without touching the daemon.
+            return {"ok": True, "prometheus": prometheus_text(),
+                    "metrics": flat_metrics()}
+        if op == "trace":
+            n = msg.get("n")
+            return {"ok": True,
+                    "spans": recent_spans(int(n) if n else None),
+                    "spans_recorded": spans_recorded()}
+        if op == "shutdown":
+            self._shutdown_requested.set()
+            threading.Thread(target=self.shutdown,
+                             daemon=True).start()
+            return {"ok": True, "op": "shutdown"}
+        return {"ok": False, "error": f"unknown op {op!r}"}
 
     def _guarded(self, request_class: str, fn):
         """Control-plane requests under the per-class watchdog budget: a

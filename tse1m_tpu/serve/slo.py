@@ -21,6 +21,7 @@ import os
 from dataclasses import dataclass, field
 
 from ..observability import record_degradation
+from ..observability import metrics as obs_metrics
 from ..resilience.watchdog import request_budget_s
 from ..trace import sync as tsync
 from ..trace.hooks import shared_access
@@ -91,12 +92,21 @@ class AdmissionController:
             shared_access(self, "backlog", write=True)
             if depth > self._backlog_max:
                 self._backlog_max = depth
-            if depth < self.policy.max_backlog_batches:
+            admitted = depth < self.policy.max_backlog_batches
+            if admitted:
                 self._in_backpressure = False
-                return True, 0.0
-            self._rejected += 1
-            fresh = not self._in_backpressure
-            self._in_backpressure = True
+            else:
+                self._rejected += 1
+                fresh = not self._in_backpressure
+                self._in_backpressure = True
+        # Registry mirror (outside the admission lock — the metric
+        # types bring their own): the backlog high-water mark and the
+        # rejection counter survive into `serve --status`, the
+        # Prometheus text, and the merged manifest.
+        obs_metrics.gauge("serve_ingest_backlog_max").set_max(depth)
+        if admitted:
+            return True, 0.0
+        obs_metrics.counter("serve_ingest_rejected_total").inc()
         if fresh:
             record_degradation(
                 "serve_backpressure", site="serve.ingest",
