@@ -368,6 +368,15 @@ def main() -> int:
                         "host->device transfers raise, and the XLA compile "
                         "count must stay within --compile-budget (also "
                         "BENCH_SANITIZE=1)")
+    p.add_argument("--profile", action="store_true",
+                   default=os.environ.get("BENCH_PROFILE", "")
+                   not in ("", "0"),
+                   help="graftprof: host sampling profiler (span/plane/"
+                        "lock-wait attribution) + compile-duration "
+                        "histograms + a jax device trace over the round; "
+                        "writes profile_NNN.json next to the flight files "
+                        "at the end (also BENCH_PROFILE=1; "
+                        "TSE1M_PROFILING=0 kills the plane)")
     p.add_argument("--compile-budget", type=int,
                    default=int(os.environ.get("BENCH_COMPILE_BUDGET", 2)),
                    help="max XLA compiles allowed during the timed "
@@ -430,6 +439,23 @@ def main() -> int:
     # — open the trace with tensorboard/xprof to see the on-device stage
     # breakdown that wall clocks can't separate over a remote PJRT link.
     profile_dir = os.environ.get("TSE1M_PROFILE_DIR")
+
+    # --profile (graftprof): the host sampler + lock-wait recorder +
+    # compile-duration listener ride the whole round, and the device
+    # trace lands under the result dir unless TSE1M_PROFILE_DIR already
+    # points somewhere.  profile_NNN.json is dumped before the final
+    # JSON.  The TSE1M_PROFILING=0 kill switch beats the flag.
+    from tse1m_tpu.observability import profiling
+
+    if args.profile and profiling.profiling_enabled():
+        profiling.install_compile_listener()
+        profiling.enable_lock_wait(True)
+        profiling.start_sampler()
+        if not profile_dir:
+            profile_dir = os.path.join("data", "result_data",
+                                       "device_trace")
+    else:
+        args.profile = False
 
     def timed(prm):
         """Timed steady-state runs; under --sanitize the whole window runs
@@ -743,6 +769,11 @@ def main() -> int:
         from tse1m_tpu.serve import (Backpressure, ServeClient, ServeDaemon,
                                      ServeServer, SloPolicy)
 
+        # graftprof: per-site lock-wait attribution across the whole
+        # serving round — the concurrent ingest/query phase is where
+        # absorb-lock queueing and the GIL convoy live, and the round
+        # reports serve_lock_wait_sites + the slow-request count.
+        profiling.enable_lock_wait(True)
         store_dir = ((args.sig_store.rstrip("/") + "_serve")
                      if args.sig_store else
                      tempfile.mkdtemp(prefix="tse1m_serve_"))
@@ -877,6 +908,28 @@ def main() -> int:
                 overhead["traced"].append(_query_window())
         finally:
             set_tracing(True)
+        # Profiled-overhead gate (graftprof): the same alternating-
+        # window probe for the profiling plane — sampler stopped +
+        # lock-wait recorder detached vs the full profiler (sampler at
+        # default Hz + per-site lock-wait timing).  Best-of-3 per mode;
+        # CI asserts profiled p99 <= 1.1 x unprofiled + 0.5 ms.
+        prof_overhead: dict = {"unprofiled": [], "profiled": []}
+        try:
+            for _ in range(3):
+                profiling.stop_sampler()
+                profiling.enable_lock_wait(False)
+                prof_overhead["unprofiled"].append(_query_window())
+                profiling.enable_lock_wait(True)
+                profiling.start_sampler()
+                prof_overhead["profiled"].append(_query_window())
+        finally:
+            profiling.stop_sampler()
+            profiling.enable_lock_wait(True)
+            if args.profile:
+                # Restore the round-long --profile sampler the probe's
+                # windows tore down (lock-wait histograms live in the
+                # registry and survived).
+                profiling.start_sampler()
         with ServeClient(port=server.port) as c:
             c.shutdown()
         daemon.stop()
@@ -909,6 +962,10 @@ def main() -> int:
             "serve_sanitized": bool(args.sanitize),
             "serve_untraced_p99_ms": min(overhead["untraced"]),
             "serve_traced_p99_ms": min(overhead["traced"]),
+            "serve_unprofiled_p99_ms": min(prof_overhead["unprofiled"]),
+            "serve_profiled_p99_ms": min(prof_overhead["profiled"]),
+            "serve_lock_wait_sites": profiling.lock_wait_summary(top=8),
+            "serve_slow_requests": int(profiling.slow_requests_total()),
         }
 
     def bench_schemes() -> dict:
@@ -1180,6 +1237,16 @@ def main() -> int:
     result["trace_id"] = pinned_trace()
     result["trace_spans_recorded"] = spans_recorded()
     result.update(flat_metrics())
+    if args.profile:
+        # graftprof artifact for the round: sampler aggregate, collapsed
+        # stacks, per-site lock waits, slow-request captures — numbered
+        # and atomic like the flight files.
+        prof_path = profiling.dump_profile(
+            extra={"round": result["metric"], "n": int(args.n)},
+            d=os.environ.get("TSE1M_FLIGHT_DIR")
+            or os.path.join("data", "result_data"))
+        result["profile_path"] = prof_path
+        profiling.stop_sampler()
     print(json.dumps(result))
     return 0
 

@@ -39,14 +39,15 @@ import sys
 import tempfile
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-BENCH_KEYS = ("degradation_events", "degradation_counts", "chunk_halvings",
-              "store_scrub_shards", "store_scrub_corrupt",
-              "store_scrub_quarantined", "store_scrub_state_ok",
-              "wire_v3_saved_mb", "prefilter_hit_rate",
-              "prefilter_recall", "stage_entropy_s",
-              # telemetry plane: pinned trace + flat registry export
-              "trace_id", "trace_spans_recorded",
-              "metrics_stage_seconds_count")
+sys.path.insert(0, REPO)
+
+# The fault-context key contract lives in the shared machine-readable
+# schema (observability/regress.py BENCH_SCHEMA) — the same source of
+# truth the bench-smoke and serve-smoke heredocs import, so a renamed
+# key fails every job by name instead of drifting one inventory.
+from tse1m_tpu.observability.regress import required_keys  # noqa: E402
+
+BENCH_KEYS = required_keys("fault")
 
 # The machine-checked seat inventory (graftlint ``fault-seat-drift``):
 # every ``fault_point(...)`` seat in production code must have an entry

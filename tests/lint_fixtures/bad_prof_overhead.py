@@ -1,0 +1,20 @@
+"""prof-overhead fixture: a profiler that can outlive its process."""
+import threading
+
+
+class Sampler:
+    def start(self):
+        # no daemon flag at all: blocks interpreter exit
+        t = threading.Thread(target=self._loop, name="sampler")
+        t.start()
+        return t
+
+    def _loop(self):
+        pass
+
+
+def start_profiler(fn, live):
+    # computed daemon flag: an unauditable maybe, same finding
+    t = threading.Thread(target=fn, daemon=bool(live))
+    t.start()
+    return t

@@ -1,0 +1,28 @@
+"""prof-overhead fixture: daemon threads + the kill switch consulted."""
+import os
+import threading
+
+
+def profiling_enabled():
+    return os.environ.get("TSE1M_PROFILING", "1") != "0"
+
+
+class Sampler:
+    def start(self):
+        if not profiling_enabled():
+            return None
+        t = threading.Thread(target=self._loop, daemon=True,
+                             name="sampler")
+        t.start()
+        return t
+
+    def _loop(self):
+        pass
+
+
+def start_profiler(fn):
+    if not profiling_enabled():
+        return None
+    t = threading.Thread(target=fn, daemon=True)
+    t.start()
+    return t

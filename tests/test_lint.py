@@ -66,6 +66,21 @@ def _rule_findings(rule: str, filename: str, relpath: str | None = None):
     # manual start_span escape hatch needs a finally-guaranteed .end().
     ("span-discipline", "bad_span_discipline.py",
      "good_span_discipline.py", None),
+    # graftprof: sampler/profiler threads must be literal daemon=True
+    # and the plane must consult the TSE1M_PROFILING kill switch —
+    # bound by name markers anywhere...
+    ("prof-overhead", "bad_prof_overhead.py", "good_prof_overhead.py",
+     None),
+    # ...and wholesale inside the profiling module itself.
+    ("prof-overhead", "bad_prof_overhead.py", "good_prof_overhead.py",
+     "tse1m_tpu/observability/profiling.py"),
+    # The graftprof PR pulls profiling.py + regress.py into the
+    # watchdog-clock plane wholesale: profile/gate timestamps must share
+    # the deadline_clock axis (the serve fixtures are the in-plane pair).
+    ("watchdog-clock", "bad_serve_clock.py", "good_serve_clock.py",
+     "tse1m_tpu/observability/profiling.py"),
+    ("watchdog-clock", "bad_serve_clock.py", "good_serve_clock.py",
+     "tse1m_tpu/observability/regress.py"),
 ])
 def test_rule_bad_fires_good_silent(rule, bad, good, spoof):
     assert _rule_findings(rule, bad, spoof), f"{rule} missed {bad}"
@@ -109,6 +124,16 @@ def test_scheme_parity_kernel_modules_exempt():
                            "tse1m_tpu/cluster/pipeline.py")
     # one finding per raw kernel call site in the fixture
     assert len(found) == 4
+
+
+def test_prof_overhead_counts_and_kill_switch():
+    # two non-daemon spawns (absent flag, computed flag) + one
+    # kill-switch finding for the file
+    found = _rule_findings("prof-overhead", "bad_prof_overhead.py")
+    msgs = " | ".join(f.message for f in found)
+    assert len(found) == 3
+    assert "daemon=True" in msgs
+    assert "TSE1M_PROFILING" in msgs
 
 
 def test_nondeterminism_scoped_to_replay_planes():

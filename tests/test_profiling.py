@@ -1,0 +1,382 @@
+"""graftprof (observability/profiling.py): sampler lifecycle + kill
+switch, span/plane attribution, lock-wait histograms per site, the
+atomic numbered profile artifact, slow-request capture with the
+absorbing site named, and the serve-plane slowlog/profile verbs.
+
+The load-bearing claims:
+
+- ``TSE1M_PROFILING=0`` (or ``set_profiling(False)``) means NO sampling
+  threads exist — start refuses, a live sampler loop exits, and the
+  lock-wait recorder detaches;
+- every contended traced-lock site shows up in ``lock_wait_seconds``
+  under its own name (the recorder buffers and never deadlocks on the
+  registry's own lock — the regression test below);
+- a query that blows its SLO budget while an absorb is in flight
+  captures the absorbing site by name plus its own span chain;
+- ``profile_NNN.json`` numbers like the flight files and lands atomic.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tse1m_tpu.observability import flight, profiling
+from tse1m_tpu.observability.metrics import histogram, reset_metrics
+from tse1m_tpu.observability.tracing import span, thread_span_chain
+from tse1m_tpu.resilience.watchdog import deadline_clock
+from tse1m_tpu.trace import sync as tsync
+
+
+@pytest.fixture(autouse=True)
+def _clean_profiling_plane():
+    """Every test starts with no sampler, no recorder, an empty slowlog
+    and a fresh registry — and leaves the plane the same way."""
+    profiling.set_profiling(None)
+    profiling.stop_sampler()
+    profiling.enable_lock_wait(False)
+    profiling.slow_request_log().clear()
+    reset_metrics()
+    yield
+    profiling.set_profiling(None)
+    profiling.stop_sampler()
+    profiling.enable_lock_wait(False)
+    profiling.slow_request_log().clear()
+    reset_metrics()
+
+
+def _sampler_threads():
+    return [t for t in threading.enumerate()
+            if t.name.startswith("tse1m-prof-sampler")]
+
+
+def _burn(seconds: float) -> None:
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < seconds:
+        sum(i * i for i in range(500))
+
+
+# -- sampler lifecycle + kill switch ------------------------------------------
+
+def test_sampler_start_stop_and_snapshot():
+    s = profiling.start_sampler(hz=200.0)
+    assert s is not None and _sampler_threads()
+    with span("prof.test.burn"):
+        _burn(0.15)
+    snap = s.snapshot()
+    assert snap["samples"] > 0
+    assert snap["hz"] == 200.0
+    assert snap["plane_self"], snap
+    assert "prof.test.burn" in snap["span_self"], snap["span_self"]
+    profiling.stop_sampler()
+    assert not _sampler_threads()
+
+
+def test_start_sampler_is_idempotent():
+    a = profiling.start_sampler(hz=200.0)
+    b = profiling.start_sampler()
+    assert a is b
+    assert len(_sampler_threads()) == 1
+
+
+def test_kill_switch_env_refuses_start(monkeypatch):
+    monkeypatch.setenv("TSE1M_PROFILING", "0")
+    assert profiling.profiling_enabled() is False
+    assert profiling.start_sampler() is None
+    assert not _sampler_threads()
+    assert profiling.enable_lock_wait(True) is False
+
+
+def test_kill_switch_tears_down_live_sampler():
+    assert profiling.start_sampler(hz=200.0) is not None
+    profiling.enable_lock_wait(True)
+    assert _sampler_threads()
+    profiling.set_profiling(False)
+    # "off" must mean no sampling threads exist: stop_sampler joined it
+    assert not _sampler_threads()
+    # ...and the lock-wait recorder detached (raw acquires from here on)
+    lk = tsync.Lock("prof.test.dead")
+    with lk:
+        pass
+    assert not any(r["site"] == "prof.test.dead"
+                   for r in profiling.lock_wait_summary())
+    # env verdict restored by the autouse fixture via set_profiling(None)
+
+
+def test_env_kill_switch_exits_running_loop(monkeypatch):
+    s = profiling.start_sampler(hz=200.0)
+    assert s is not None
+    monkeypatch.setenv("TSE1M_PROFILING", "0")
+    # the loop re-checks the switch every period (5 ms at 200 Hz)
+    deadline = time.monotonic() + 2.0
+    while _sampler_threads() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert not _sampler_threads()
+
+
+# -- lock-wait attribution ----------------------------------------------------
+
+def _contend(site: str) -> None:
+    """Make the calling thread measurably queue on a lock named
+    ``site`` while the recorder watches."""
+    lk = tsync.Lock(site)
+    held = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with lk:
+            held.set()
+            release.wait(2.0)
+
+    t = threading.Thread(target=holder, daemon=True)
+    t.start()
+    assert held.wait(2.0)
+    threading.Timer(0.03, release.set).start()
+    with lk:
+        pass
+    t.join(2.0)
+
+
+def test_lock_wait_histograms_per_site():
+    profiling.enable_lock_wait(True)
+    _contend("prof.test.contended")
+    rows = {r["site"]: r for r in profiling.lock_wait_summary()}
+    assert "prof.test.contended" in rows, rows
+    assert rows["prof.test.contended"]["count"] >= 1
+    assert rows["prof.test.contended"]["max_ms"] >= 10.0
+
+
+def test_lock_wait_recorder_survives_registry_locks():
+    """The deadlock regression: recording a wait for the registry's OWN
+    lock must not re-acquire it (the pending-buffer design)."""
+    profiling.enable_lock_wait(True)
+    done = []
+
+    def worker():
+        for i in range(200):
+            histogram("prof_test_regress", lane=str(i % 3)).observe(0.001)
+        done.append(True)
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10.0)
+    assert len(done) == 4, "registry traffic deadlocked under recorder"
+    assert any(r["site"] == "MetricsRegistry"
+               for r in profiling.lock_wait_summary())
+
+
+def test_drain_lock_waits_is_per_thread_and_one_shot():
+    profiling.enable_lock_wait(True)
+    _contend("prof.test.drain")
+    waits = profiling.drain_lock_waits()
+    assert any(site == "prof.test.drain" for site, _ in waits), waits
+    assert profiling.drain_lock_waits() == []  # drained
+
+
+# -- slow-request capture -----------------------------------------------------
+
+def test_capture_slow_request_names_absorbing_site():
+    profiling.start_sampler(hz=200.0)
+    with span("serve.query.test"):
+        time.sleep(0.02)
+        rec = profiling.capture_slow_request(
+            "query", wall_s=0.02, budget_ms=1.0,
+            absorb={"site": "serve.index.swap", "rows": 4096,
+                    "since_s": 1.0},
+            rows=1)
+    assert rec["kind"] == "query"
+    assert rec["wall_ms"] == pytest.approx(20.0)
+    assert rec["absorb"]["site"] == "serve.index.swap"
+    assert rec["absorb"]["rows"] == 4096
+    # the capture ran inside the open span: the chain names it
+    assert "serve.query.test" in rec["span_chain"], rec["span_chain"]
+    assert rec["tags"]["rows"] == 1
+    assert profiling.slow_requests_total() == 1
+    assert profiling.recent_slow_requests()[-1]["kind"] == "query"
+
+
+def test_thread_span_chain_mirrors_nesting():
+    with span("outer"):
+        with span("inner"):
+            chain = thread_span_chain()
+    assert chain[-2:] == ["outer", "inner"]
+    assert thread_span_chain() == []  # both closed
+
+
+def test_slowlog_ring_is_bounded():
+    slog = profiling.SlowRequestLog(capacity=4)
+    for i in range(10):
+        slog.append({"kind": "query", "i": i})
+    assert slog.total() == 10
+    assert [r["i"] for r in slog.recent()] == [6, 7, 8, 9]
+    assert [r["i"] for r in slog.recent(2)] == [8, 9]
+
+
+def test_daemon_query_slow_capture_behind_absorb(tmp_path):
+    """The acceptance shape: a query that blows its budget while the
+    daemon is mid-absorb captures the absorbing site by name, with the
+    query's span chain attached."""
+    from tse1m_tpu.cluster import ClusterParams
+    from tse1m_tpu.data.synth import synth_session_sets
+    from tse1m_tpu.serve import ServeDaemon, SloPolicy
+
+    items = synth_session_sets(64, set_size=64, seed=5)[0]
+    dm = ServeDaemon(str(tmp_path / "store"),
+                     params=ClusterParams(n_hashes=32, n_bands=4,
+                                          use_pallas="never"),
+                     slo=SloPolicy(query_p99_target_ms=0.0)).start()
+    try:
+        dm.ingest(items, timeout=60)
+        dm.quiesce(timeout=60)
+        # Freeze a mid-absorb state the way the ingest thread publishes
+        # it (GIL-atomic whole-dict overwrite), then query: a 0 ms
+        # budget makes every query an SLO violation, so the capture is
+        # deterministic.
+        dm._busy = True
+        dm._inflight = {"site": "serve.index.swap", "rows": 4096,
+                        "since_s": 0.0}
+        with span("serve.query"):
+            dm.query(items[:1])
+    finally:
+        dm._busy = False
+        dm.stop(commit=False)
+    assert profiling.slow_requests_total() >= 1
+    rec = profiling.recent_slow_requests()[-1]
+    assert rec["kind"] == "query"
+    assert rec["absorb"]["site"] == "serve.index.swap"
+    assert rec["budget_ms"] == 0.0
+    assert "serve.query" in rec["span_chain"], rec["span_chain"]
+
+
+# -- profile artifact ---------------------------------------------------------
+
+def test_dump_profile_numbers_like_flight_files(tmp_path):
+    profiling.start_sampler(hz=200.0)
+    time.sleep(0.05)
+    p0 = profiling.dump_profile(d=str(tmp_path))
+    p1 = profiling.dump_profile(d=str(tmp_path))
+    assert p0.endswith("profile_000.json")
+    assert p1.endswith("profile_001.json")
+    with open(p0) as f:
+        payload = json.load(f)
+    for key in ("pid", "uptime_s", "profiling_enabled", "sampler",
+                "collapsed_stacks", "lock_wait_sites", "slow_requests",
+                "slow_requests_total"):
+        assert key in payload, key
+    assert payload["sampler"]["hz"] == 200.0
+    # atomicity: no temp droppings next to the artifacts
+    leftovers = [f for f in os.listdir(str(tmp_path))
+                 if not f.startswith("profile_")]
+    assert leftovers == [], leftovers
+
+
+def test_dump_profile_without_directory_is_none(monkeypatch):
+    monkeypatch.delenv("TSE1M_FLIGHT_DIR", raising=False)
+    monkeypatch.setattr(flight, "_flight_dir", None)
+    assert profiling.dump_profile() is None
+
+
+def test_profile_status_shape():
+    profiling.start_sampler(hz=200.0)
+    st = profiling.profile_status()
+    assert st["profiling_enabled"] is True
+    assert st["sampler_alive"] is True
+    assert isinstance(st["lock_wait_top"], list)
+    assert st["slow_requests_total"] == 0
+    profiling.stop_sampler()
+    assert profiling.profile_status()["sampler_alive"] is False
+
+
+def test_sampler_stacks_between_window():
+    s = profiling.start_sampler(hz=200.0)
+    assert s is not None
+    t0 = deadline_clock()
+    with span("prof.window.test"):
+        _burn(0.1)
+    t1 = deadline_clock()
+    win = s.stacks_between(t0, t1)
+    assert win, "no samples landed in a 100 ms busy window at 200 Hz"
+    assert all(t0 - 0.01 <= w["t_s"] <= t1 + 0.01 for w in win)
+    assert any(w["span"] == "prof.window.test" for w in win), win[:3]
+
+
+def test_collapsed_stack_format():
+    s = profiling.start_sampler(hz=200.0)
+    _burn(0.08)
+    np.sort(np.random.default_rng(0).integers(0, 100, 1000))
+    lines = s.collapsed(limit=10)
+    assert lines and len(lines) <= 10
+    stack, count = lines[0].rsplit(" ", 1)
+    assert int(count) >= 1
+    assert ":" in stack  # frame labels are file:function
+
+
+# -- serve verbs --------------------------------------------------------------
+
+def test_serve_slowlog_and_profile_verbs(tmp_path):
+    from tse1m_tpu.cluster import ClusterParams
+    from tse1m_tpu.data.synth import synth_session_sets
+    from tse1m_tpu.serve import (ServeClient, ServeDaemon, ServeServer,
+                                 SloPolicy)
+
+    flight.set_flight_dir(str(tmp_path / "flight"))
+    items = synth_session_sets(64, set_size=64, seed=7)[0]
+    dm = ServeDaemon(str(tmp_path / "store"),
+                     params=ClusterParams(n_hashes=32, n_bands=4,
+                                          use_pallas="never"),
+                     slo=SloPolicy(query_p99_target_ms=0.0)).start()
+    server = ServeServer(dm, port=0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        profiling.start_sampler(hz=200.0)
+        with ServeClient(port=server.port) as c:
+            c.ingest(items, timeout_s=60)
+            c.quiesce(timeout_s=60)
+            q = c.query(items[:4], timeout_s=60)
+            assert q["known"].all()
+            # budget 0 ms: that query IS a slow request — and it ran
+            # inside the server's serve.query span, so the capture's
+            # span chain names the op
+            sl = c.slowlog()
+            assert sl["ok"] and sl["slow_requests_total"] >= 1
+            assert sl["slow_requests"][-1]["kind"] == "query"
+            assert "serve.query" in sl["slow_requests"][-1]["span_chain"]
+            assert len(c.slowlog(n=1)["slow_requests"]) == 1
+            # status surfaces the graftprof counters
+            st = c.status()
+            assert st["slow_requests_total"] >= 1
+            assert isinstance(st["lock_wait_top"], list)
+            assert len(st["lock_wait_top"]) <= 3
+            # profile verb: live summary + dumped artifact on demand
+            pr = c.profile()
+            assert pr["ok"] and pr["profiling_enabled"] is True
+            assert pr["sampler_alive"] is True
+            pr2 = c.profile(dump=True)
+            assert pr2["profile_path"].endswith("profile_000.json")
+            with open(pr2["profile_path"]) as f:
+                assert json.load(f)["pid"] == os.getpid()
+            c.shutdown()
+    finally:
+        flight.set_flight_dir(None)
+        server.server_close()
+        dm.stop(commit=False)
+
+
+def test_cli_serve_client_lists_new_ops(capsys):
+    from tse1m_tpu import cli as _cli
+
+    with pytest.raises(SystemExit) as ei:
+        _cli.main(["serve-client", "--help"])
+    assert ei.value.code == 0
+    out = capsys.readouterr().out
+    assert "slowlog" in out and "profile" in out
+    with pytest.raises(SystemExit):
+        _cli.main(["serve-client", "not-an-op"])

@@ -1,0 +1,253 @@
+"""Bench-key schema + perf-regression gate (observability/regress.py).
+
+The edge-case contract the module docstring promises, plus the two
+acceptance shapes from the graftprof PR: a planted 2x stage-wall
+regression at seconds scale MUST fail the gate, and the r08 -> r09
+diff must render a readable grouped report.  The committed
+``BENCH_r08.json`` / ``BENCH_r09.json`` rounds are the fixtures — the
+gate is tested against the artifacts it exists to judge.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+
+import pytest
+
+from tse1m_tpu.bench import main as bench_main
+from tse1m_tpu.observability.regress import (BENCH_SCHEMA,
+                                             assert_bench_keys, diff,
+                                             format_gate_report, gate,
+                                             gated_keys, load_runs,
+                                             required_keys,
+                                             write_baseline)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _round(name: str) -> dict:
+    with open(os.path.join(REPO, name)) as f:
+        return json.load(f)
+
+
+# -- schema contract ----------------------------------------------------------
+
+def test_schema_contexts_cover_the_four_smokes():
+    for ctx in ("bench", "degradation", "fault", "serve"):
+        assert required_keys(ctx), ctx
+    # the graftprof keys joined the serve contract
+    serve = required_keys("serve")
+    for key in ("serve_unprofiled_p99_ms", "serve_profiled_p99_ms",
+                "serve_lock_wait_sites", "serve_slow_requests"):
+        assert key in serve, key
+
+
+def test_assert_bench_keys_names_the_offending_key():
+    good = {k: 1 for k in required_keys("serve")}
+    assert_bench_keys(good, "serve")  # complete contract passes
+    del good["serve_p99_ms"]
+    with pytest.raises(AssertionError, match="serve_p99_ms"):
+        assert_bench_keys(good, "serve")
+
+
+def test_gated_keys_are_schema_entries_with_bands():
+    keys = gated_keys()
+    assert "stage_compute_s" in keys and "ari_vs_planted" in keys
+    for key in keys:
+        spec = BENCH_SCHEMA[key]
+        assert spec["dir"] in ("lower", "higher"), key
+        assert spec["tol"] >= 0 and spec["abs"] >= 0, key
+
+
+def test_committed_rounds_satisfy_the_bench_contract():
+    # the schema is derived FROM the trajectory: r08 (the last round
+    # that ran the full matrix, warm store included) carries every
+    # bench-context key.  r09 skipped the warm-store pass, which is
+    # exactly the kind of contract drift assert_bench_keys exists to
+    # catch in CI — so it doubles as the negative fixture here.
+    assert_bench_keys(_round("BENCH_r08.json"), "bench")
+    with pytest.raises(AssertionError, match="cluster_warm_wall_s|cache_"):
+        assert_bench_keys(_round("BENCH_r09.json"), "bench")
+
+
+# -- the gate: clean pass and planted regression ------------------------------
+
+def test_gate_clean_pass_against_own_baseline():
+    # an unregressed round gates green; keys with no baseline history
+    # (serve_p99_ms is absent from r09's matrix) warn instead of fail
+    r09 = _round("BENCH_r09.json")
+    report = gate(r09, [r09])
+    assert report["ok"], format_gate_report(report)
+    r08 = _round("BENCH_r08.json")
+    assert gate(r08, [r08])["ok"]
+
+
+def test_gate_fails_planted_2x_stage_wall():
+    """The acceptance criterion: double a stage wall at seconds scale
+    against an r09-derived baseline and the gate MUST go red."""
+    r09 = _round("BENCH_r09.json")
+    assert r09["stage_compute_s"] > 1.0, (
+        "fixture rot: planted 2x below seconds scale would hide in the "
+        "absolute slack band")
+    planted = dict(r09)
+    planted["stage_compute_s"] = r09["stage_compute_s"] * 2.0
+    report = gate(planted, [r09])
+    assert not report["ok"]
+    bad = [r for r in report["rows"] if not r["ok"]]
+    assert [r["key"] for r in bad] == ["stage_compute_s"]
+    assert "stage_compute_s" in format_gate_report(report)
+
+
+def test_gate_direction_aware_quality_drop():
+    r09 = _round("BENCH_r09.json")
+    dropped = dict(r09)
+    dropped["ari_vs_planted"] = r09["ari_vs_planted"] - 0.2
+    report = gate(dropped, [r09])
+    assert not report["ok"]
+    bad = {r["key"] for r in report["rows"] if not r["ok"]}
+    assert bad == {"ari_vs_planted"}
+    # a quality IMPROVEMENT never trips a lower-is-better style bound
+    improved = dict(r09)
+    improved["ari_vs_planted"] = min(1.0, r09["ari_vs_planted"] + 0.1)
+    assert gate(improved, [r09])["ok"]
+
+
+def test_gate_missing_key_current_fails_missing_baseline_warns():
+    r09 = _round("BENCH_r09.json")
+    # gated key missing from the CURRENT run: the contract shrank — red
+    shrunk = {k: v for k, v in r09.items() if k != "stage_compute_s"}
+    report = gate(shrunk, [r09])
+    assert not report["ok"]
+    row = next(r for r in report["rows"] if r["key"] == "stage_compute_s")
+    assert "contract shrank" in row["note"]
+    # gated key missing from the BASELINE: the contract grew — warn only
+    baseline = {k: v for k, v in r09.items() if k != "stage_compute_s"}
+    report = gate(r09, [baseline])
+    assert report["ok"]
+    row = next(r for r in report["rows"] if r["key"] == "stage_compute_s")
+    assert row["ok"] and "re-baseline" in row["note"]
+
+
+def test_gate_zero_and_nan_baselines_never_crash():
+    base = {"stage_compute_s": 0.0}
+    # zero median: band degrades to 3*MAD + abs slack (0.5 s here)
+    assert gate({"stage_compute_s": 0.4}, [base],
+                keys=("stage_compute_s",))["ok"]
+    assert not gate({"stage_compute_s": 0.9}, [base],
+                    keys=("stage_compute_s",))["ok"]
+    # NaN baseline values are filtered; with no finite history the key
+    # is reported, not gated
+    report = gate({"stage_compute_s": 5.0},
+                  [{"stage_compute_s": float("nan")}],
+                  keys=("stage_compute_s",))
+    assert report["ok"]
+    assert "no baseline history" in report["rows"][0]["note"]
+    # NaN CURRENT value: skipped with a note, never a crash
+    report = gate({"stage_compute_s": float("nan")},
+                  [{"stage_compute_s": 2.0}], keys=("stage_compute_s",))
+    assert report["ok"]
+    assert "non-finite" in report["rows"][0]["note"]
+
+
+def test_gate_single_run_baseline_has_no_mad_term():
+    report = gate({"stage_compute_s": 2.0}, [{"stage_compute_s": 2.0}],
+                  keys=("stage_compute_s",))
+    row = report["rows"][0]
+    assert row["ok"] and row["mad"] == 0.0 and row["n"] == 1
+    assert "single-run" in row["note"]
+
+
+def test_gate_mad_widens_band_with_noisy_history():
+    # history median 2.0, MAD 0.5: bound = 2 + 2*0.75 + 3*0.5 + 0.5 = 5.5
+    hist = [{"stage_compute_s": v} for v in (1.5, 2.0, 2.5)]
+    assert gate({"stage_compute_s": 5.4}, hist,
+                keys=("stage_compute_s",))["ok"]
+    assert not gate({"stage_compute_s": 5.6}, hist,
+                    keys=("stage_compute_s",))["ok"]
+
+
+# -- the diff -----------------------------------------------------------------
+
+def test_diff_r08_r09_is_readable():
+    out = diff(_round("BENCH_r08.json"), _round("BENCH_r09.json"),
+               name_a="BENCH_r08.json", name_b="BENCH_r09.json")
+    assert out.startswith("bench diff: BENCH_r08.json -> BENCH_r09.json")
+    # grouped sections, and the serve keys that arrived in r09 are
+    # listed as a visible contract change, not silently dropped
+    assert "[stage]" in out or "[core]" in out
+    assert "only in BENCH_r09.json" in out
+
+
+def test_diff_direction_aware_verdicts():
+    a = {"stage_compute_s": 2.0, "ari_vs_planted": 0.9,
+         "cluster_encoding": "delta-v3"}
+    b = {"stage_compute_s": 4.0, "ari_vs_planted": 0.99,
+         "cluster_encoding": "delta-v4"}
+    out = diff(a, b)
+    assert "WORSE" in out      # wall doubled (lower is better)
+    assert "better" in out     # quality rose (higher is better)
+    assert "'delta-v3' -> 'delta-v4'" in out  # identity change shown
+
+
+def test_diff_flags_scale_change_and_zero_to_zero():
+    a = {"metric": "2k", "stage_compute_s": 0.0}
+    b = {"metric": "1m", "stage_compute_s": 1.0}
+    out = diff(a, b, show_all=True)
+    assert "not scale-comparable" in out
+    assert "new" in out  # zero -> nonzero renders, no ZeroDivisionError
+    # identical ungated values are suppressed by default
+    assert "(no differences)" in diff({"x": 1}, {"x": 1})
+
+
+# -- baseline files + module CLI ----------------------------------------------
+
+def test_write_and_load_baseline_roundtrip(tmp_path):
+    runs = [{"stage_compute_s": v} for v in (1.0, 2.0, 3.0)]
+    path = str(tmp_path / "baseline.json")
+    write_baseline(path, runs, note="test history")
+    loaded = load_runs(path)
+    assert loaded == runs
+    # a bare single-result file loads as a one-run history
+    single = str(tmp_path / "single.json")
+    with open(single, "w") as f:
+        json.dump({"stage_compute_s": 2.0}, f)
+    assert load_runs(single) == [{"stage_compute_s": 2.0}]
+    with open(single, "w") as f:
+        json.dump([], f)
+    with pytest.raises(ValueError):
+        load_runs(single)
+
+
+def test_bench_cli_gate_exit_codes(tmp_path, capsys):
+    r09 = os.path.join(REPO, "BENCH_r09.json")
+    base = str(tmp_path / "base.json")
+    assert bench_main(["baseline", base, r09, "--note", "r09"]) == 0
+    assert bench_main(["gate", r09, "--baseline", base]) == 0
+    planted = dict(_round("BENCH_r09.json"))
+    planted["stage_compute_s"] *= 2.0
+    cur = str(tmp_path / "planted.json")
+    with open(cur, "w") as f:
+        json.dump(planted, f)
+    assert bench_main(["gate", cur, "--baseline", base]) == 1
+    out = capsys.readouterr().out
+    assert "perf gate: PASS" in out and "perf gate: FAIL" in out
+    assert "stage_compute_s" in out
+
+
+def test_bench_cli_diff_and_keys(capsys):
+    r08 = os.path.join(REPO, "BENCH_r08.json")
+    r09 = os.path.join(REPO, "BENCH_r09.json")
+    assert bench_main(["diff", r08, r09]) == 0
+    assert bench_main(["keys", "serve"]) == 0
+    out = capsys.readouterr().out
+    assert "bench diff:" in out
+    assert "serve_profiled_p99_ms" in out
+
+
+def test_committed_smoke_baseline_is_loadable():
+    runs = load_runs(os.path.join(REPO, "BENCH_baseline_smoke.json"))
+    assert runs
+    for run in runs:
+        assert math.isfinite(float(run["value"]))

@@ -516,8 +516,32 @@ def _cmd_cluster(args) -> int:
     else:
         manifest_path = os.path.join(cfg.result_dir, "run_manifest.json")
     runner = StepRunner(manifest_path)
-    rec = runner.run("cluster", _run_cluster_step, args, sig_store,
-                     distributed, pod_route)
+    # graftprof: --profile wraps the step in the host sampler + device
+    # trace and drops profile_NNN.json next to run_manifest.json.  The
+    # kill switch (TSE1M_PROFILING=0) wins over the flag.
+    from .observability import profiling
+
+    prof_on = bool(getattr(args, "profile", False)) \
+        and profiling.profiling_enabled()
+    if prof_on:
+        profiling.install_compile_listener()
+        profiling.enable_lock_wait(True)
+        profiling.start_sampler()
+    try:
+        with profiling.device_trace(
+                os.path.join(cfg.result_dir, "device_trace")
+                if prof_on else None):
+            rec = runner.run("cluster", _run_cluster_step, args, sig_store,
+                             distributed, pod_route)
+    finally:
+        if prof_on:
+            prof_path = profiling.dump_profile(
+                extra={"step": "cluster", "n": int(args.n)},
+                d=cfg.result_dir)
+            profiling.stop_sampler()
+            profiling.enable_lock_wait(False)
+            if prof_path:
+                log.info("cluster: profile -> %s", prof_path)
     if (rec.result or {}).get("pod_epoch") is not None:
         runner.set_meta(epoch=rec.result["pod_epoch"])
     if nproc > 1:
@@ -842,6 +866,10 @@ def _cmd_serve_client(args) -> int:
                     else client.ingest(vectors))
             resp = {k: (v.tolist() if isinstance(v, np.ndarray) else v)
                     for k, v in resp.items()}
+        elif args.op == "slowlog":
+            resp = client.slowlog(args.limit)
+        elif args.op == "profile":
+            resp = client.profile(dump=args.dump)
         else:
             resp = getattr(client, args.op)()
     print(json.dumps(resp))
@@ -974,14 +1002,19 @@ def main(argv=None) -> int:
                        help="one client request against a running serve "
                             "daemon")
     p.add_argument("op", choices=("ping", "status", "query", "ingest",
-                                  "metrics", "trace", "quiesce",
-                                  "shutdown"))
+                                  "metrics", "trace", "slowlog", "profile",
+                                  "quiesce", "shutdown"))
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--port-file", default=None)
     p.add_argument("--npy", default=None,
                    help="[K, S] uint32 .npy of coverage vectors "
                         "(query/ingest)")
+    p.add_argument("--limit", type=int, default=None,
+                   help="slowlog: at most N most-recent captures")
+    p.add_argument("--dump", action="store_true",
+                   help="profile: also write profile_NNN.json daemon-side "
+                        "and return its path")
     p.set_defaults(fn=_cmd_serve_client)
 
     p = sub.add_parser("cluster", help="MinHash+LSH session dedup demo")
@@ -1025,6 +1058,13 @@ def main(argv=None) -> int:
                         "models set membership only). Joins the store/"
                         "checkpoint policy tuple: mixed-scheme stores "
                         "refuse like mixed-seed stores")
+    p.add_argument("--profile", action="store_true",
+                   help="graftprof: host sampling profiler (span/plane/"
+                        "lock-wait attribution) + jax device trace + "
+                        "compile-duration histograms around the run; "
+                        "writes profile_NNN.json (and device_trace/) into "
+                        "the result dir next to run_manifest.json. "
+                        "TSE1M_PROFILING=0 kills the whole plane")
     p.set_defaults(fn=_cmd_cluster)
 
     args = ap.parse_args(argv)

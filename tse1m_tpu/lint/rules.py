@@ -689,7 +689,15 @@ def nondeterminism(src: FileSource) -> list[Finding]:
 
 _WATCHDOG_PLANE = ("tse1m_tpu/resilience/watchdog.py",
                    "tse1m_tpu/resilience/coordinator.py",
-                   "tse1m_tpu/observability/latency.py")
+                   "tse1m_tpu/observability/latency.py",
+                   # graftprof: the sampler timestamps stacks and the
+                   # lock-wait recorder times acquires on the same axis
+                   # the SLO math compares against; the regression gate
+                   # judges walls measured on it.  A second clock in
+                   # either file makes profile/flight/bench timelines
+                   # unalignable.
+                   "tse1m_tpu/observability/profiling.py",
+                   "tse1m_tpu/observability/regress.py")
 # The serving plane (PR 10) lives in the clock discipline wholesale: its
 # SLO decisions, latency histograms and admission windows all compare
 # against watchdog budgets, so a raw clock anywhere in tse1m_tpu/serve/
@@ -821,6 +829,73 @@ def span_discipline(src: FileSource) -> list[Finding]:
     return out
 
 
+# -- 12. prof-overhead (profiling plane) --------------------------------------
+#
+# A profiler must never be able to hang or outlive the process it
+# observes.  Two checkable shapes enforce that (graftprof PR):
+# (a) every thread the profiling plane spawns is constructed with a
+# literal ``daemon=True`` — a non-daemon sampler blocks interpreter
+# exit, so the observed process cannot die until its observer does, and
+# a computed daemon flag is an unauditable maybe; (b) a plane file that
+# spawns threads must reference the ``TSE1M_PROFILING`` kill switch
+# somewhere, so an operator can amputate ALL sampling with one env var
+# when the profiler itself becomes the problem.  Scope: the profiling
+# module, plus any function or class whose name claims sampler/profiler
+# semantics anywhere in the tree.
+
+_PROF_PLANE = ("tse1m_tpu/observability/profiling.py",)
+_PROF_NAME_MARKERS = ("sampler", "profiler")
+_PROF_KILL_SWITCH = "TSE1M_PROFILING"
+
+
+def _enclosing_names(node: ast.AST, parents: dict) -> str:
+    """Lowercased, space-joined names of every enclosing function and
+    class — the scope a profiling-plane thread spawn is judged by."""
+    names = []
+    while node is not None:
+        node = parents.get(node)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            names.append(node.name.lower())
+    return " ".join(names)
+
+
+def prof_overhead(src: FileSource) -> list[Finding]:
+    out = []
+    in_plane = src.path in _PROF_PLANE
+    parents = None
+    plane_spawns = []
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _dotted(node.func).rsplit(".", 1)[-1] != "Thread":
+            continue
+        if parents is None:
+            parents = _parents(src.tree)
+        if not (in_plane or any(m in _enclosing_names(node, parents)
+                                for m in _PROF_NAME_MARKERS)):
+            continue
+        plane_spawns.append(node)
+        daemon_literal_true = any(
+            kw.arg == "daemon" and isinstance(kw.value, ast.Constant)
+            and kw.value.value is True
+            for kw in node.keywords)
+        if not daemon_literal_true:
+            out.append(_f(src, node,
+                          "profiling-plane `Thread(...)` without a literal "
+                          "`daemon=True` — a non-daemon sampler thread "
+                          "blocks interpreter exit, so the observed "
+                          "process cannot die until its observer does"))
+    if plane_spawns and _PROF_KILL_SWITCH not in src.text:
+        out.append(_f(src, plane_spawns[0],
+                      "profiling code spawns threads but never consults "
+                      f"the `{_PROF_KILL_SWITCH}` kill switch — the "
+                      "operator must be able to amputate all sampling "
+                      "with one env var when the profiler itself becomes "
+                      "the problem"))
+    return out
+
+
 RULES = {
     "broad-except": broad_except,
     "nonatomic-write": nonatomic_write,
@@ -833,6 +908,7 @@ RULES = {
     "nondeterminism": nondeterminism,
     "watchdog-clock": watchdog_clock,
     "span-discipline": span_discipline,
+    "prof-overhead": prof_overhead,
 }
 
 __all__ = ["RULES"]

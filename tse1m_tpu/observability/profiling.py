@@ -1,0 +1,634 @@
+"""graftprof: host sampling profiler with span & lock-wait attribution.
+
+The telemetry plane (PR 13) tells you *that* a request was slow — a
+span duration, a histogram tail.  This module tells you *why*: a
+daemon thread samples ``sys._current_frames()`` at ``TSE1M_PROF_HZ``
+(default 97 Hz — prime, so the sampler never phase-locks to a
+periodic workload) and tags each sampled thread with its active span
+via the per-thread open-span mirror in :mod:`.tracing`.  Samples
+aggregate three ways: per-plane self-time (which subpackage owns the
+wall), per-span self-time (which unit of work owns it), and collapsed
+stacks (``a;b;c count`` — the flamegraph input format), all readable
+while the process runs and dumped atomically into ``profile_NNN.json``
+next to the flight files.
+
+Lock-wait attribution rides the traced-lock seat in
+:mod:`..trace.sync`: when enabled, every untraced acquire is timed on
+``deadline_clock`` and the time-to-acquire lands in the metrics
+registry as ``lock_wait_seconds{site=<lock name>}``.  This is the
+direct, quantified picture of a lock convoy — e.g. the BENCH_r08
+anecdote of queries stuck 250 ms+ behind a big ingest absorb shows up
+as a fat ``SignatureStore.*`` / absorb-site tail here.  The recorder
+never touches the registry directly: the acquire it just timed may BE
+the registry's own lock, still held by the caller, so observations
+buffer in a GIL-atomic dict and :func:`flush_lock_waits` folds them
+into the histograms from lock-free entry points.
+
+The slow-request log closes the loop for serving: when a query or
+ingest blows its SLO budget, :func:`capture_slow_request` freezes the
+evidence — open-span chain, completed spans of the same trace, the
+sampler stacks overlapping the request window, the lock waits the
+request's thread just suffered, and the daemon's in-flight absorb
+state — into a bounded ring exported over the TCP ``slowlog`` verb.
+
+Overhead discipline (the ``prof-overhead`` lint rule's contract):
+every sampling thread is a ``daemon=True`` thread named
+``tse1m-prof-sampler``, and the whole plane sits behind one kill
+switch — ``TSE1M_PROFILING=0`` (or :func:`set_profiling`) refuses to
+start samplers, detaches the lock-wait recorder, and makes a running
+sampler loop exit.  CI gates the residual cost: profiled query p99
+must stay within 1.1x + 0.5 ms of unprofiled.
+
+This module lives in the ``watchdog-clock`` lint plane: all timing is
+``deadline_clock`` (one time base with the deadlines and histograms
+the profiles explain) and the only file write is the atomic profile
+dump.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import os
+import sys
+import threading
+
+from ..resilience.watchdog import deadline_clock
+from ..trace import sync as tsync
+from ..trace.hooks import shared_access, trace_point
+from ..utils.atomic import atomic_write
+from ..utils.logging import get_logger
+from . import tracing
+from .flight import get_flight_dir
+from .metrics import counter, get_registry, histogram
+
+log = get_logger("observability.profiling")
+
+_DEFAULT_HZ = 97.0
+_STACK_DEPTH = 48
+_STACK_CAP = 5000
+_RECENT_SAMPLES = 4096
+_DEFAULT_SLOWLOG = 64
+_WAIT_FLOOR_MS = 0.5      # per-thread recent-wait floor (noise gate)
+_WAIT_KEEP = 16           # per-thread recent waits retained for capture
+_PROFILE_FMT = "profile_{:03d}.json"
+_SAMPLER_THREAD_NAME = "tse1m-prof-sampler"
+
+
+# -- kill switch --------------------------------------------------------------
+
+_override: bool | None = None
+
+
+def profiling_enabled() -> bool:
+    """The plane-wide kill switch: ``TSE1M_PROFILING=0`` wins unless a
+    runtime :func:`set_profiling` call overrode it.  Checked on sampler
+    start AND inside the sampler loop, so flipping the env var kills a
+    live sampler within one period."""
+    if _override is not None:
+        return _override
+    return os.environ.get("TSE1M_PROFILING", "1") != "0"
+
+
+def set_profiling(on: bool | None) -> None:
+    """Runtime override of the kill switch (``None`` restores the env
+    var's verdict).  Turning profiling off tears down the live seats:
+    the global sampler is stopped and joined, and the lock-wait
+    recorder is detached — "off" must mean no sampling threads exist."""
+    global _override
+    _override = None if on is None else bool(on)
+    if on is not None and not on:
+        stop_sampler()
+        tsync.set_lock_wait_recorder(None)
+
+
+# -- sample attribution helpers ----------------------------------------------
+
+def _plane_of(filename: str) -> str:
+    """Map a frame's file to its plane: the subpackage under
+    ``tse1m_tpu/`` (``serve``, ``cluster``, ...), a top-level module's
+    own name, or ``ext`` for everything outside the package."""
+    p = filename.replace("\\", "/")
+    i = p.rfind("tse1m_tpu/")
+    if i < 0:
+        return "ext"
+    rest = p[i + len("tse1m_tpu/"):]
+    j = rest.find("/")
+    return rest[:j] if j >= 0 else rest.rsplit(".", 1)[0]
+
+
+def _frame_label(code) -> str:
+    base = os.path.basename(code.co_filename)
+    return f"{base.rsplit('.', 1)[0]}:{code.co_name}"
+
+
+# -- the sampler --------------------------------------------------------------
+
+class Sampler:
+    """Periodic whole-process stack sampler (one daemon thread).
+
+    State is guarded by one traced lock; the sampler thread is the
+    only writer, readers (``snapshot``/``stacks_between``/the dump)
+    see a consistent cut.  The thread never samples itself — its own
+    frames are pure overhead, not workload."""
+
+    def __init__(self, hz: float | None = None) -> None:
+        if hz is None:
+            hz = float(os.environ.get("TSE1M_PROF_HZ", _DEFAULT_HZ))
+        self.hz = max(1.0, float(hz))
+        self._period = 1.0 / self.hz
+        self._lock = tsync.Lock("Sampler")
+        self._stop_evt = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._samples = 0
+        self._plane_self: dict = {}
+        self._span_self: dict = {}
+        self._stacks: dict = {}
+        self._recent: collections.deque = collections.deque(
+            maxlen=_RECENT_SAMPLES)
+        self._started_at = deadline_clock()
+
+    # lifecycle ---------------------------------------------------------------
+
+    def start(self) -> bool:
+        """Spawn the sampler thread; False (and no thread) when the
+        TSE1M_PROFILING kill switch is off."""
+        if not profiling_enabled():
+            return False
+        with self._lock:
+            shared_access(self, "thread", write=True)
+            if self._thread is not None and self._thread.is_alive():
+                return True
+            self._stop_evt = threading.Event()
+            th = threading.Thread(target=self._loop,
+                                  name=_SAMPLER_THREAD_NAME, daemon=True)
+            self._thread = th
+            self._started_at = deadline_clock()
+        th.start()
+        return True
+
+    def stop(self, timeout: float = 2.0) -> None:
+        with self._lock:
+            shared_access(self, "thread", write=True)
+            th = self._thread
+            evt = self._stop_evt
+            self._thread = None
+        evt.set()
+        if th is not None and th.is_alive():
+            th.join(timeout)
+
+    def alive(self) -> bool:
+        with self._lock:
+            shared_access(self, "thread", write=False)
+            th = self._thread
+        return th is not None and th.is_alive()
+
+    def _loop(self) -> None:
+        evt = self._stop_evt
+        while not evt.wait(self._period):
+            if not profiling_enabled():
+                break
+            self._sample_once()
+
+    # sampling ----------------------------------------------------------------
+
+    def _sample_once(self) -> None:
+        now = deadline_clock()
+        me = threading.get_ident()
+        frames = sys._current_frames()
+        with self._lock:
+            shared_access(self, "stacks", write=True)
+            for tid, frame in frames.items():
+                if tid == me:
+                    continue
+                leaf_plane = _plane_of(frame.f_code.co_filename)
+                entry = tracing.thread_span(tid)
+                span_name = entry[2] if entry else "(no-span)"
+                parts = []
+                f = frame
+                depth = 0
+                while f is not None and depth < _STACK_DEPTH:
+                    parts.append(_frame_label(f.f_code))
+                    f = f.f_back
+                    depth += 1
+                parts.reverse()
+                collapsed = ";".join(parts)
+                self._samples += 1
+                self._plane_self[leaf_plane] = (
+                    self._plane_self.get(leaf_plane, 0) + 1)
+                self._span_self[span_name] = (
+                    self._span_self.get(span_name, 0) + 1)
+                if collapsed in self._stacks or len(
+                        self._stacks) < _STACK_CAP:
+                    self._stacks[collapsed] = (
+                        self._stacks.get(collapsed, 0) + 1)
+                else:
+                    self._stacks["(other)"] = (
+                        self._stacks.get("(other)", 0) + 1)
+                self._recent.append((now, tid, span_name, collapsed))
+
+    # readers -----------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """JSON-safe aggregate: total samples, per-plane and per-span
+        self-time shares, and how long the sampler has run."""
+        with self._lock:
+            shared_access(self, "stacks", write=False)
+            samples = self._samples
+            planes = dict(self._plane_self)
+            spans = dict(self._span_self)
+            t0 = self._started_at
+        return {
+            "hz": self.hz,
+            "samples": samples,
+            "window_s": round(deadline_clock() - t0, 3),
+            "plane_self": dict(sorted(planes.items(),
+                                      key=lambda kv: -kv[1])),
+            "span_self": dict(sorted(spans.items(),
+                                     key=lambda kv: -kv[1])[:32]),
+        }
+
+    def collapsed(self, limit: int | None = None) -> list:
+        """Flamegraph lines ``frame;frame;frame count``, hottest first
+        — feed straight to flamegraph.pl / speedscope."""
+        with self._lock:
+            shared_access(self, "stacks", write=False)
+            items = sorted(self._stacks.items(), key=lambda kv: -kv[1])
+        if limit is not None:
+            items = items[:int(limit)]
+        return [f"{stack} {count}" for stack, count in items]
+
+    def stacks_between(self, t0: float, t1: float,
+                       tid: int | None = None) -> list:
+        """Samples whose timestamp falls in ``[t0, t1]`` (deadline_clock
+        axis), optionally for one thread — the slow-request log's
+        "what was the process doing during my window" query."""
+        with self._lock:
+            shared_access(self, "stacks", write=False)
+            recent = list(self._recent)
+        out = []
+        for t, sample_tid, span_name, collapsed in recent:
+            if t < t0 or t > t1:
+                continue
+            if tid is not None and sample_tid != tid:
+                continue
+            out.append({"t_s": round(t, 4), "tid": sample_tid,
+                        "span": span_name, "stack": collapsed})
+        return out
+
+
+# -- global sampler seat ------------------------------------------------------
+
+_sampler: Sampler | None = None
+
+
+def start_sampler(hz: float | None = None) -> Sampler | None:
+    """Start (or return the running) process-wide sampler; None when
+    the kill switch is off — callers never need to branch."""
+    global _sampler
+    if not profiling_enabled():
+        return None
+    s = _sampler
+    if s is None:
+        s = Sampler(hz)
+        _sampler = s
+    if not s.start():
+        return None
+    return s
+
+
+def get_sampler() -> Sampler | None:
+    return _sampler
+
+
+def stop_sampler(timeout: float = 2.0) -> None:
+    global _sampler
+    s = _sampler
+    _sampler = None
+    if s is not None:
+        s.stop(timeout)
+
+
+# -- lock-wait attribution ----------------------------------------------------
+
+_wait_state = threading.local()
+
+# Pending per-site wait samples, folded into the registry's
+# ``lock_wait_seconds`` histograms by flush_lock_waits().  The recorder
+# CANNOT observe into the registry directly: the acquire it just timed
+# may be the registry's own lock (every histogram lives behind one),
+# and observing would re-acquire that non-reentrant lock on the same
+# thread — a self-deadlock no reentrancy flag can prevent.  setdefault
+# and append are GIL-atomic, so this buffer needs no lock of its own.
+_pending_waits: dict = {}
+_PENDING_CAP = 4096
+
+
+def _record_lock_wait(lock, acquire, blocking: bool = True,
+                      timeout: float = -1) -> bool:
+    """The recorder installed into trace.sync: time the raw acquire on
+    deadline_clock, buffer it per lock site (see ``_pending_waits``),
+    and remember notable waits per-thread for slow-request capture.
+    The ``busy`` flag stops acquires made *while recording* from
+    re-entering the recorder."""
+    st = _wait_state
+    if getattr(st, "busy", False):
+        return acquire(blocking, timeout)
+    st.busy = True
+    try:
+        t0 = deadline_clock()
+        ok = acquire(blocking, timeout)
+        dt = deadline_clock() - t0
+        pend = _pending_waits.setdefault(lock.name, [])
+        if len(pend) < _PENDING_CAP:
+            pend.append(dt)
+        if dt * 1e3 >= _WAIT_FLOOR_MS:
+            waits = getattr(st, "waits", None)
+            if waits is None:
+                waits = st.waits = []
+            waits.append((lock.name, round(dt * 1e3, 3)))
+            del waits[:-_WAIT_KEEP]
+        return ok
+    finally:
+        st.busy = False
+
+
+def flush_lock_waits() -> None:
+    """Fold the pending wait samples into the registry's
+    ``lock_wait_seconds`` histograms.  Callers must not hold any traced
+    lock (every summary/dump entry point qualifies).  Best-effort: a
+    sample appended to a site list between our pop and a concurrent
+    setdefault is dropped — profiling data, not accounting."""
+    st = _wait_state
+    st.busy = True  # don't record the registry's own acquires below
+    try:
+        for site in list(_pending_waits):
+            samples = _pending_waits.pop(site, [])
+            if samples:
+                h = histogram("lock_wait_seconds", site=site)
+                for dt in samples:
+                    h.observe(dt)
+    finally:
+        st.busy = False
+
+
+def enable_lock_wait(on: bool = True) -> bool:
+    """Attach (or detach) the lock-wait recorder to the traced-lock
+    seat.  Refuses to attach when TSE1M_PROFILING kills the plane."""
+    if on and not profiling_enabled():
+        return False
+    tsync.set_lock_wait_recorder(_record_lock_wait if on else None)
+    return bool(on)
+
+
+def drain_lock_waits() -> list:
+    """``(site, wait_ms)`` pairs the *calling thread* accumulated since
+    its last drain — a slow request drains its own thread to learn
+    which locks it just queued on."""
+    waits = getattr(_wait_state, "waits", None)
+    if not waits:
+        return []
+    out = list(waits)
+    del waits[:]
+    return out
+
+
+def lock_wait_summary(top: int | None = None) -> list:
+    """Per-site wait stats from the registry's ``lock_wait_seconds``
+    histograms, worst p99 first: ``{site, count, p99_ms, max_ms}``."""
+    flush_lock_waits()
+    out = []
+    for m in get_registry().collect():
+        if m.name != "lock_wait_seconds" or not hasattr(m, "snapshot"):
+            continue
+        snap = m.snapshot()
+        if not snap.get("count"):
+            continue
+        out.append({"site": m.labels.get("site", "?"),
+                    "count": snap["count"],
+                    "p99_ms": snap["p99_ms"],
+                    "max_ms": snap["max_ms"]})
+    out.sort(key=lambda r: (-r["p99_ms"], r["site"]))
+    if top is not None:
+        out = out[:int(top)]
+    return out
+
+
+# -- slow-request log ---------------------------------------------------------
+
+class SlowRequestLog:
+    """Bounded ring of SLO-violation captures (thread-safe,
+    overwrite-oldest).  Records are JSON-safe dicts: the ``slowlog``
+    verb and ``serve --status`` ship them without translation."""
+
+    def __init__(self, capacity: int | None = None) -> None:
+        if capacity is None:
+            capacity = int(os.environ.get("TSE1M_SLOWLOG_CAP",
+                                          _DEFAULT_SLOWLOG))
+        self.capacity = max(1, int(capacity))
+        self._lock = tsync.Lock("SlowRequestLog")
+        self._buf: collections.deque = collections.deque(
+            maxlen=self.capacity)
+        self._total = 0
+
+    def append(self, record: dict) -> None:
+        trace_point("profiling.slowlog.append")
+        with self._lock:
+            shared_access(self, "buf", write=True)
+            self._buf.append(record)
+            self._total += 1
+
+    def recent(self, n: int | None = None) -> list:
+        with self._lock:
+            shared_access(self, "buf", write=False)
+            out = list(self._buf)
+        if n is not None:
+            out = out[-int(n):]
+        return out
+
+    def total(self) -> int:
+        with self._lock:
+            shared_access(self, "buf", write=False)
+            return self._total
+
+    def clear(self) -> None:
+        with self._lock:
+            shared_access(self, "buf", write=True)
+            self._buf.clear()
+            self._total = 0
+
+
+_slowlog = SlowRequestLog()
+
+
+def slow_request_log() -> SlowRequestLog:
+    return _slowlog
+
+
+def recent_slow_requests(n: int | None = None) -> list:
+    return _slowlog.recent(n)
+
+
+def slow_requests_total() -> int:
+    return _slowlog.total()
+
+
+def capture_slow_request(kind: str, wall_s: float, budget_ms: float,
+                         t0: float | None = None,
+                         absorb: dict | None = None, **tags) -> dict:
+    """Freeze the evidence for one budget-blowing request.  Call from
+    the request's own thread right after it finishes: the open-span
+    chain, the per-thread lock waits, the sampler window and the
+    in-flight absorb state are all read relative to the caller."""
+    now = deadline_clock()
+    if t0 is None:
+        t0 = now - wall_s
+    trace = tracing.current_trace()
+    record = {
+        "kind": str(kind),
+        "wall_ms": round(wall_s * 1e3, 3),
+        "budget_ms": round(float(budget_ms), 3),
+        "at_s": round(now, 3),
+        "trace": trace,
+        "span_chain": tracing.thread_span_chain(),
+        "lock_waits_ms": drain_lock_waits(),
+        "absorb": dict(absorb) if absorb else None,
+    }
+    sampler = _sampler
+    if sampler is not None:
+        record["stacks"] = sampler.stacks_between(t0, now)[-8:]
+    else:
+        record["stacks"] = []
+    if trace:
+        record["trace_spans"] = [
+            s for s in tracing.recent_spans(64)
+            if s and s.get("trace") == trace["t"]][-8:]
+    if tags:
+        record["tags"] = {str(k): v for k, v in tags.items()}
+    _slowlog.append(record)
+    counter("slow_requests_total", kind=str(kind)).inc()
+    return record
+
+
+# -- compile-duration histograms ----------------------------------------------
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_compile_listener_installed = False
+
+
+def install_compile_listener() -> bool:
+    """Idempotently route XLA backend-compile durations into the
+    registry as ``jit_compile_seconds`` (jax.monitoring has no removal
+    API, so ONE process-lifetime listener; the registry histogram it
+    feeds is reset with the registry).  Returns availability."""
+    global _compile_listener_installed
+    if _compile_listener_installed:
+        return True
+    try:
+        import jax.monitoring as monitoring
+
+        def _on_event(event: str, duration: float = 0.0, **kw) -> None:
+            if event == _COMPILE_EVENT:
+                histogram("jit_compile_seconds",
+                          event=event.rsplit("/", 1)[-1]).observe(duration)
+
+        monitoring.register_event_duration_secs_listener(_on_event)
+    except Exception as e:  # graftlint: disable=broad-except -- jax absent/too old; compile histograms degrade to unavailable
+        log.warning("compile-duration listener unavailable (%s: %s)",
+                    type(e).__name__, e)
+        return False
+    _compile_listener_installed = True
+    return True
+
+
+@contextlib.contextmanager
+def device_trace(outdir: str | None):
+    """``jax.profiler`` device-trace capture around a block; a no-op
+    when ``outdir`` is falsy, the kill switch is off, or jax's profiler
+    is unavailable — call sites never branch."""
+    if not outdir or not profiling_enabled():
+        yield
+        return
+    try:
+        from jax import profiler as jprof
+        os.makedirs(outdir, exist_ok=True)
+        jprof.start_trace(outdir)
+    except Exception as e:  # graftlint: disable=broad-except -- profiler backend optional; trace capture degrades to no-op
+        log.warning("device trace unavailable (%s: %s)",
+                    type(e).__name__, e)
+        yield
+        return
+    try:
+        yield
+    finally:
+        jprof.stop_trace()
+
+
+# -- artifact + status --------------------------------------------------------
+
+def _next_profile_path(d: str) -> str:
+    n = 0
+    for name in os.listdir(d):
+        if name.startswith("profile_") and name.endswith(".json"):
+            try:
+                n = max(n, int(name[len("profile_"):-len(".json")]) + 1)
+            except ValueError:
+                continue
+    return os.path.join(d, _PROFILE_FMT.format(n))
+
+
+def dump_profile(extra: dict | None = None,
+                 d: str | None = None) -> str | None:
+    """Write ``profile_NNN.json`` (atomic, numbered like the flight
+    files) into ``d`` or the flight directory; returns the path, or
+    None when no directory is configured.  All timestamps are on the
+    deadline_clock axis — profiles and flight dumps line up."""
+    if d is None:
+        d = get_flight_dir()
+    if not d:
+        return None
+    sampler = _sampler
+    payload = {
+        "pid": os.getpid(),
+        "uptime_s": round(deadline_clock(), 3),
+        "trace_id": tracing.pinned_trace(),
+        "profiling_enabled": profiling_enabled(),
+        "sampler": sampler.snapshot() if sampler is not None else None,
+        "collapsed_stacks": (sampler.collapsed(200)
+                             if sampler is not None else []),
+        "lock_wait_sites": lock_wait_summary(),
+        "slow_requests": _slowlog.recent(32),
+        "slow_requests_total": _slowlog.total(),
+    }
+    if extra:
+        payload["extra"] = dict(extra)
+    os.makedirs(d, exist_ok=True)
+    path = _next_profile_path(d)
+    with atomic_write(path) as f:
+        json.dump(payload, f, indent=2, default=str)
+    log.info("profile dumped to %s", path)
+    return path
+
+
+def profile_status() -> dict:
+    """JSON-safe live summary for the serve ``profile`` verb and
+    ``--status``: kill-switch state, sampler aggregate, worst lock
+    sites, slow-request tally."""
+    sampler = _sampler
+    return {
+        "profiling_enabled": profiling_enabled(),
+        "sampler_alive": bool(sampler is not None and sampler.alive()),
+        "sampler": sampler.snapshot() if sampler is not None else None,
+        "lock_wait_top": lock_wait_summary(top=3),
+        "slow_requests_total": _slowlog.total(),
+    }
+
+
+__all__ = ["Sampler", "SlowRequestLog", "capture_slow_request",
+           "device_trace", "drain_lock_waits", "dump_profile",
+           "enable_lock_wait", "flush_lock_waits", "get_sampler",
+           "install_compile_listener", "lock_wait_summary",
+           "profile_status", "profiling_enabled", "recent_slow_requests",
+           "set_profiling", "slow_request_log", "slow_requests_total",
+           "start_sampler", "stop_sampler"]

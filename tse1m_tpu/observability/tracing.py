@@ -33,6 +33,7 @@ from __future__ import annotations
 import contextlib
 import contextvars
 import os
+import threading
 
 from ..resilience.watchdog import deadline_clock
 from ..trace import sync as tsync
@@ -158,6 +159,32 @@ def pinned_trace() -> str | None:
 _current: contextvars.ContextVar = contextvars.ContextVar(
     "tse1m_current_span", default=None)
 
+# Thread-id -> stack of (trace, span_id, name) for OPEN spans.  The
+# contextvar above is invisible from other threads, but the sampling
+# profiler (observability/profiling.py) must tag a ``sys._current_frames``
+# sample with the sampled thread's active span — this mirror is that
+# join table.  Each thread only ever mutates its own entry (one dict
+# store / pop under the GIL), so readers get a consistent-enough view
+# without a lock on the span hot path.
+_thread_spans: dict = {}
+
+
+def thread_span(tid: int):
+    """(trace, span_id, name) of the innermost open span on thread
+    ``tid``, or None — the sampler's attribution lookup."""
+    stack = _thread_spans.get(tid)
+    return stack[-1] if stack else None
+
+
+def thread_span_chain(tid: int | None = None) -> list:
+    """Open-span names outermost-first for ``tid`` (default: the calling
+    thread) — the slow-request log's span chain for spans that have not
+    closed into the ring yet."""
+    if tid is None:
+        tid = threading.get_ident()
+    stack = _thread_spans.get(tid)
+    return [entry[2] for entry in stack] if stack else []
+
 
 def current_trace() -> dict | None:
     """The propagation context of the innermost active span:
@@ -173,7 +200,7 @@ class Span:
     reaches the ring on the first call."""
 
     __slots__ = ("trace", "span_id", "parent", "name", "tags",
-                 "_start", "_token", "_done")
+                 "_start", "_token", "_done", "_tid")
 
     def __init__(self, trace: str, span_id: str, parent: str,
                  name: str, tags: dict, token) -> None:
@@ -185,6 +212,9 @@ class Span:
         self._start = deadline_clock()
         self._token = token
         self._done = False
+        self._tid = threading.get_ident()
+        _thread_spans.setdefault(self._tid, []).append(
+            (trace, span_id, name))
 
     def set_tag(self, key: str, value) -> None:
         self.tags[str(key)] = value
@@ -197,6 +227,16 @@ class Span:
         if self._token is not None:
             with contextlib.suppress(ValueError):
                 _current.reset(self._token)
+        stack = _thread_spans.get(self._tid)
+        if stack:
+            # Normally the top frame; a span ended from another thread
+            # (rare cross-callback shape) searches down for its id.
+            for i in range(len(stack) - 1, -1, -1):
+                if stack[i][1] == self.span_id:
+                    del stack[i]
+                    break
+            if not stack:
+                _thread_spans.pop(self._tid, None)
         _ring.append({"trace": self.trace, "span": self.span_id,
                       "parent": self.parent, "name": self.name,
                       "start_s": round(self._start, 6),
@@ -267,5 +307,5 @@ def continue_trace(ctx: dict | None):
 __all__ = ["Span", "SpanRing", "adopt_trace", "clear_spans",
            "continue_trace", "current_trace", "new_trace_id",
            "pinned_trace", "recent_spans", "set_tracing", "span",
-           "span_ring", "spans_recorded", "start_span",
-           "tracing_enabled"]
+           "span_ring", "spans_recorded", "start_span", "thread_span",
+           "thread_span_chain", "tracing_enabled"]

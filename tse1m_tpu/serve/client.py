@@ -150,6 +150,23 @@ class ServeClient:
         return self.request("trace", timeout_s=request_budget_s("status")
                             or None, **payload)
 
+    def slowlog(self, n: int | None = None) -> dict:
+        """Recent slow-request captures (graftprof): span chain,
+        sampler stacks, lock waits, in-flight absorb state per entry."""
+        payload = {"n": int(n)} if n else {}
+        return self.request("slowlog",
+                            timeout_s=request_budget_s("status") or None,
+                            **payload)
+
+    def profile(self, dump: bool = False) -> dict:
+        """Live profiler summary (sampler aggregate, top lock-wait
+        sites); ``dump=True`` also writes profile_NNN.json daemon-side
+        and returns its path."""
+        payload = {"dump": True} if dump else {}
+        return self.request("profile",
+                            timeout_s=request_budget_s("status") or None,
+                            **payload)
+
     def quiesce(self, timeout_s: float | None = None) -> dict:
         return self.request(
             "quiesce",

@@ -51,6 +51,7 @@ from ..cluster.encode import quantize_ids
 from ..cluster.store import SignatureStore, row_digests
 from ..observability import StageRecorder, record_degradation
 from ..observability import metrics as obs_metrics
+from ..observability import profiling
 from ..observability.flight import dump_flight, get_flight_dir, set_flight_dir
 from ..observability.latency import LatencyRecorder
 from ..observability.tracing import continue_trace, current_trace, span
@@ -179,6 +180,12 @@ class ServeDaemon:
         self._q: queue.Queue[_Ticket] = queue.Queue()
         self._stop = threading.Event()
         self._busy = False
+        # In-flight absorb state for slow-request attribution: the
+        # ingest thread overwrites the whole dict at each phase (one
+        # GIL-atomic reference store), a slow query copies it — the
+        # capture names the site (batch vs index swap) and size of the
+        # work it queued behind.
+        self._inflight: dict = {}
         self._last_committed_gen = self._index.generation
         self._ingest_error: BaseException | None = None
         self._thread: threading.Thread | None = None
@@ -263,6 +270,7 @@ class ServeDaemon:
             log.warning("serve: recovered %d acked row(s) the persisted "
                         "state did not cover (crash between append and "
                         "state commit)", absorbed)
+        self._inflight = {}
 
     # -- index mutation (ingest thread only) ---------------------------------
 
@@ -297,6 +305,9 @@ class ServeDaemon:
 
     def _absorb(self, digests: np.ndarray, sigs: np.ndarray,
                 locator: np.ndarray) -> None:
+        self._inflight = {"site": "serve.index.swap",
+                          "rows": int(digests.shape[0]),
+                          "since_s": round(deadline_clock(), 3)}
         index = self._index
         keys = host_band_keys(sigs, self.params.n_bands)
         new_index = index.absorb(
@@ -372,8 +383,16 @@ class ServeDaemon:
                     with continue_trace(t.trace):
                         with span("serve.ingest.batch",
                                   rows=int(t.items.shape[0])):
+                            ti = deadline_clock()
                             with self.lat_ingest.time():
                                 t.done(self._ingest_batch(t.items))
+                            wall_i = deadline_clock() - ti
+                            if wall_i > self.slo.ingest_budget_s > 0:
+                                profiling.capture_slow_request(
+                                    "ingest", wall_i,
+                                    self.slo.ingest_budget_s * 1e3,
+                                    t0=ti, absorb=self._inflight,
+                                    rows=int(t.items.shape[0]))
                     gen = self._index.generation
                     if (gen - self._last_committed_gen
                             >= self.state_commit_every):
@@ -396,6 +415,7 @@ class ServeDaemon:
                           "continues", type(e).__name__, e)
             finally:
                 self._busy = False
+                self._inflight = {}
 
     def _ingest_batch(self, items: np.ndarray) -> dict:
         """One acknowledged batch: EVERY row becomes a new index row (the
@@ -405,6 +425,8 @@ class ServeDaemon:
         gather their signature, only the content-novel tail touches the
         device."""
         k = int(items.shape[0])
+        self._inflight = {"site": "serve.ingest.batch", "rows": k,
+                          "since_s": round(deadline_clock(), 3)}
         index = self._index
         n_old = index.n_rows
         if k == 0:
@@ -496,6 +518,15 @@ class ServeDaemon:
         wall = deadline_clock() - t0
         self.lat_query.add(wall)
         self.tracker.observe_query(wall)
+        if wall * 1e3 > self.slo.query_p99_target_ms:
+            # SLO violation: freeze the evidence while it is still warm
+            # — the ingest thread's in-flight absorb state (copied: it
+            # may finish mid-capture), this thread's recent lock waits
+            # and the sampler window all point at the convoy.
+            profiling.capture_slow_request(
+                "query", wall, self.slo.query_p99_target_ms, t0=t0,
+                absorb=self._inflight if self._busy else None,
+                rows=n, generation=int(index.generation))
         return {"labels": out, "known": hit,
                 "generation": index.generation}
 
@@ -527,6 +558,10 @@ class ServeDaemon:
                 "serve_ingest_rejected_total").value),
             "uncommitted_generations": int(index.generation
                                            - self._last_committed_gen),
+            # graftprof: slow-request tally + the three worst lock-wait
+            # sites (empty until the lock-wait recorder is enabled).
+            "slow_requests_total": profiling.slow_requests_total(),
+            "lock_wait_top": profiling.lock_wait_summary(top=3),
             "last_scrub": dict(self.last_scrub),
             "policy": dict(self.store.policy),
             **self.admission.stats(),

@@ -33,6 +33,7 @@ import threading
 
 import numpy as np
 
+from ..observability import profiling
 from ..observability.export import flat_metrics, prometheus_text
 from ..observability.tracing import (continue_trace, recent_spans, span,
                                      spans_recorded)
@@ -182,6 +183,22 @@ class ServeServer(socketserver.ThreadingTCPServer):
             return {"ok": True,
                     "spans": recent_spans(int(n) if n else None),
                     "spans_recorded": spans_recorded()}
+        if op == "slowlog":
+            # SLO-violation captures (span chain + sampler window +
+            # in-flight absorb state), newest last.
+            n = msg.get("n")
+            return {"ok": True,
+                    "slow_requests": profiling.recent_slow_requests(
+                        int(n) if n else None),
+                    "slow_requests_total":
+                        profiling.slow_requests_total()}
+        if op == "profile":
+            # Live profiler summary; ``dump: true`` additionally writes
+            # the atomic profile_NNN.json next to the flight files.
+            resp = {"ok": True, **profiling.profile_status()}
+            if msg.get("dump"):
+                resp["profile_path"] = profiling.dump_profile()
+            return resp
         if op == "shutdown":
             self._shutdown_requested.set()
             threading.Thread(target=self.shutdown,

@@ -25,6 +25,23 @@ import threading
 
 from . import hooks
 
+# Lock-wait attribution seat (observability/profiling.py): when a
+# recorder is installed, every untraced acquire routes through it so the
+# profiler can histogram time-to-acquire per lock site — the direct
+# measurement of a lock convoy (e.g. queries stuck behind an ingest
+# absorb).  ``None`` (the default) keeps the production fast path at one
+# extra global read; the recorder itself must never touch a traced lock
+# without its own reentrancy guard, or recording a wait would recurse.
+_lock_wait_recorder = None
+
+
+def set_lock_wait_recorder(recorder) -> None:
+    """Install (or clear, with ``None``) the lock-wait recorder:
+    ``recorder(lock, acquire, blocking, timeout) -> bool`` wraps the raw
+    acquire and owns the timing."""
+    global _lock_wait_recorder
+    _lock_wait_recorder = recorder
+
 
 class Lock:
     """Traced non-reentrant mutex (context-manager capable)."""
@@ -38,7 +55,10 @@ class Lock:
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
         t = hooks.active_tracer()
         if t is None:
-            return self._real.acquire(blocking, timeout)
+            rec = _lock_wait_recorder
+            if rec is None:
+                return self._real.acquire(blocking, timeout)
+            return rec(self, self._real.acquire, blocking, timeout)
         return t.lock_acquire(self, blocking, timeout)
 
     def release(self) -> None:
@@ -71,4 +91,4 @@ class RLock(Lock):
     _factory = staticmethod(threading.RLock)
 
 
-__all__ = ["Lock", "RLock"]
+__all__ = ["Lock", "RLock", "set_lock_wait_recorder"]
