@@ -333,6 +333,21 @@ def main() -> int:
     p.add_argument("--serve-batch", type=int,
                    default=int(os.environ.get("BENCH_SERVE_BATCH", 1024)),
                    help="ingest batch size for the serving round")
+    p.add_argument("--serve-sharded", action="store_true",
+                   default=os.environ.get("BENCH_SERVE_SHARDED", "")
+                   not in ("", "0"),
+                   help="sharded serving round (BENCH_r10 contract): "
+                        "--serve-shards digest-range shard daemons behind "
+                        "a fan-out router plus one read replica; runs an "
+                        "in-process failover drill (lost-ack window drop "
+                        "+ epoch-advanced writer replacement + zombie "
+                        "fence check) and emits serve_shards / "
+                        "serve_router_p99_ms / serve_replica_qps / "
+                        "serve_failover_lost_acks (also "
+                        "BENCH_SERVE_SHARDED=1)")
+    p.add_argument("--serve-shards", type=int,
+                   default=int(os.environ.get("BENCH_SERVE_SHARDS", 2)),
+                   help="shard-daemon count for --serve-sharded")
     p.add_argument("--scheme", default=os.environ.get("BENCH_SCHEME",
                                                       "kminhash"),
                    choices=("kminhash", "cminhash", "weighted"),
@@ -968,6 +983,185 @@ def main() -> int:
             "serve_slow_requests": int(profiling.slow_requests_total()),
         }
 
+    def bench_serve_sharded() -> dict:
+        """Sharded serving round (the BENCH_r10 contract): N digest-range
+        shard daemons — each a single-writer ``ServeDaemon`` over its
+        ``range_NNNN/`` slice, fenced by an epoch lease — behind the
+        fan-out router, plus ONE read replica streaming shard 0.
+
+        Three phases, all in-process (the multi-process SIGKILL shape
+        lives in tests/test_serve_chaos.py and the CI fault matrix):
+
+        1. Ingest the corpus through the router in batches, then measure
+           the router's broadcast-query p99 (``serve_router_p99_ms``).
+        2. Failover drill: (a) an injected connection drop at the
+           ``serve.router.forward`` lost-ack window — the retry carries
+           the SAME request id, so the shard's journal replays the
+           committed ack instead of double-absorbing; (b) an
+           epoch-advanced replacement writer takes shard 0's lease, the
+           superseded zombie is asserted to append ZERO rows, and every
+           previously acked row must still answer ``known`` through the
+           router: ``serve_failover_lost_acks`` is the count that does
+           not (gated at exactly 0).
+        3. Replica round: stream shard 0's store, adopt, assert zero
+           staleness after the final pull, and measure sustained replica
+           query rate (``serve_replica_qps``)."""
+        import shutil as _shutil
+        import tempfile
+
+        import numpy as np
+
+        from tse1m_tpu.resilience.coordinator import (LeaseSupersededError,
+                                                      RangeLeaseGuard)
+        from tse1m_tpu.resilience.faults import (FaultPlan, FaultRule,
+                                                 clear_plan, install_plan)
+        from tse1m_tpu.serve import (LocalTransport, ServeDaemon,
+                                     ServeReplica, ShardRouter, SloPolicy,
+                                     replica_staleness, stream_shards)
+
+        n_shards = max(2, int(args.serve_shards))
+        n_sh = int(min(args.n,
+                       int(os.environ.get("BENCH_SHARDED_N", "8192"))))
+        corpus = items[:n_sh]
+        batch = max(1, min(int(args.serve_batch), 512))
+        root = tempfile.mkdtemp(prefix="tse1m_serve_sharded_")
+
+        def spawn(sid: int, guard=None):
+            guard = guard or RangeLeaseGuard.claim(root, sid, owner=sid)
+            return ServeDaemon(os.path.join(root, f"range_{sid:04d}"),
+                               params=params, signer="host",
+                               state_commit_every=1, lease_guard=guard,
+                               slo=SloPolicy.from_env()).start()
+
+        daemons = {sid: spawn(sid) for sid in range(n_shards)}
+        router = ShardRouter(
+            {sid: LocalTransport(d) for sid, d in daemons.items()})
+        try:
+            # Phase 1: routed ingest + router query p99.
+            ingest_walls = []
+            for lo in range(0, n_sh, batch):
+                t0 = time.perf_counter()
+                router.ingest(corpus[lo:lo + batch])
+                ingest_walls.append(time.perf_counter() - t0)
+            probe = np.random.default_rng(11).integers(0, n_sh, size=200)
+            walls = []
+            for i in probe:
+                t0 = time.perf_counter()
+                resp = router.query(corpus[int(i):int(i) + 1])
+                walls.append(time.perf_counter() - t0)
+                if not bool(resp["known"][0]):
+                    raise AssertionError(
+                        f"routed row {int(i)} unknown to its shard owner")
+            router_p99_ms = round(
+                float(np.percentile(np.asarray(walls), 99)) * 1e3, 3)
+
+            # Phase 2a: lost-ack window drop -> journal replay, not a
+            # double absorb.
+            rows_before = sum(d.store.n_rows for d in daemons.values())
+            # dup_fraction=0: content-unique drill rows, so the store-row
+            # accounting below is exact (novel == unique digests).
+            drill, _ = synth_session_sets(batch, set_size=args.set_size,
+                                          seed=args.seed + 104729,
+                                          dup_fraction=0.0)
+            install_plan(FaultPlan([FaultRule(
+                site="serve.router.forward", kind="connection_drop",
+                times=1)]))
+            try:
+                ack = router.ingest(drill, request_id="bench-failover-ack")
+            finally:
+                clear_plan()
+            if int(ack["acked"]) != batch:
+                raise AssertionError(
+                    f"short ack across the dropped forward: {ack}")
+
+            # Phase 2b: epoch-advanced replacement writer for shard 0;
+            # the superseded zombie must append zero rows.
+            zombie = daemons[0]
+            z_rows = zombie.store.n_rows
+            replacement_guard = RangeLeaseGuard.claim(root, 0, owner=100)
+            fenced = False
+            try:
+                zombie.ingest(corpus[:1], timeout=60)
+            except (RuntimeError, LeaseSupersededError):
+                fenced = True
+            if not fenced or zombie.store.n_rows != z_rows:
+                raise AssertionError(
+                    "superseded shard writer was not fenced (rows "
+                    f"{z_rows} -> {zombie.store.n_rows})")
+            zombie.stop(commit=False)
+            daemons[0] = spawn(0, guard=replacement_guard)
+            router.transports[0] = LocalTransport(daemons[0])
+            # Re-send the drill batch under the SAME request id across
+            # the writer swap: committed slices replay, nothing absorbs
+            # twice.
+            ack2 = router.ingest(drill, request_id="bench-failover-ack")
+            if int(ack2["acked"]) != batch:
+                raise AssertionError(f"failover re-ack short: {ack2}")
+            rows_after = sum(d.store.n_rows for d in daemons.values())
+            expect_rows = rows_before + int(ack["novel"])
+            if rows_after != expect_rows:
+                raise AssertionError(
+                    f"failover double-absorbed: {rows_after} store rows, "
+                    f"expected {expect_rows}")
+            # Zero lost acks: every row acked before the failover still
+            # answers known through the router.
+            lost = 0
+            for lo in range(0, n_sh, 2048):
+                resp = router.query(corpus[lo:lo + 2048])
+                lost += int((~np.asarray(resp["known"])).sum())
+            lost += int((~np.asarray(
+                router.query(drill)["known"])).sum())
+            if lost:
+                raise AssertionError(
+                    f"{lost} acked row(s) lost across the shard failover")
+
+            # Phase 3: read replica over shard 0's streamed store.
+            replica_dir = os.path.join(root, "replica_0000")
+            src = daemons[0].store.directory
+            router.quiesce(timeout=600)  # commit state for the stream
+            stream_shards(src, replica_dir)
+            replica = ServeReplica(replica_dir, params=params)
+            replica.refresh()
+            staleness = replica_staleness(src, replica)
+            if staleness:
+                raise AssertionError(
+                    f"replica {staleness} generation(s) stale after a "
+                    "completed pull")
+            rep_walls = []
+            t_rep = time.perf_counter()
+            for i in probe[:100]:
+                t0 = time.perf_counter()
+                replica.query(corpus[int(i):int(i) + 1])
+                rep_walls.append(time.perf_counter() - t0)
+            rep_window = time.perf_counter() - t_rep
+            status = router.status()
+            if not status["ok"]:
+                raise AssertionError(
+                    f"sharded status degraded: {status}")
+            return {
+                "serve_shards": n_shards,
+                "serve_router_p99_ms": router_p99_ms,
+                "serve_router_rows": int(status["router_rows"]),
+                "serve_router_replayed_acks":
+                    int(status["router_replayed_acks"]),
+                "serve_replica_qps": round(
+                    len(rep_walls) / max(rep_window, 1e-9), 1),
+                "serve_replica_p99_ms": round(float(np.percentile(
+                    np.asarray(rep_walls), 99)) * 1e3, 3),
+                "serve_replica_staleness": int(staleness),
+                "serve_failover_lost_acks": int(lost),
+                "serve_sharded_rows": rows_after,
+                "serve_sharded_ingest_rows_s": round(
+                    n_sh / max(sum(ingest_walls), 1e-9), 1),
+            }
+        finally:
+            for d in daemons.values():
+                try:
+                    d.stop(commit=False)
+                except Exception:  # graftlint: disable=broad-except -- teardown best-effort; the round already passed/failed above
+                    pass
+            _shutil.rmtree(root, ignore_errors=True)
+
     def bench_schemes() -> dict:
         """Scheme-comparison round (the BENCH_r09 contract): every member
         of the kernel family over the same planted corpus — signature
@@ -1119,6 +1313,10 @@ def main() -> int:
     elif args.serve:
         serve_stats = bench_serve()
 
+    sharded_stats = {}
+    if args.serve_sharded:
+        sharded_stats = bench_serve_sharded()
+
     trace_stats = {}
     if args.traced:
         # Bounded deterministic-schedule sweep over the serve/store
@@ -1132,10 +1330,17 @@ def main() -> int:
         explored_store = trace_explore("store",
                                        n_seeded=max(10, n_sched // 2),
                                        exhaustive_bound=3)
+        total_explored = (explored["trace_schedules_explored"]
+                          + explored_store["trace_schedules_explored"])
+        if args.serve_sharded:
+            # Sharded-plane interleaving classes (router vs. shard
+            # writers; replica refresh vs. shard eviction).
+            for scn in ("router", "replica"):
+                total_explored += trace_explore(
+                    scn, n_seeded=max(10, n_sched // 2),
+                    exhaustive_bound=3)["trace_schedules_explored"]
         trace_stats = {
-            "trace_schedules_explored":
-                explored["trace_schedules_explored"]
-                + explored_store["trace_schedules_explored"],
+            "trace_schedules_explored": total_explored,
             "trace_races_found": trace_races,
         }
 
@@ -1189,6 +1394,7 @@ def main() -> int:
         result["wire_drift_bytes"] = wire_drift
     result.update(warm_stats)
     result.update(serve_stats)
+    result.update(sharded_stats)
     result.update(trace_stats)
     result.update(scheme_stats)
     result["scheme"] = params.scheme

@@ -196,6 +196,42 @@ def run_serve(args) -> int:
     return 0 if daemon._ingest_error is None else 1
 
 
+def run_shard(args) -> int:
+    """Digest-range shard daemon for the SHARDED serve chaos round: a
+    single-writer ServeDaemon over ``<root>/range_NNNN``, fenced by the
+    range's epoch lease (a respawned replacement claims the next epoch,
+    so a surviving zombie of this process would self-fence with zero
+    rows written), heartbeating for the router's PeerMonitor and
+    committing state every generation so the replacement preserves
+    local row identity for every acked batch.  The parent routes
+    through tse1m_tpu.serve.ShardRouter and SIGKILLs this process at
+    ``serve.ingest.commit`` via TSE1M_FAULT_PLAN."""
+    import os
+
+    from tse1m_tpu.cluster import ClusterParams
+    from tse1m_tpu.resilience.coordinator import (HeartbeatWriter,
+                                                  RangeLeaseGuard)
+    from tse1m_tpu.serve import ServeDaemon, ServeServer
+
+    params = ClusterParams(n_hashes=32, n_bands=4, use_pallas="never")
+    store = os.path.join(args.root, f"range_{args.range:04d}")
+    guard = RangeLeaseGuard.claim(args.root, args.range, owner=os.getpid())
+    heartbeat = HeartbeatWriter(args.root, process_id=args.range).start()
+    daemon = ServeDaemon(store, params=params, state_commit_every=1,
+                         lease_guard=guard).start()
+    server = ServeServer(daemon, port=0)
+    port_file = args.port_file or os.path.join(
+        args.root, f"serve_{args.range:04d}.port")
+    try:
+        server.serve_until_shutdown(port_file=port_file)
+    finally:
+        server.server_close()
+        daemon.stop()
+        heartbeat.stop()
+    print("SHARD_OK", flush=True)
+    return 0 if daemon._ingest_error is None else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -243,6 +279,12 @@ def main(argv=None) -> int:
     p.add_argument("--state-every", type=int, default=2)
     p.add_argument("--backlog", type=int, default=64)
     p.set_defaults(fn=run_serve)
+
+    p = sub.add_parser("shard")
+    p.add_argument("--root", required=True)
+    p.add_argument("--range", type=int, required=True)
+    p.add_argument("--port-file", default=None)
+    p.set_defaults(fn=run_shard)
 
     args = ap.parse_args(argv)
     return args.fn(args)

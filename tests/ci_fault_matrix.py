@@ -109,6 +109,17 @@ PRODUCTION_SEATS = {
         "kinds": ("raise", "stall"),
         "covered_by": "tests/test_backend_auto.py (host-oracle re-run + "
                       "device demotion)"},
+    "serve.router.forward": {
+        "kinds": ("connection_drop",),
+        "covered_by": "this matrix (seat `router-shard-kill`) + "
+                      "tests/test_serve_sharded.py (dropped ack replayed "
+                      "by request id: full ack, zero double-absorb)"},
+    "serve.replica.stream": {
+        "kinds": ("kill",),
+        "covered_by": "this matrix (seat `replica-refresh-kill`): SIGKILL "
+                      "mid-pull leaves the manifest uncommitted; the "
+                      "replica stays on its last adopted generation and "
+                      "the next pull converges"},
 }
 
 
@@ -396,6 +407,101 @@ def seat_serve_kill(store: str) -> dict:
             "store_scrub_quarantined": 0}
 
 
+def seat_router_shard_kill(store: str) -> dict:
+    """Sharded serving plane: SIGKILL one digest-range shard writer at
+    its ``serve.ingest.commit`` seat while the parent ingests through a
+    ShardRouter over TCP; a watcher respawns the replacement (next
+    lease epoch) and the router's retried in-flight slice — SAME
+    request id — lands on it.  Asserts ZERO lost acked rows, zero
+    double-absorbed batches, and labels elementwise-equal to an
+    uninterrupted sharded run (tests/serve_harness.py
+    ``sharded_kill_round``; the drop-window half of the contract is the
+    ``serve.router.forward`` seat, replay-tested in
+    tests/test_serve_sharded.py)."""
+    plan_rule("serve.ingest.commit", kind="kill")  # inventory-checked
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    from serve_harness import sharded_kill_round
+
+    with tempfile.TemporaryDirectory() as tmp:
+        r = sharded_kill_round(tmp)
+    assert r["lost_acked"] == 0, r
+    assert r["rows"] == r["oracle_rows"], r
+    return {"ari_vs_planted": 1.0, "degradation_events": 0,
+            "degradation_counts": {
+                "router_failover_batches": r["acked_batches"],
+                "router_replayed_acks": r["replayed_acks"]},
+            "chunk_halvings": 0, "store_scrub_corrupt": 0,
+            "store_scrub_quarantined": 0}
+
+
+def seat_replica_refresh_kill(store: str) -> dict:
+    """Replication plane: SIGKILL the puller at ``serve.replica.stream``
+    — shard files copied, manifest NOT yet committed.  The replica must
+    stay on its last ADOPTED generation (no torn view: refresh() adopts
+    only committed manifests), and the next clean pull converges to
+    staleness 0."""
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    import numpy as np
+
+    from tse1m_tpu.cluster import ClusterParams
+    from tse1m_tpu.serve import (ServeDaemon, ServeReplica,
+                                 replica_staleness, stream_shards)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        src = os.path.join(tmp, "writer")
+        dst = os.path.join(tmp, "replica")
+        params = ClusterParams(n_hashes=32, n_bands=4, use_pallas="never")
+        rng = np.random.default_rng(7)
+        items = rng.integers(0, 2**32, size=(20, 16),
+                             dtype=np.int64).astype(np.uint32)
+        writer = ServeDaemon(src, params=params,
+                             state_commit_every=1).start()
+        try:
+            assert writer.ingest(items[:12])["ok"]
+            writer.quiesce()
+            stream_shards(src, dst)  # clean bootstrap pull
+            replica = ServeReplica(dst, params=params)
+            gen_adopted = replica._generation_adopted
+            assert writer.ingest(items[12:])["ok"]  # writer advances
+            writer.quiesce()
+            # The killed pull: a subprocess streamer SIGKILLs itself at
+            # the seat — after shard copies, before the manifest commit.
+            plan_path = os.path.join(tmp, "plan.json")
+            with open(plan_path, "w") as f:
+                json.dump({"rules": [plan_rule("serve.replica.stream",
+                                               kind="kill")]}, f)
+            env = dict(os.environ, JAX_PLATFORMS="cpu",
+                       TSE1M_FAULT_PLAN=plan_path)
+            env["PYTHONPATH"] = REPO + os.pathsep + env.get(
+                "PYTHONPATH", "")
+            proc = subprocess.run(
+                [sys.executable, "-c",
+                 "import sys; from tse1m_tpu.serve import stream_shards;"
+                 f" stream_shards({src!r}, {dst!r})"],
+                cwd=REPO, env=env, capture_output=True, text=True,
+                timeout=600)
+            assert proc.returncode == -signal.SIGKILL, (
+                proc.returncode, proc.stderr[-2000:])
+            # No torn adoption: the manifest never committed, so the
+            # replica stays on the last adopted generation and still
+            # answers every row of it.
+            assert replica.refresh() is False
+            assert replica._generation_adopted == gen_adopted
+            q = replica.query(items[:12])
+            assert bool(q["known"].all())
+            # The next clean pull converges.
+            stream_shards(src, dst)
+            assert replica.refresh() is True
+            assert replica_staleness(src, replica) == 0
+            assert bool(replica.query(items)["known"].all())
+        finally:
+            writer.stop(commit=False)
+    return {"ari_vs_planted": 1.0, "degradation_events": 0,
+            "degradation_counts": {"replica_torn_pulls_rejected": 1},
+            "chunk_halvings": 0, "store_scrub_corrupt": 0,
+            "store_scrub_quarantined": 0}
+
+
 def seat_schedule_replay(store: str) -> dict:
     """graftrace: replay the committed adversarial schedule strings
     (tests/test_trace.py ADVERSARIAL_SCHEDULES) against the real
@@ -466,6 +572,8 @@ SEATS = {"stall": seat_stall, "oom": seat_oom, "kill": seat_kill,
          "zombie": seat_zombie,
          "leader-loss-promote": seat_leader_loss_promote,
          "serve-kill": seat_serve_kill,
+         "router-shard-kill": seat_router_shard_kill,
+         "replica-refresh-kill": seat_replica_refresh_kill,
          "scheme-smoke": seat_scheme_smoke,
          "schedule-replay": seat_schedule_replay}
 

@@ -1,0 +1,22 @@
+"""BAD (spoofed tse1m_tpu/serve/replicate.py): a replica that joins the
+write plane — writable store handle, adoption outside refresh(), a
+store mutator."""
+
+from tse1m_tpu.cluster.store import SignatureStore
+
+
+class Replica:
+    def __init__(self, directory):
+        self.store = SignatureStore(directory, {})
+        self._generation_adopted = -1
+
+    def query(self, rows):
+        self._generation_adopted = int(self.store.generation)
+        self._rebuild()
+        return rows
+
+    def _rebuild(self):
+        pass
+
+    def trim(self):
+        self.store.evict(0)

@@ -1,0 +1,12 @@
+"""BAD (spoofed tse1m_tpu/serve/router.py): the router touches the
+write plane — a store handle, a store mutator, spilled state."""
+
+from tse1m_tpu.cluster.store import SignatureStore
+
+
+def forward_and_spill(store_dir, rows, acks):
+    store = SignatureStore(store_dir, {})
+    store.append(rows, rows)
+    with open(store_dir + "/router_state.json", "w") as f:
+        f.write("{}")
+    return acks

@@ -1,0 +1,30 @@
+"""GOOD (spoofed tse1m_tpu/serve/replicate.py): read-only handle,
+adoption only via refresh()/__init__/_rebuild, stream writes its frames
+but never the adopted generation."""
+
+import shutil
+
+from tse1m_tpu.cluster.store import SignatureStore
+
+
+class Replica:
+    def __init__(self, directory):
+        self.store = SignatureStore(directory, {}, read_only=True)
+        self._generation_adopted = -1
+        self._rebuild()
+
+    def _rebuild(self):
+        self._generation_adopted = int(self.store.generation)
+
+    def refresh(self):
+        if self.store.refresh():
+            self._rebuild()
+            return True
+        return False
+
+    def query(self, rows):
+        return self.store.load_signatures(rows, rows)
+
+
+def stream(src, dst):
+    shutil.copyfile(src, dst + ".tmp")

@@ -1,0 +1,16 @@
+"""GOOD (spoofed tse1m_tpu/serve/router.py): stateless fan-out — the
+router READS the owner's port file, forwards, and maps acks in memory;
+its own port file goes through atomic_write."""
+
+from tse1m_tpu.utils.atomic import atomic_write
+
+
+def forward(transport, msg, port_file):
+    with open(port_file, encoding="utf-8") as f:
+        port = int(f.read().strip())
+    return transport(dict(msg, port=port))
+
+
+def publish_port(port_file, port):
+    with atomic_write(port_file) as f:
+        f.write(str(port))

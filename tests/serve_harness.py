@@ -147,4 +147,154 @@ def serve_kill_round(tmp: str, n: int = 900, batch: int = 100,
             "generation": int(status["generation"])}
 
 
-__all__ = ["SERVE_PARAMS", "serve_kill_round", "spawn_serve"]
+def spawn_shard(root: str, sid: int, plan_path: str | None = None,
+                timeout_s: float = 180.0) -> tuple:
+    """Start one digest-range shard daemon (chaos_drivers ``shard``);
+    returns (proc, port) once its ``serve_NNNN.port`` file lands."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("TSE1M_FAULT_PLAN", None)
+    if plan_path:
+        env["TSE1M_FAULT_PLAN"] = plan_path
+    port_file = os.path.join(root, f"serve_{sid:04d}.port")
+    if os.path.exists(port_file):  # never race a stale port
+        os.remove(port_file)
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "tests", "chaos_drivers.py"),
+         "shard", "--root", root, "--range", str(sid)],
+        env=env, cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if os.path.exists(port_file):
+            with open(port_file, encoding="utf-8") as f:
+                txt = f.read().strip()
+            if txt:
+                return proc, int(txt)
+        if proc.poll() is not None:
+            out, err = proc.communicate(timeout=10)
+            raise AssertionError(
+                f"shard {sid} died before binding (rc={proc.returncode})"
+                f"\n{err[-3000:]}")
+        time.sleep(0.05)
+    proc.kill()
+    raise AssertionError(f"shard {sid} never wrote its port file")
+
+
+def _oracle_sharded_run(items: "np.ndarray", batch: int,
+                        oracle_root: str) -> tuple:
+    """The uninterrupted ORACLE: the same batches through the same
+    router logic over in-process shard daemons (LocalTransport) — the
+    chaos run's post-recovery labels must equal these elementwise."""
+    from tse1m_tpu.cluster import ClusterParams
+    from tse1m_tpu.serve import LocalTransport, ServeDaemon, ShardRouter
+
+    params = ClusterParams(**SERVE_PARAMS)
+    daemons = {
+        sid: ServeDaemon(os.path.join(oracle_root, f"range_{sid:04d}"),
+                         params=params, state_commit_every=1).start()
+        for sid in range(2)}
+    try:
+        router = ShardRouter({sid: LocalTransport(d)
+                              for sid, d in daemons.items()})
+        for i, lo in enumerate(range(0, len(items), batch)):
+            r = router.ingest(items[lo:lo + batch], timeout=300,
+                              request_id=f"b{i:04d}")
+            assert r["ok"], r
+        router.quiesce(timeout=600)
+        final = router.query(items)
+        rows = sum(int(d._index.n_rows) for d in daemons.values())
+    finally:
+        for d in daemons.values():
+            d.stop(commit=False)
+    assert bool(final["known"].all())
+    return final["labels"], rows
+
+
+def sharded_kill_round(tmp: str, n: int = 600, batch: int = 100,
+                       kill_batch: int = 2, seed: int = 13) -> dict:
+    """The sharded-failover game-day, shared by pytest and the CI
+    fault-matrix ``router-shard-kill`` seat: SIGKILL shard 0 at its
+    ``serve.ingest.commit`` seat mid-round while the parent ingests
+    through a ShardRouter over TCP.  A watcher respawns the replacement
+    writer (which claims the range's next lease epoch); the router's
+    retried in-flight slice — SAME request id — lands on it, so the
+    round completes with ZERO lost acked rows, zero double-absorbed
+    batches, and post-recovery labels elementwise-equal to an
+    uninterrupted sharded run."""
+    import threading
+
+    from tse1m_tpu.data.synth import synth_session_sets
+    from tse1m_tpu.resilience import RetryPolicy
+    from tse1m_tpu.serve import ShardRouter, TcpTransport
+
+    items, _ = synth_session_sets(n, set_size=64, seed=seed)
+    oracle_labels, oracle_rows = _oracle_sharded_run(
+        items, batch, os.path.join(tmp, "oracle_root"))
+
+    root = os.path.join(tmp, "sharded_root")
+    os.makedirs(root, exist_ok=True)
+    plan_path = os.path.join(tmp, "plan.json")
+    with open(plan_path, "w", encoding="utf-8") as f:
+        json.dump({"rules": [{"site": "serve.ingest.commit",
+                              "kind": "kill",
+                              "after_calls": kill_batch}]}, f)
+    procs = {}
+    procs[0], _ = spawn_shard(root, 0, plan_path=plan_path)
+    procs[1], _ = spawn_shard(root, 1)
+    victim = procs[0]
+    respawned = {}
+
+    def watch_and_respawn() -> None:
+        victim.wait()
+        # The replacement writer claims epoch+1 on range 0; were the
+        # victim a wedged zombie instead of a corpse, its next commit
+        # would self-fence (coordinator.RangeLeaseGuard.verify).
+        respawned["proc"], respawned["port"] = spawn_shard(root, 0)
+
+    watcher = threading.Thread(target=watch_and_respawn, daemon=True)
+    watcher.start()
+    # The retry window must cover the replacement's cold start (a fresh
+    # interpreter importing jax) — an operator tunes exactly this knob.
+    router = ShardRouter(
+        {sid: TcpTransport(
+            port_file=os.path.join(root, f"serve_{sid:04d}.port"))
+         for sid in range(2)},
+        retry=RetryPolicy(max_attempts=60, base_delay=0.25, max_delay=3.0))
+    acks = []
+    try:
+        for i, lo in enumerate(range(0, n, batch)):
+            r = router.ingest(items[lo:lo + batch], timeout=300,
+                              request_id=f"b{i:04d}")
+            assert r["ok"], r
+            acks.append(r)
+        watcher.join(timeout=180)
+        assert not watcher.is_alive(), "watcher never saw the kill"
+        assert victim.returncode == -signal.SIGKILL, victim.returncode
+        # Durability: every acked row answers through the router.
+        final = router.query(items)
+        lost = int((~final["known"]).sum())
+        assert lost == 0, f"{lost} acked rows lost across the failover"
+        assert np.array_equal(final["labels"], oracle_labels), \
+            "post-failover labels diverged from the uninterrupted run"
+        router.quiesce(timeout=600)
+        status = router.status()
+        assert status["ok"], status
+        rows = sum(int(s["rows"]) for s in status["shard_status"].values())
+        # Zero double-absorb: the killed batch recomputed exactly once.
+        assert rows == oracle_rows, (rows, oracle_rows)
+    finally:
+        for proc in [procs[1], respawned.get("proc") or victim]:
+            if proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=60)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+    return {"lost_acked": 0, "rows": rows, "oracle_rows": oracle_rows,
+            "acked_batches": len(acks),
+            "replayed_acks": sum(1 for a in acks if a.get("replayed"))}
+
+
+__all__ = ["SERVE_PARAMS", "serve_kill_round", "sharded_kill_round",
+           "spawn_serve", "spawn_shard"]

@@ -81,6 +81,19 @@ def _rule_findings(rule: str, filename: str, relpath: str | None = None):
      "tse1m_tpu/observability/profiling.py"),
     ("watchdog-clock", "bad_serve_clock.py", "good_serve_clock.py",
      "tse1m_tpu/observability/regress.py"),
+    # Sharded serve: the new router/replica modules sit in the
+    # watchdog-clock plane wholesale (serve/ prefix)...
+    ("watchdog-clock", "bad_serve_clock.py", "good_serve_clock.py",
+     "tse1m_tpu/serve/router.py"),
+    ("watchdog-clock", "bad_serve_clock.py", "good_serve_clock.py",
+     "tse1m_tpu/serve/replicate.py"),
+    # ...and the write-plane split is its own rule: the router is
+    # stateless; a replica's store is read_only and its served view
+    # advances only through refresh().
+    ("serve-write-plane", "bad_router_write.py", "good_router_write.py",
+     "tse1m_tpu/serve/router.py"),
+    ("serve-write-plane", "bad_replica_adopt.py", "good_replica_adopt.py",
+     "tse1m_tpu/serve/replicate.py"),
 ])
 def test_rule_bad_fires_good_silent(rule, bad, good, spoof):
     assert _rule_findings(rule, bad, spoof), f"{rule} missed {bad}"
@@ -134,6 +147,28 @@ def test_prof_overhead_counts_and_kill_switch():
     assert len(found) == 3
     assert "daemon=True" in msgs
     assert "TSE1M_PROFILING" in msgs
+
+
+def test_serve_write_plane_counts_and_scope():
+    # Router fixture: store handle + store mutator + writable open = 3;
+    # replica fixture: writable handle + adoption assign + adoption
+    # call + store mutator = 4; outside the two modules the rule is
+    # silent (the writer daemon legitimately mutates its store).
+    router = _rule_findings("serve-write-plane", "bad_router_write.py",
+                            "tse1m_tpu/serve/router.py")
+    assert len(router) == 3
+    replica = _rule_findings("serve-write-plane", "bad_replica_adopt.py",
+                             "tse1m_tpu/serve/replicate.py")
+    assert len(replica) == 4
+    msgs = " | ".join(f.message for f in router + replica)
+    assert "STATELESS" in msgs and "read_only=True" in msgs
+    assert "refresh()" in msgs
+    for off_plane in ("tse1m_tpu/serve/daemon.py",
+                      "tse1m_tpu/cluster/store.py"):
+        assert not _rule_findings("serve-write-plane",
+                                  "bad_router_write.py", off_plane)
+        assert not _rule_findings("serve-write-plane",
+                                  "bad_replica_adopt.py", off_plane)
 
 
 def test_nondeterminism_scoped_to_replay_planes():

@@ -19,7 +19,7 @@ import os
 import subprocess
 import sys
 
-from serve_harness import REPO, serve_kill_round
+from serve_harness import REPO, serve_kill_round, sharded_kill_round
 
 
 def test_sigkill_mid_ingest_zero_lost_acked_rows(tmp_path):
@@ -27,6 +27,21 @@ def test_sigkill_mid_ingest_zero_lost_acked_rows(tmp_path):
     assert r["lost_acked"] == 0
     assert r["acked_before_kill"] == 300
     assert r["rows"] == 900
+
+
+def test_sharded_sigkill_midround_zero_lost_acks(tmp_path):
+    """The sharded failover game-day: SIGKILL shard 0 mid-ingest at its
+    ``serve.ingest.commit`` seat while the parent routes through a
+    ShardRouter over TCP; a watcher respawns the replacement writer
+    (next lease epoch) and the router's retried in-flight slice — same
+    request id — lands on it.  Zero lost acked rows, zero
+    double-absorbs, labels elementwise-equal to an uninterrupted
+    sharded run (serve_harness.sharded_kill_round; the CI fault-matrix
+    ``router-shard-kill`` seat runs the same round)."""
+    r = sharded_kill_round(str(tmp_path))
+    assert r["lost_acked"] == 0
+    assert r["rows"] == r["oracle_rows"]
+    assert r["acked_batches"] == 6
 
 
 def test_rss_bounded_under_sustained_ingest_with_lru(tmp_path):

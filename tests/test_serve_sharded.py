@@ -1,0 +1,295 @@
+"""Sharded serve plane (tse1m_tpu/serve/router.py + replicate.py):
+digest-range shard daemons behind the stateless fan-out router, and
+read replicas over CRC-framed shard streaming.
+
+The load-bearing claims:
+
+- the router speaks the single-daemon verbs unchanged and its
+  fan-out/min-merge partition over exact-duplicate corpora equals a
+  single daemon's elementwise (canonicalized);
+- an injected connection drop in the lost-ack window (fault seat
+  ``serve.router.forward``) is absorbed by the retried SAME request
+  id: the shard's journal replays the original ack — full ack, zero
+  double-absorbed rows, and the replay is visible in router status;
+- a superseded (fenced) shard writer appends ZERO rows: its next
+  commit observes the advanced lease epoch and latches instead of
+  writing;
+- a replica's staleness is exactly the writer generations it has not
+  pulled, drops to 0 after stream+refresh, and its store handle is
+  read-only — write-plane verbs refuse;
+- the graftrace schedule explorer drives >= 200 seeded PCT schedules
+  over the two NEW interleaving classes (router vs. shard writers;
+  replica refresh vs. writer eviction/stream) with zero races.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from tse1m_tpu.cluster import ClusterParams
+from tse1m_tpu.cluster.store import digest_range_ids, row_digests
+from tse1m_tpu.resilience.coordinator import RangeLeaseGuard
+from tse1m_tpu.resilience.faults import (FaultPlan, FaultRule, clear_plan,
+                                         install_plan)
+from tse1m_tpu.serve import (LocalTransport, ReplicationPuller, RouterServer,
+                             ServeClient, ServeDaemon, ServeReplica,
+                             ShardRouter, replica_staleness, stream_shards)
+from tse1m_tpu.trace.explore import explore
+
+PARAMS = ClusterParams(n_hashes=32, n_bands=4, use_pallas="never")
+N_SHARDS = 2
+
+
+def _unique_vectors(n: int, seed: int = 5, width: int = 16) -> np.ndarray:
+    """Content-distinct random coverage rows: no near-duplicates (random
+    32-bit elements never collide in a band), so the only cluster
+    structure is the EXACT duplicates a test plants — identical under
+    single-daemon and sharded routing (same digest -> same shard)."""
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 2**32, size=(n, width),
+                        dtype=np.int64).astype(np.uint32)
+
+
+def _canon(labels) -> list:
+    """First-occurrence canonical form: two label arrays describe the
+    same partition iff their canonical forms are equal elementwise."""
+    seen: dict = {}
+    return [seen.setdefault(int(v), len(seen)) for v in labels]
+
+
+def _start_shards(tmp_path, n_shards: int = N_SHARDS) -> dict:
+    return {sid: ServeDaemon(str(tmp_path / f"range_{sid:04d}"),
+                             params=PARAMS,
+                             state_commit_every=1).start()
+            for sid in range(n_shards)}
+
+
+def _stop_shards(daemons: dict) -> None:
+    for d in daemons.values():
+        d.stop(commit=False)
+
+
+# -- router fan-out / min-merge parity ---------------------------------------
+
+def test_router_partition_parity_vs_single_daemon(tmp_path):
+    base = _unique_vectors(40)
+    items = np.concatenate([base, base[[0, 3, 7, 3]]])  # planted exact dups
+    single = ServeDaemon(str(tmp_path / "single"), params=PARAMS).start()
+    daemons = _start_shards(tmp_path)
+    try:
+        router = ShardRouter({sid: LocalTransport(d)
+                              for sid, d in daemons.items()})
+        for lo in range(0, len(items), 16):
+            s = single.ingest(items[lo:lo + 16])
+            r = router.ingest(items[lo:lo + 16])
+            assert s["ok"] and r["ok"]
+            assert r["acked"] == s["acked"] == len(items[lo:lo + 16])
+        single.quiesce()
+        router.quiesce()
+        qs = single.query(items)
+        qr = router.query(items)
+        assert bool(qs["known"].all()) and bool(qr["known"].all())
+        assert _canon(qs["labels"]) == _canon(qr["labels"]), \
+            "router min-merge partition diverged from the single daemon"
+        # Both shards own part of the corpus (the parity is a fan-out
+        # parity, not a one-shard degenerate case).
+        owners = digest_range_ids(row_digests(items), N_SHARDS)
+        assert len(np.unique(owners)) == N_SHARDS
+        # Every ingested row is an index row; the STORE stays
+        # content-addressed — planted exact dups appended no signatures.
+        rows = sum(int(d._index.n_rows) for d in daemons.values())
+        assert rows == int(single._index.n_rows) == len(items)
+        store_rows = sum(int(d.store.n_rows) for d in daemons.values())
+        assert store_rows == int(single.store.n_rows) == len(base)
+    finally:
+        single.stop(commit=False)
+        _stop_shards(daemons)
+
+
+def test_router_forward_drop_replays_ack_idempotently(tmp_path):
+    """The lost-ack window: the shard committed and answered, the drop
+    eats the answer before the router passes it up.  The retried SAME
+    per-shard request id must be answered by the journal REPLAY — full
+    ack, zero rows double-absorbed."""
+    items = _unique_vectors(24, seed=9)
+    daemons = _start_shards(tmp_path)
+    try:
+        router = ShardRouter({sid: LocalTransport(d)
+                              for sid, d in daemons.items()})
+        install_plan(FaultPlan([FaultRule(site="serve.router.forward",
+                                          kind="connection_drop",
+                                          times=1)]))
+        try:
+            r = router.ingest(items, request_id="drop-regress")
+        finally:
+            clear_plan()
+        assert r["ok"] and r["acked"] == 24
+        assert r.get("replayed"), "dropped ack was not replayed"
+        rows = sum(int(d._index.n_rows) for d in daemons.values())
+        assert rows == 24, f"double-absorb: {rows} rows from 24 uniques"
+        q = router.query(items)
+        assert bool(q["known"].all())
+        st = router.status()
+        assert st["router_replayed_acks"] >= 1
+        assert st["router_rows"] == 24
+    finally:
+        _stop_shards(daemons)
+
+
+def test_serve_client_over_router_server_carries_request_id(tmp_path):
+    """The reconnect regression, end to end over TCP: a ServeClient
+    ingest through a RouterServer with a drop injected at
+    ``serve.router.forward`` still returns ONE full ack (the client's
+    minted request id rides the retry; the shard replays).  The client
+    code is byte-identical to the single-daemon topology."""
+    items = _unique_vectors(18, seed=21)
+    daemons = _start_shards(tmp_path)
+    router = ShardRouter({sid: LocalTransport(d)
+                          for sid, d in daemons.items()})
+    server = RouterServer(router, port=0)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        with ServeClient(port=server.port) as c:
+            assert c.ping()["ok"]
+            install_plan(FaultPlan([FaultRule(site="serve.router.forward",
+                                              kind="connection_drop",
+                                              times=1)]))
+            try:
+                r = c.ingest(items, timeout_s=120)
+            finally:
+                clear_plan()
+            assert r["ok"] and r["acked"] == 18
+            rows = sum(int(d._index.n_rows) for d in daemons.values())
+            assert rows == 18
+            q = c.query(items, timeout_s=60)
+            assert q["known"].all()
+            st = c.status()
+            assert st["topology"] == "sharded"
+            assert st["shards"] == N_SHARDS
+            assert c.quiesce(timeout_s=120)["ok"]
+    finally:
+        server.shutdown()
+        server.server_close()
+        _stop_shards(daemons)
+
+
+# -- lease fencing ------------------------------------------------------------
+
+def test_fenced_zombie_shard_writer_appends_zero_rows(tmp_path):
+    """A superseded writer's next commit observes the advanced epoch
+    and self-fences BEFORE the store append: zero rows written by the
+    zombie, and the replacement (owning the new epoch) absorbs the same
+    batch cleanly."""
+    root = str(tmp_path)
+    items = _unique_vectors(16, seed=33)
+    guard = RangeLeaseGuard.claim(root, 0, owner=111)
+    zombie = ServeDaemon(str(tmp_path / "range_0000"), params=PARAMS,
+                         state_commit_every=1, lease_guard=guard).start()
+    try:
+        assert zombie.ingest(items[:8])["ok"]
+        rows_before = int(zombie.store.n_rows)
+        # Failover: the replacement claims the next epoch on range 0.
+        replacement_guard = RangeLeaseGuard.claim(root, 0, owner=222)
+        with pytest.raises(Exception):  # noqa: B017, PT011 — ticket wraps LeaseSupersededError
+            zombie.ingest(items[8:], timeout=60)
+        assert int(zombie.store.n_rows) == rows_before, \
+            "fenced zombie writer appended rows"
+        assert zombie._ingest_error is not None
+    finally:
+        zombie.stop(commit=False)
+    replacement = ServeDaemon(str(tmp_path / "range_0000"), params=PARAMS,
+                              state_commit_every=1,
+                              lease_guard=replacement_guard).start()
+    try:
+        r = replacement.ingest(items[8:])
+        assert r["ok"] and r["acked"] == 8
+        assert bool(replacement.query(items)["known"].all())
+    finally:
+        replacement.stop(commit=False)
+
+
+# -- read replicas ------------------------------------------------------------
+
+def test_replica_staleness_bound_refresh_and_read_only(tmp_path):
+    items = _unique_vectors(30, seed=41)
+    src = str(tmp_path / "writer")
+    dst = str(tmp_path / "replica")
+    writer = ServeDaemon(src, params=PARAMS, state_commit_every=1).start()
+    try:
+        assert writer.ingest(items[:20])["ok"]
+        writer.quiesce()
+        stream_shards(src, dst)
+        replica = ServeReplica(dst, params=PARAMS)
+        assert replica_staleness(src, replica) == 0
+        q = replica.query(items[:20])
+        assert bool(q["known"].all())
+        # Replica answers agree with the writer's partition.
+        assert _canon(q["labels"]) == \
+            _canon(writer.query(items[:20])["labels"])
+        # Writer advances; the replica is STALE-BOUNDED, not wrong: old
+        # rows still answer, new rows unknown until the next pull.
+        assert writer.ingest(items[20:])["ok"]
+        writer.quiesce()
+        assert replica_staleness(src, replica) > 0
+        lagged = replica.query(items)
+        assert bool(lagged["known"][:20].all())
+        assert not bool(lagged["known"][20:].any())
+        stream_shards(src, dst)
+        assert replica.refresh()
+        assert replica_staleness(src, replica) == 0
+        fresh = replica.query(items)
+        assert bool(fresh["known"].all())
+        # Write plane is fenced by construction.
+        assert replica.read_only and replica.store.read_only
+        with pytest.raises(RuntimeError, match="read replica"):
+            replica.ingest(items[:1])
+        with pytest.raises(RuntimeError):
+            replica.quiesce()
+        st = replica.status()
+        assert st["read_only"] and st["generation_adopted"] >= 1
+    finally:
+        writer.stop(commit=False)
+
+
+def test_replication_puller_converges(tmp_path):
+    items = _unique_vectors(12, seed=55)
+    src = str(tmp_path / "writer")
+    dst = str(tmp_path / "replica")
+    writer = ServeDaemon(src, params=PARAMS, state_commit_every=1).start()
+    try:
+        assert writer.ingest(items)["ok"]
+        writer.quiesce()
+        stream_shards(src, dst)
+        replica = ServeReplica(dst, params=PARAMS)
+        puller = ReplicationPuller(src, replica, interval_s=0.05)
+        assert puller.pull_once() is False  # already fresh
+        assert writer.ingest(_unique_vectors(6, seed=56))["ok"]
+        writer.quiesce()
+        assert puller.pull_once() is True
+        assert replica_staleness(src, replica) == 0
+        assert puller.pulls == 2
+    finally:
+        writer.stop(commit=False)
+
+
+# -- the explorer over the new interleaving classes ---------------------------
+
+def test_explore_router_and_replica_200_seeded_schedules():
+    """The acceptance bar: >= 200 distinct seeded PCT schedules across
+    the two NEW interleaving classes — router fan-out vs. concurrent
+    shard writers (global label map, replay idempotence, zero
+    double-absorb) and replica refresh vs. writer eviction/stream
+    (committed-view adoption, generation monotonicity) — zero races."""
+    stats_r = explore("router", n_seeded=105, exhaustive_bound=3)
+    assert stats_r["trace_races_found"] == 0
+    stats_p = explore("replica", n_seeded=105, exhaustive_bound=3)
+    assert stats_p["trace_races_found"] == 0
+    total = (stats_r["trace_schedules_explored"]
+             + stats_p["trace_schedules_explored"])
+    assert total >= 200
+    assert (stats_r["trace_distinct_traces"]
+            + stats_p["trace_distinct_traces"]) >= 8

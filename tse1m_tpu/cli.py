@@ -815,6 +815,34 @@ def _cmd_serve(args) -> int:
         return 0 if rec.status == "ok" else 1
 
     store = args.sig_store or cfg.sig_store
+    guard = None
+    heartbeat = None
+    state_every = args.state_every
+    if getattr(args, "range", None) is not None:
+        # Shard-daemon mode: single writer over ONE digest range of a
+        # sharded serve root, fenced by an epoch lease (the router fans
+        # requests to it by digest prefix).
+        if not args.root:
+            log.error("--range needs --root <sharded serve root>")
+            return 2
+        from .resilience.coordinator import HeartbeatWriter, RangeLeaseGuard
+
+        store = os.path.join(args.root, f"range_{args.range:04d}")
+        guard = RangeLeaseGuard.claim(args.root, args.range,
+                                      owner=os.getpid())
+        # The router's PeerMonitor watches heartbeats keyed by range id.
+        heartbeat = HeartbeatWriter(args.root,
+                                    process_id=args.range).start()
+        if state_every is None:
+            # Routed shard writers commit state every generation so a
+            # replacement writer preserves local row identity for every
+            # acked batch (serve/router.py module docstring).
+            state_every = 1
+        if not args.port_file:
+            args.port_file = os.path.join(args.root,
+                                          f"serve_{args.range:04d}.port")
+    if state_every is None:
+        state_every = 8
     if not store:
         log.error("no signature store: pass --sig-store, or set "
                   "TSE1M_SIG_STORE / the INI's sig_store")
@@ -824,7 +852,8 @@ def _cmd_serve(args) -> int:
 
     params = ClusterParams(seed=args.seed, use_pallas=args.use_pallas)
     daemon = ServeDaemon(store, params=params, slo=SloPolicy.from_env(),
-                         state_commit_every=args.state_every).start()
+                         state_commit_every=state_every,
+                         lease_guard=guard).start()
     server = ServeServer(daemon, host=args.host, port=args.port)
 
     def _graceful(signum, frame):  # noqa: ARG001
@@ -836,7 +865,11 @@ def _cmd_serve(args) -> int:
         # reading a dead deployment's store directory.
         dump_flight("sigterm", site="serve.shutdown",
                     extra={"signal": int(signum)})
-        server.shutdown()
+        # shutdown() joins serve_forever, which runs in THIS thread —
+        # calling it inline from the handler would deadlock.
+        import threading
+
+        threading.Thread(target=server.shutdown, daemon=True).start()
 
     signal.signal(signal.SIGTERM, _graceful)
     signal.signal(signal.SIGINT, _graceful)
@@ -845,7 +878,92 @@ def _cmd_serve(args) -> int:
     finally:
         server.server_close()
         daemon.stop()
+        if heartbeat is not None:
+            heartbeat.stop()
     return 0 if daemon._ingest_error is None else 1
+
+
+def _cmd_serve_router(args) -> int:
+    """Fan-out router over N digest-range shard daemons (`tse1m
+    serve-router`).
+
+    Speaks the exact JSON-over-TCP verbs a single daemon does, so
+    `serve-client` works unchanged against it: ingest splits by digest
+    range and acks only after every owner's manifest commit (durable-
+    once, idempotent request ids survive a shard writer failover);
+    query broadcasts and min-merges labels.  Shard daemons are resolved
+    through their ``<root>/serve_NNNN.port`` files (the default a
+    ``serve --root R --range N`` daemon writes), re-read on every
+    reconnect — a replacement writer publishes itself by rewriting the
+    same file.  The router holds no durable state and never opens a
+    store directory (graftlint serve-write-plane)."""
+    import signal
+
+    from .resilience.coordinator import PeerMonitor
+    from .serve import RouterServer, ShardRouter, TcpTransport
+
+    transports = {
+        sid: TcpTransport(
+            host=args.shard_host,
+            port_file=os.path.join(args.root, f"serve_{sid:04d}.port"))
+        for sid in range(args.shards)}
+    monitor = PeerMonitor(args.root, n_processes=args.shards,
+                          process_id=-1,
+                          peers=list(range(args.shards)))
+    router = ShardRouter(transports, monitor=monitor)
+    server = RouterServer(router, host=args.host, port=args.port)
+
+    def _graceful(signum, frame):  # noqa: ARG001
+        log.warning("serve-router: signal %d; shutting down", signum)
+        import threading
+
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _graceful)
+    signal.signal(signal.SIGINT, _graceful)
+    try:
+        server.serve_until_shutdown(port_file=args.port_file)
+    finally:
+        server.server_close()
+    return 0
+
+
+def _cmd_serve_replica(args) -> int:
+    """Read replica over a streamed shard-store copy (`tse1m
+    serve-replica`).
+
+    Pulls the writer's committed shards + LSH state into ``--dir``
+    (CRC-framed file copy, manifest committed last), adopts each new
+    generation atomically, and serves ``query``/``status``/``ping``
+    over the same TCP protocol — write-plane verbs refuse with a
+    structured error.  Staleness is bounded by ``--interval``."""
+    import signal
+
+    from .cluster import ClusterParams
+    from .serve import (ReplicationPuller, ServeReplica, ServeServer,
+                        stream_shards)
+
+    stream_shards(args.src, args.dir)  # first pull before serving
+    params = ClusterParams(seed=args.seed, use_pallas="never")
+    replica = ServeReplica(args.dir, params=params)
+    puller = ReplicationPuller(args.src, replica,
+                               interval_s=args.interval).start()
+    server = ServeServer(replica, host=args.host, port=args.port)
+
+    def _graceful(signum, frame):  # noqa: ARG001
+        log.warning("serve-replica: signal %d; shutting down", signum)
+        import threading
+
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _graceful)
+    signal.signal(signal.SIGINT, _graceful)
+    try:
+        server.serve_until_shutdown(port_file=args.port_file)
+    finally:
+        server.server_close()
+        puller.stop()
+    return 0
 
 
 def _cmd_serve_client(args) -> int:
@@ -986,10 +1104,19 @@ def main(argv=None) -> int:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--use-pallas", default="auto",
                    choices=("auto", "never", "force", "interpret"))
-    p.add_argument("--state-every", type=int, default=8,
+    p.add_argument("--state-every", type=int, default=None,
                    help="commit the LSH state to the store every N ingest "
                         "generations (acks are durable regardless; this "
-                        "bounds recovery work after a crash)")
+                        "bounds recovery work after a crash); default 8, "
+                        "or 1 in shard mode (--range) so the router can "
+                        "rely on committed local row ids")
+    p.add_argument("--root", default=None,
+                   help="sharded serve root (shard mode; with --range)")
+    p.add_argument("--range", type=int, default=None,
+                   help="digest range this daemon owns as single writer "
+                        "(shard mode: serves <root>/range_NNNN, claims "
+                        "the range's epoch lease, writes a heartbeat and "
+                        "defaults --port-file to <root>/serve_NNNN.port)")
     p.add_argument("--status", action="store_true",
                    help="client mode: print a running daemon's status "
                         "(index generation, rows, queue depth + backlog "
@@ -1016,6 +1143,38 @@ def main(argv=None) -> int:
                    help="profile: also write profile_NNN.json daemon-side "
                         "and return its path")
     p.set_defaults(fn=_cmd_serve_client)
+
+    p = sub.add_parser("serve-router",
+                       help="stateless fan-out router over digest-range "
+                            "shard daemons (README 'Sharded serving'); "
+                            "serve-client works unchanged against it")
+    p.add_argument("--root", required=True,
+                   help="sharded serve root holding the shards' "
+                        "serve_NNNN.port files and heartbeats")
+    p.add_argument("--shards", type=int, default=2,
+                   help="number of digest-range shard daemons")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--shard-host", default="127.0.0.1",
+                   help="host the shard daemons listen on")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--port-file", default=None)
+    p.set_defaults(fn=_cmd_serve_router)
+
+    p = sub.add_parser("serve-replica",
+                       help="read replica over a streamed store copy "
+                            "(stale-bounded query/status; writes refuse)")
+    p.add_argument("--src", required=True,
+                   help="writer store directory to stream shards from")
+    p.add_argument("--dir", required=True,
+                   help="replica store directory (created/refreshed)")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="seconds between replication pulls (staleness "
+                        "bound)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--port-file", default=None)
+    p.set_defaults(fn=_cmd_serve_replica)
 
     p = sub.add_parser("cluster", help="MinHash+LSH session dedup demo")
     p.add_argument("--n", type=int, default=100_000)

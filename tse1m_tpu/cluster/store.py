@@ -90,6 +90,10 @@ log = get_logger("cluster.store")
 _MANIFEST = "store_manifest.json"
 _STATE = "state.json"
 _QUARANTINE_DIR = "quarantine"
+# Serving idempotency journal bound: retries arrive within one client
+# retry window, so a small LRU of recent request ids suffices — the
+# oldest entries age out with each append's manifest commit.
+_JOURNAL_MAX = 128
 
 # The policy tuple: any of these changing invalidates every stored
 # signature (different hash family / universe), so it is THE manifest key.
@@ -304,6 +308,12 @@ class SignatureStore:
         self._key_mmaps: dict[int, np.ndarray] = {}
         # Shards quarantined while opening THIS instance (scrub reports).
         self.quarantined_at_open: list[dict] = []
+        # Serving-plane idempotency journal: request id -> the original
+        # ack fields, committed with the SAME manifest write as the
+        # shard append it describes — a retried ingest whose first
+        # attempt already committed replays its ack instead of
+        # re-absorbing (durable-once semantics across a writer restart).
+        self.serve_journal: dict[str, dict] = {}
         prior = self._load_json(self._manifest_path)
         # Pre-scheme manifest: normalization defaults it to kminhash; a
         # writable open heals the manifest once so every committed
@@ -325,6 +335,9 @@ class SignatureStore:
             self.shards = [dict(s) for s in prior.get("shards", [])]
             self._probe_gen = int(prior.get("probe_gen", 0))
             self.generation = int(prior.get("generation", 0))
+            self.serve_journal = {
+                str(k): dict(v)
+                for k, v in prior.get("serve_journal", {}).items()}
             if prior.get("crc_algo", _CRC_ALGO) != _CRC_ALGO:
                 if self.read_only:
                     # Cannot re-frame another host's shards; skip frame
@@ -411,11 +424,16 @@ class SignatureStore:
         if fp != self._committed_fp:
             self.generation += 1
             self._committed_fp = fp
+        payload = {"policy": self.policy, "crc_algo": _CRC_ALGO,
+                   "probe_gen": self._probe_gen,
+                   "generation": self.generation,
+                   "shards": self.shards}
+        if self.serve_journal:
+            # Only when non-empty, so batch-plane manifests stay
+            # byte-identical to the pre-journal format.
+            payload["serve_journal"] = self.serve_journal
         with atomic_write(self._manifest_path) as f:
-            json.dump({"policy": self.policy, "crc_algo": _CRC_ALGO,
-                       "probe_gen": self._probe_gen,
-                       "generation": self.generation,
-                       "shards": self.shards}, f)
+            json.dump(payload, f)
 
     def _reframe_all(self) -> None:
         """Recompute every frame under the current CRC algo (a store
@@ -858,6 +876,16 @@ class SignatureStore:
         return out
 
     # -- append -------------------------------------------------------------
+
+    def journal_record(self, request_id: str, entry: dict) -> None:
+        """Stage one serving ack under ``request_id`` so the NEXT
+        manifest write (normally the append commit the ack describes)
+        makes it durable atomically with the rows themselves.  Bounded:
+        the oldest entries age out past ``_JOURNAL_MAX``."""
+        self._require_writable("journal_record")
+        self.serve_journal[str(request_id)] = dict(entry)
+        while len(self.serve_journal) > _JOURNAL_MAX:
+            self.serve_journal.pop(next(iter(self.serve_journal)))
 
     def append(self, digests: np.ndarray, sigs: np.ndarray) -> int:
         """Append (digest, signature) rows not already stored; returns the

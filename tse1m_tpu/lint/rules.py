@@ -896,6 +896,115 @@ def prof_overhead(src: FileSource) -> list[Finding]:
     return out
 
 
+# -- 13. serve-write-plane (sharded serve topology) ---------------------------
+#
+# The sharded serve plane's zero-lost-ack proof is only auditable if the
+# write plane has exactly one kind of inhabitant: the shard writer
+# daemon.  Two path-scoped checks hold that shape (sharded-serve PR):
+#
+# (a) the ROUTER (serve/router.py) is STATELESS — it never constructs a
+#     ``SignatureStore``, never calls a store mutator, and never opens a
+#     file writable.  A router that spills state grows a second
+#     durability seat the failover contract does not cover: an ingest is
+#     acked iff the OWNING SHARD's manifest committed, and the router
+#     must be killable/replaceable at any instant with no recovery step.
+#     (The one file a router writes — its own port file — goes through
+#     ``utils.atomic.atomic_write``, which this rule does not flag.)
+#
+# (b) a READ REPLICA (serve/replicate.py) joins the read plane only: its
+#     store handle is constructed with a literal ``read_only=True``, it
+#     never calls a store mutator, and the served view advances ONLY
+#     through the adoption path — ``refresh()`` and the ``__init__``/
+#     ``_rebuild`` seats it drives.  An adoption write anywhere else
+#     (a ``_generation_adopted`` assignment or a ``_rebuild()`` call in
+#     query/status/ad-hoc code) could publish a generation whose
+#     manifest has not committed, turning a bounded-STALENESS replica
+#     into a torn-VIEW one.  (Writable ``open()`` is legal here: the
+#     shard streamer legitimately copies frames into the replica's
+#     directory — CRC-verified, manifest committed last.)
+
+_ROUTER_WRITE_PLANE = ("tse1m_tpu/serve/router.py",)
+_REPLICA_WRITE_PLANE = ("tse1m_tpu/serve/replicate.py",)
+_STORE_MUTATORS = {"append", "save_state", "journal_record", "commit_state",
+                   "evict", "scrub", "quarantine", "compact"}
+_ADOPTION_SEATS = {"__init__", "_rebuild", "refresh"}
+
+
+def serve_write_plane(src: FileSource) -> list[Finding]:
+    in_router = src.path in _ROUTER_WRITE_PLANE
+    in_replica = src.path in _REPLICA_WRITE_PLANE
+    if not (in_router or in_replica):
+        return []
+    out = []
+    parents = _parents(src.tree)
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Call):
+            name = _dotted(node.func)
+            leaf = name.rsplit(".", 1)[-1]
+            recv = name.rsplit(".", 1)[0] if "." in name else ""
+            if leaf == "SignatureStore":
+                if in_router:
+                    out.append(_f(src, node,
+                                  "the router opens a store — the router is "
+                                  "STATELESS: durability lives entirely at "
+                                  "the shard writers (an ack is durable iff "
+                                  "the owning shard's manifest committed), "
+                                  "and a router-side store is a durability "
+                                  "seat the failover proof does not cover"))
+                elif not any(kw.arg == "read_only"
+                             and isinstance(kw.value, ast.Constant)
+                             and kw.value.value is True
+                             for kw in node.keywords):
+                    out.append(_f(src, node,
+                                  "replica store handle without a literal "
+                                  "`read_only=True` — a replica is excluded "
+                                  "from the write plane BY CONSTRUCTION; a "
+                                  "writable handle here could append to a "
+                                  "range the lease plane dealt to a writer"))
+            elif leaf in _STORE_MUTATORS and "store" in recv.lower():
+                out.append(_f(src, node,
+                              f"store mutator `{name}()` in the "
+                              f"{'router' if in_router else 'replica'} — "
+                              "only the range's single writer daemon may "
+                              "mutate store state; the read plane serves "
+                              "streamed committed generations only"))
+            elif in_router and _open_write_mode(node):
+                out.append(_f(src, node,
+                              "writable `open()` in the router — the router "
+                              "holds no durable state (its port file goes "
+                              "through utils.atomic.atomic_write); spilled "
+                              "router state breaks the kill-anytime "
+                              "failover contract"))
+            elif in_replica and leaf == "_rebuild":
+                fn = _enclosing_function(node, parents)
+                if fn is None or fn.name not in _ADOPTION_SEATS:
+                    out.append(_f(src, node,
+                                  "`_rebuild()` outside the adoption path — "
+                                  "replica state advances only via "
+                                  "refresh() (or __init__), after the "
+                                  "streamed manifest committed; adopting "
+                                  "elsewhere can publish a torn view"))
+        elif in_replica and isinstance(node, (ast.Assign, ast.AugAssign,
+                                              ast.AnnAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            if any((isinstance(t, ast.Attribute)
+                    and t.attr == "_generation_adopted")
+                   or (isinstance(t, ast.Name)
+                       and t.id == "_generation_adopted")
+                   for t in targets):
+                fn = _enclosing_function(node, parents)
+                if fn is None or fn.name not in _ADOPTION_SEATS:
+                    out.append(_f(src, node,
+                                  "`_generation_adopted` assigned outside "
+                                  "refresh()/__init__/_rebuild — the "
+                                  "adopted generation may only move when "
+                                  "every file its manifest references is "
+                                  "in place (the stream commits the "
+                                  "manifest LAST)"))
+    return out
+
+
 RULES = {
     "broad-except": broad_except,
     "nonatomic-write": nonatomic_write,
@@ -909,6 +1018,7 @@ RULES = {
     "watchdog-clock": watchdog_clock,
     "span-discipline": span_discipline,
     "prof-overhead": prof_overhead,
+    "serve-write-plane": serve_write_plane,
 }
 
 __all__ = ["RULES"]

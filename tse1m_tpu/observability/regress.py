@@ -169,6 +169,17 @@ BENCH_SCHEMA: dict = {
                                 desc="per-site lock-wait p99 table"),
     "serve_slow_requests": _k(("serve",), "lower",
                               desc="slow-request captures in round"),
+    # sharded serve plane (this PR)
+    "serve_shards": _k(("serve",), desc="shard daemons behind the router"),
+    "serve_router_p99_ms": _k(("serve",), "lower", gate=True, tol=1.0,
+                              abs_slack=1.0,
+                              desc="router fan-out query p99"),
+    "serve_replica_qps": _k(("serve",), "higher",
+                            desc="read-replica sustained query rate"),
+    "serve_failover_lost_acks": _k(("serve",), "lower", gate=True,
+                                   tol=0.0, abs_slack=0.0,
+                                   desc="acked rows lost across a shard "
+                                        "writer failover (must be 0)"),
 }
 
 

@@ -269,11 +269,18 @@ class PeerMonitor:
     regressed seq under the current nonce, never counts as an advance."""
 
     def __init__(self, directory: str, n_processes: int, process_id: int,
-                 timeout_s: float | None = None) -> None:
+                 timeout_s: float | None = None,
+                 peers: list | None = None) -> None:
         self.directory = directory
         self.process_id = int(process_id)
-        self.peers = [p for p in range(int(n_processes))
-                      if p != self.process_id]
+        # ``peers`` overrides the dense 0..n_processes-1 assumption for
+        # asymmetric topologies — e.g. a serve router (not itself a
+        # shard writer) watching the shard daemons' heartbeat ids.
+        self.peers = (sorted(int(p) for p in peers
+                             if int(p) != self.process_id)
+                      if peers is not None
+                      else [p for p in range(int(n_processes))
+                            if p != self.process_id])
         self.timeout_s = (heartbeat_timeout_s()
                           if timeout_s is None else float(timeout_s))
         now = deadline_clock()
@@ -623,6 +630,52 @@ def verify_lease(root: str, range_id: int, epoch: int, owner: int,
         raise LeaseSupersededError(range_id, held, cur)
 
 
+class RangeLeaseGuard:
+    """One shard writer's proof of tenure over one digest range — the
+    serving plane's handle on the batch plane's epoch-lease fencing.
+
+    Constructed by :meth:`claim` (failover: advance the epoch, fencing
+    whatever writer held the range) or :meth:`acquire` (bootstrap under
+    a membership-ledger deal at the ledger's epoch).  ``verify`` is the
+    per-durability-point check the shard ``ServeDaemon`` calls between
+    its commit fault seat and the store append: a superseded writer
+    raises :class:`LeaseSupersededError` there with zero rows written."""
+
+    def __init__(self, root: str, range_id: int, epoch: int, owner: int,
+                 nonce: str) -> None:
+        self.root = root
+        self.range_id = int(range_id)
+        self.epoch = int(epoch)
+        self.owner = int(owner)
+        self.nonce = str(nonce)
+
+    @classmethod
+    def claim(cls, root: str, range_id: int, owner: int,
+              nonce: str | None = None) -> "RangeLeaseGuard":
+        """Advance-then-acquire: take the range at the epoch AFTER the
+        on-disk lease's — the replacement writer's seat.  The epoch bump
+        is itself the fence: the superseded holder's next ``verify``
+        sees a later epoch and self-fences."""
+        nonce = nonce if nonce is not None else os.urandom(8).hex()
+        cur = read_lease(root, range_id)
+        epoch = (int(cur["epoch"]) + 1) if cur is not None else 1
+        acquire_lease(root, range_id, epoch, owner, nonce)
+        return cls(root, range_id, epoch, owner, nonce)
+
+    @classmethod
+    def acquire(cls, root: str, range_id: int, epoch: int, owner: int,
+                nonce: str) -> "RangeLeaseGuard":
+        """Bootstrap under a :class:`MembershipLedger` deal: take the
+        range at the ledger's epoch (raises if a later epoch already
+        owns it — this process is the zombie)."""
+        acquire_lease(root, range_id, epoch, owner, nonce)
+        return cls(root, range_id, epoch, owner, nonce)
+
+    def verify(self) -> None:
+        verify_lease(self.root, self.range_id, self.epoch, self.owner,
+                     self.nonce)
+
+
 # -- membership ledger -------------------------------------------------------
 
 
@@ -773,6 +826,7 @@ class MembershipLedger:
 
 __all__ = ["HeartbeatWriter", "HostLostError", "LeaseSupersededError",
            "MembershipLedger", "PeerMonitor", "PodSupervisor",
+           "RangeLeaseGuard",
            "acquire_lease", "exchange_dir", "hard_exit_if_host_lost",
            "heartbeat_interval_s", "heartbeat_path", "heartbeat_timeout_s",
            "lease_path", "negotiate_run_nonce", "read_lease",

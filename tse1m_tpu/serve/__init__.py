@@ -7,13 +7,25 @@ atomically swapped `cluster.incremental.LiveClusterIndex` snapshots, an
 SLO/admission layer (`slo`), and a tiny JSON-over-TCP transport
 (`server`/`client`).  `cli serve` runs it; batch `cli cluster` shares
 the same index code — one merge implementation for both shapes.
+
+Fleet scale: `router.ShardRouter` fans the same verbs over N digest-
+range shard daemons (each a single-writer `ServeDaemon` over one
+``range_NNNN/`` slice, fenced by an epoch lease) with durable-once
+ingest acks, and `replicate.ServeReplica` serves stale-bounded reads
+from a streamed store copy — `ServeClient` works unchanged against
+any of the three topologies.
 """
 
 from .client import Backpressure, ServeClient, ServeError
 from .daemon import IngestRejected, ServeDaemon
+from .replicate import (ReplicationPuller, ServeReplica, replica_staleness,
+                        stream_shards)
+from .router import LocalTransport, RouterServer, ShardRouter, TcpTransport
 from .server import ServeServer
 from .slo import AdmissionController, SloPolicy, SloTracker
 
 __all__ = ["AdmissionController", "Backpressure", "IngestRejected",
-           "ServeClient", "ServeDaemon", "ServeError", "ServeServer",
-           "SloPolicy", "SloTracker"]
+           "LocalTransport", "ReplicationPuller", "RouterServer",
+           "ServeClient", "ServeDaemon", "ServeError", "ServeReplica",
+           "ServeServer", "ShardRouter", "SloPolicy", "SloTracker",
+           "TcpTransport", "replica_staleness", "stream_shards"]

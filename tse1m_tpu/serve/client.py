@@ -12,6 +12,7 @@ after a reconnect instead of failing the caller's first attempt.
 
 from __future__ import annotations
 
+import os
 import socket
 
 import numpy as np
@@ -128,14 +129,23 @@ class ServeClient:
         return resp
 
     def ingest(self, vectors: np.ndarray,
-               timeout_s: float | None = None) -> dict:
+               timeout_s: float | None = None,
+               request_id: str | None = None) -> dict:
         """Durable ingest: the response means every row is committed to
         the store (SIGKILL after this returns loses nothing).  Raises
         :class:`Backpressure` under admission control — the caller owns
-        the backoff (it knows whether the batch is droppable)."""
+        the backoff (it knows whether the batch is droppable).
+
+        Idempotent end to end: ONE request id is minted per logical
+        call and rides every retry of it, so a reconnect after the
+        server committed-but-did-not-answer replays the original ack
+        server-side instead of re-absorbing the batch (the pre-fix
+        failure mode: the pinned connection's in-flight ingest was
+        re-sent as a NEW request after a server restart)."""
         return self.request(
             "ingest",
             timeout_s=timeout_s or request_budget_s("ingest") or None,
+            request_id=request_id or os.urandom(8).hex(),
             **encode_vectors(vectors))
 
     def metrics(self) -> dict:

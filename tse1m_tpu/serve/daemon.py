@@ -43,7 +43,7 @@ import threading
 import numpy as np
 
 from ..cluster.host import host_band_keys
-from ..cluster.incremental import LiveClusterIndex
+from ..cluster.incremental import LiveClusterIndex, _delta_max_runs
 from ..cluster.pipeline import (ClusterParams, _store_policy,
                                 minhash_novel_rows)
 from ..cluster.schemes import make_params, scheme_host_signatures
@@ -56,6 +56,7 @@ from ..observability.flight import dump_flight, get_flight_dir, set_flight_dir
 from ..observability.latency import LatencyRecorder
 from ..observability.tracing import continue_trace, current_trace, span
 from ..resilience import (StageWatchdog, fault_point, reraise_if_fault)
+from ..resilience.coordinator import LeaseSupersededError
 from ..resilience.watchdog import deadline_clock
 from ..trace.hooks import shared_access, trace_point
 from ..utils.logging import get_logger
@@ -79,11 +80,14 @@ class IngestRejected(RuntimeError):
 
 
 class _Ticket:
-    __slots__ = ("items", "op", "event", "result", "error", "trace")
+    __slots__ = ("items", "op", "event", "result", "error", "trace",
+                 "request_id")
 
-    def __init__(self, items=None, op: str = "ingest") -> None:
+    def __init__(self, items=None, op: str = "ingest",
+                 request_id: str | None = None) -> None:
         self.items = items
         self.op = op
+        self.request_id = request_id
         self.event = threading.Event()
         self.result: dict | None = None
         self.error: BaseException | None = None
@@ -133,7 +137,8 @@ class ServeDaemon:
                  params: ClusterParams | None = None,
                  slo: SloPolicy | None = None,
                  state_commit_every: int = 8,
-                 signer: str = "device") -> None:
+                 signer: str = "device",
+                 lease_guard=None) -> None:
         from ..cluster.store import ShardedSignatureStore
 
         if signer not in ("device", "host"):
@@ -148,7 +153,19 @@ class ServeDaemon:
         self.params = params or ClusterParams()
         self.signer = signer
         self.slo = slo or SloPolicy.from_env()
+        # Single-writer fencing for the sharded plane: when this daemon
+        # serves one digest range of a pod root, the guard proves epoch
+        # tenure at every durability point — a superseded (zombie)
+        # writer self-fences with zero rows written.
+        self.lease_guard = lease_guard
         self.state_commit_every = max(1, int(state_commit_every))
+        if self.slo.live_delta_runs is not None:
+            # The LSM delta-run bound is read by the index at absorb
+            # time; the policy field is the serving-plane surface for it.
+            import os
+
+            os.environ["TSE1M_LIVE_DELTA_RUNS"] = str(
+                int(self.slo.live_delta_runs))
         policy = self._resolve_policy(store_dir)
         self.qbits = int(policy["quant_bits"])
         # The store's scheme WINS (serving must answer in the kernel
@@ -336,6 +353,8 @@ class ServeDaemon:
         index = self._index
         if index.n_rows == 0:
             return
+        if self.lease_guard is not None:
+            self.lease_guard.verify()
         self.store.save_state(
             index.labels, index.locator,
             index.band_tables(),
@@ -345,10 +364,15 @@ class ServeDaemon:
 
     # -- ingest --------------------------------------------------------------
 
-    def submit(self, items: np.ndarray) -> _Ticket:
+    def submit(self, items: np.ndarray,
+               request_id: str | None = None) -> _Ticket:
         """Admission-checked enqueue; raises IngestRejected under
         backpressure.  The returned ticket's ``wait()`` blocks until the
-        batch is durably acknowledged (store append committed)."""
+        batch is durably acknowledged (store append committed).
+
+        ``request_id`` makes the batch idempotent: a retry carrying the
+        id of an ingest that already committed replays the original ack
+        (journal consult in ``_ingest_batch``) instead of re-absorbing."""
         if self._ingest_error is not None:
             raise RuntimeError("serve ingest loop is down") \
                 from self._ingest_error
@@ -357,14 +381,16 @@ class ServeDaemon:
         admitted, retry_after = self.admission.try_admit(depth)
         if not admitted:
             raise IngestRejected(depth, retry_after)
-        t = _Ticket(np.ascontiguousarray(items, np.uint32))
+        t = _Ticket(np.ascontiguousarray(items, np.uint32),
+                    request_id=request_id)
         trace_point("serve.queue.put")
         self._q.put(t)
         return t
 
     def ingest(self, items: np.ndarray,
-               timeout: float | None = None) -> dict:
-        return self.submit(items).wait(timeout)
+               timeout: float | None = None,
+               request_id: str | None = None) -> dict:
+        return self.submit(items, request_id=request_id).wait(timeout)
 
     def _ingest_loop(self) -> None:
         while not self._stop.is_set():
@@ -385,7 +411,8 @@ class ServeDaemon:
                                   rows=int(t.items.shape[0])):
                             ti = deadline_clock()
                             with self.lat_ingest.time():
-                                t.done(self._ingest_batch(t.items))
+                                t.done(self._ingest_batch(
+                                    t.items, request_id=t.request_id))
                             wall_i = deadline_clock() - ti
                             if wall_i > self.slo.ingest_budget_s > 0:
                                 profiling.capture_slow_request(
@@ -406,6 +433,16 @@ class ServeDaemon:
                     dump_flight("serve.ingest_crash", site="serve.ingest",
                                 extra={"error": f"{type(e).__name__}: {e}"})
                     raise
+                if isinstance(e, LeaseSupersededError):
+                    # Self-fence: this writer's digest range was re-dealt
+                    # (the verify fired BEFORE the append, so zero rows
+                    # were written).  Latch the error — further submits
+                    # are refused — but keep the thread alive so the
+                    # read-only query path drains gracefully.
+                    self._ingest_error = e
+                    log.error("serve: shard writer fenced (%s); ingest "
+                              "refused from here on", e)
+                    continue
                 if isinstance(e, (KeyboardInterrupt, SystemExit)):
                     self._ingest_error = e
                     dump_flight("serve.ingest_exit", site="serve.ingest",
@@ -417,7 +454,32 @@ class ServeDaemon:
                 self._busy = False
                 self._inflight = {}
 
-    def _ingest_batch(self, items: np.ndarray) -> dict:
+    def _replay_ack(self, request_id: str, items: np.ndarray) -> dict:
+        """The idempotent-retry answer: this request id already committed
+        (its journal entry rode the append's manifest write), so the
+        rows are in the index — answer from there instead of absorbing a
+        second copy.  Row ids come from the digest map (for a batch that
+        crossed a writer restart they are the surviving first-occurrence
+        rows, which min-merge correctly router-side)."""
+        entry = self.store.serve_journal[request_id]
+        index = self._index
+        digests = row_digests(items)
+        hit, row = index.lookup_digests(digests)
+        labels = np.full(int(items.shape[0]), -1, np.int64)
+        labels[hit] = index.labels[row[hit]].astype(np.int64)
+        record_degradation(
+            "serve_ingest_replayed", site="serve.ingest",
+            detail={"request_id": request_id,
+                    "acked": int(entry.get("acked", 0))})
+        return {"ok": True, "acked": int(entry.get("acked", 0)),
+                "novel": int(entry.get("novel", 0)),
+                "generation": index.generation,
+                "labels": labels.astype(int).tolist(),
+                "rows": row.astype(int).tolist(),
+                "replayed": True}
+
+    def _ingest_batch(self, items: np.ndarray,
+                      request_id: str | None = None) -> dict:
         """One acknowledged batch: EVERY row becomes a new index row (the
         batch pipeline's label space keeps content-duplicate sessions as
         distinct rows, and post-quiesce parity is elementwise against
@@ -427,6 +489,8 @@ class ServeDaemon:
         k = int(items.shape[0])
         self._inflight = {"site": "serve.ingest.batch", "rows": k,
                           "since_s": round(deadline_clock(), 3)}
+        if request_id is not None and request_id in self.store.serve_journal:
+            return self._replay_ack(request_id, items)
         index = self._index
         n_old = index.n_rows
         if k == 0:
@@ -447,6 +511,16 @@ class ServeDaemon:
         # (tmp+rename shard + manifest) has happened — a SIGKILL anywhere
         # after it loses zero acknowledged rows.
         fault_point("serve.ingest.commit")
+        if self.lease_guard is not None:
+            # Fence point: tenure is proven AFTER the durability seat and
+            # BEFORE the append — a superseded writer raises here with
+            # zero rows written to the re-dealt range.
+            self.lease_guard.verify()
+        if request_id is not None:
+            # Staged under the id so the append's manifest write commits
+            # the ack atomically with the rows it acknowledges.
+            self.store.journal_record(request_id,
+                                      {"acked": k, "novel": novel})
         self.store.append(digests[miss], sigs[miss])
         _, sh2, rw2 = self.store.bulk_probe(digests)
         locator = np.stack([sh2, rw2], axis=1).astype(np.int32)
@@ -564,6 +638,10 @@ class ServeDaemon:
             "lock_wait_top": profiling.lock_wait_summary(top=3),
             "last_scrub": dict(self.last_scrub),
             "policy": dict(self.store.policy),
+            # The LSM consolidation bound actually in effect (SloPolicy
+            # live_delta_runs / TSE1M_LIVE_DELTA_RUNS): the p99 tuning
+            # knob the pre-split measurement round surfaces.
+            "live_delta_runs": _delta_max_runs(),
             **self.admission.stats(),
             **self.tracker.stats(),
             **self.lat_query.summary(),

@@ -1,0 +1,459 @@
+"""Stateless fan-out router for the sharded serve plane.
+
+One router process fronts N shard daemons — each a single-writer
+`ServeDaemon` over its ``range_NNNN/`` slice of a pod store root, owning
+its digest range through an epoch lease
+(`resilience.coordinator.RangeLeaseGuard`).  The router speaks the same
+JSON-over-TCP verbs as a single daemon, so `ServeClient` and
+``cli serve-client`` work unchanged against either topology:
+
+- **ingest** splits the batch by digest range (`cluster.store
+  .digest_range_ids` — the same deal the pod batch plane uses), forwards
+  each slice to its owner with a per-shard idempotent request id, and
+  acks only after EVERY owner's manifest commit.  On a shard-daemon
+  death mid-window the forward retries against the epoch-advanced
+  replacement writer with the SAME request id: a slice that already
+  committed replays its original ack from the shard's manifest journal
+  (zero rows double-absorbed), a slice that never committed ingests
+  fresh (zero acked rows lost).  Lease fencing makes the replay safe —
+  the superseded writer can no longer append.
+- **query** broadcasts to every shard (an LSH near-duplicate can live in
+  any range — only exact duplicates co-shard by digest) and min-merges:
+  membership comes from the digest owner, the label is the smallest
+  mapped global id any shard proposes.
+- The router holds NO durable state.  Its only soft state is the
+  per-shard local-row -> global-row map, rebuilt purely from ack
+  ``rows`` fields (``setdefault`` — min global id wins), which is why a
+  replayed ack composes: digest-lookup rows map onto already-assigned
+  global ids.  Routed shard daemons should run ``state_commit_every=1``
+  so a writer restart preserves local row identity for every batch that
+  was acked before the crash (the one in-flight batch per shard is
+  retried idempotently).
+
+The router never opens a store directory and never writes a store file
+(graftlint ``serve-write-plane``): durability lives entirely at the
+shard writers.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import threading
+
+import numpy as np
+
+from ..cluster.store import digest_range_ids, row_digests
+from ..observability import metrics as obs_metrics
+from ..observability.export import flat_metrics, prometheus_text
+from ..observability.latency import LatencyRecorder
+from ..observability.tracing import (continue_trace, recent_spans, span,
+                                     spans_recorded)
+from ..resilience import RetryPolicy, fault_point, reraise_if_fault, retry_call
+from ..resilience.watchdog import request_budget_s
+from ..trace import sync as tsync
+from ..trace.hooks import shared_access, trace_point
+from ..utils.logging import get_logger
+from .daemon import IngestRejected
+from .server import (_Handler, decode_vectors, encode_vectors, read_msg,
+                     write_msg)
+
+log = get_logger("serve.router")
+
+_CONNECT_TIMEOUT_S = 5.0
+
+# Synthetic label space for cluster representatives the router never
+# acked (rows pre-loaded into a shard store outside this router): each
+# (shard, local row) still gets ONE deterministic global label, kept
+# below -1 so it can never collide with a routed global row id.
+_FOREIGN_BASE = -2
+
+
+class TcpTransport:
+    """One pinned connection to one shard daemon; reconnects lazily and
+    re-resolves the port file on every reconnect — a replacement writer
+    under a fresh port publishes itself by rewriting the same file."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 port_file: str | None = None) -> None:
+        self.host = host
+        self.port = int(port)
+        self.port_file = port_file
+        self._sock: socket.socket | None = None
+
+    def _resolve_port(self) -> int:
+        if self.port_file:
+            with open(self.port_file, encoding="utf-8") as f:
+                return int(f.read().strip())
+        return self.port
+
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            s = socket.create_connection((self.host, self._resolve_port()),
+                                         timeout=_CONNECT_TIMEOUT_S)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._sock = s
+        return self._sock
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+    def __call__(self, msg: dict, timeout_s: float | None = None) -> dict:
+        sock = self._connect()
+        sock.settimeout(timeout_s or _CONNECT_TIMEOUT_S)
+        try:
+            write_msg(sock, msg)
+            return read_msg(sock)
+        except (ConnectionError, socket.timeout, OSError):
+            self.close()
+            raise
+
+
+class LocalTransport:
+    """In-process transport over a `ServeDaemon` (or `ServeReplica`):
+    the graftrace schedule explorer and the unit tests drive the real
+    router logic without sockets.  Speaks the same message dicts the
+    TCP servers dispatch."""
+
+    def __init__(self, daemon) -> None:
+        self.daemon = daemon
+
+    def __call__(self, msg: dict, timeout_s: float | None = None) -> dict:
+        op = str(msg.get("op", ""))
+        if op == "ingest":
+            rid = msg.get("request_id")
+            return self.daemon.ingest(decode_vectors(msg),
+                                      request_id=str(rid) if rid else None)
+        if op == "query":
+            res = self.daemon.query(decode_vectors(msg))
+            return {"ok": True,
+                    "labels": res["labels"].astype(int).tolist(),
+                    "known": res["known"].astype(bool).tolist(),
+                    "generation": int(res["generation"])}
+        if op == "ping":
+            idx = self.daemon._index
+            return {"ok": True, "op": "ping",
+                    "generation": idx.generation, "rows": idx.n_rows}
+        if op == "status":
+            return {"ok": True, **self.daemon.status()}
+        if op == "quiesce":
+            return self.daemon.quiesce()
+        return {"ok": False, "error": f"unknown op {op!r}"}
+
+
+class ShardRouter:
+    """Fan `query`/`ingest` over the shard owners; min-merge the
+    answers.  Thread-safe: the per-shard row map and the request
+    counter live under one lock; forwards happen outside it."""
+
+    def __init__(self, transports: dict[int, object],
+                 monitor=None,
+                 retry: RetryPolicy | None = None) -> None:
+        if not transports:
+            raise ValueError("router needs at least one shard transport")
+        self.transports = dict(transports)
+        self.n_shards = len(self.transports)
+        if sorted(self.transports) != list(range(self.n_shards)):
+            raise ValueError(
+                f"shard transports must cover ranges 0..{self.n_shards - 1} "
+                f"densely, got {sorted(self.transports)}")
+        # Optional resilience.coordinator.PeerMonitor over the shard
+        # daemons' heartbeat files (peers = range ids): `status` reports
+        # which writers are currently lost without waiting on a forward
+        # timeout to discover it.
+        self.monitor = monitor
+        # Failover window: enough attempts to cover a replacement
+        # writer's respawn + recovery behind the same port file.
+        self.retry = retry or RetryPolicy(max_attempts=8, base_delay=0.1,
+                                          max_delay=2.0)
+        self._lock = tsync.Lock("ShardRouter")
+        # shard id -> {local index row -> global row id}; global ids are
+        # assigned in submission order, so min-global == first ingest.
+        self._gmap: dict[int, dict[int, int]] = {
+            sid: {} for sid in self.transports}
+        self._next_row = 0
+        self._seq = 0
+        self._replayed = 0
+        self.lat_forward = LatencyRecorder("serve_router_forward")
+
+    # -- forwarding ----------------------------------------------------------
+
+    def _forward(self, sid: int, msg: dict,
+                 timeout_s: float | None = None) -> dict:
+        """One shard exchange under the shared retry engine: connection
+        failures (a dying or restarting writer) re-send the SAME message
+        — same request id — so the replacement's journal replay, not a
+        second absorb, answers a retried committed slice."""
+
+        def attempt() -> dict:
+            with span("serve.router.forward", shard=int(sid),
+                      op=str(msg.get("op", ""))):
+                with self.lat_forward.time():
+                    resp = self.transports[sid](msg, timeout_s=timeout_s)
+            # The lost-ack window: the shard has committed and answered,
+            # this process has not yet passed the answer up.  An
+            # injected drop here is exactly "writer died after commit,
+            # before the ack reached the client".
+            fault_point("serve.router.forward")
+            return resp
+
+        resp = retry_call(attempt, policy=self.retry,
+                          site="serve.router.forward")
+        if not resp.get("ok", False):
+            if resp.get("error") == "backpressure":
+                raise IngestRejected(int(resp.get("depth", 0)),
+                                     float(resp.get("retry_after_s", 0.1)))
+            raise RuntimeError(
+                f"shard {sid} refused {msg.get('op')}: {resp.get('error')}")
+        return resp
+
+    def _map_label(self, sid: int, local: int) -> int:
+        """Shard-local label (an index row id) -> global label, under
+        the caller's lock.  Unrouted representatives get a stable
+        synthetic id below -1 (never a routed global row)."""
+        g = self._gmap[sid].get(int(local))
+        if g is not None:
+            return g
+        return _FOREIGN_BASE - (int(local) * self.n_shards + int(sid))
+
+    # -- verbs ---------------------------------------------------------------
+
+    def ingest(self, vectors: np.ndarray, timeout: float | None = None,
+               request_id: str | None = None) -> dict:
+        vectors = np.ascontiguousarray(vectors, np.uint32)
+        k = int(vectors.shape[0])
+        rid_in = str(request_id) if request_id else None
+        with self._lock:
+            shared_access(self, "gmap", write=True)
+            self._seq += 1
+            rid = rid_in or f"r{self._seq:08d}"
+            g0 = self._next_row
+            self._next_row += k
+        if k == 0:
+            return {"ok": True, "acked": 0, "novel": 0, "generation": 0,
+                    "labels": [], "rows": [], "shards": {}}
+        rows_sid = digest_range_ids(row_digests(vectors), self.n_shards)
+        trace_point("serve.router.split")
+        per_shard: dict[int, np.ndarray] = {}
+        for sid in np.unique(rows_sid):
+            per_shard[int(sid)] = np.flatnonzero(rows_sid == sid)
+        acked = novel = 0
+        replayed = False
+        gens: dict[int, int] = {}
+        glabels = np.empty(k, np.int64)
+        # In-flight window: ONE slice outstanding per shard, forwarded
+        # in range order — deterministic under the schedule explorer.
+        resps: dict[int, dict] = {}
+        for sid in sorted(per_shard):
+            sel = per_shard[sid]
+            msg = {"op": "ingest", "request_id": f"{rid}/{sid}",
+                   **encode_vectors(vectors[sel])}
+            resps[sid] = self._forward(sid, msg, timeout_s=timeout)
+        with self._lock:
+            shared_access(self, "gmap", write=True)
+            for sid in sorted(per_shard):
+                sel = per_shard[sid]
+                resp = resps[sid]
+                acked += int(resp.get("acked", 0))
+                novel += int(resp.get("novel", 0))
+                gens[sid] = int(resp.get("generation", 0))
+                if resp.get("replayed"):
+                    replayed = True
+                    self._replayed += 1
+                gmap = self._gmap[sid]
+                # Map THIS slice's rows first (min-global wins), then
+                # translate its labels — a cluster representative may be
+                # in the slice itself.
+                for i, local in zip(sel.tolist(), resp["rows"]):
+                    # A replayed ack can carry -1 for a row whose store
+                    # copy was since evicted; never map a sentinel.
+                    if int(local) >= 0:
+                        gmap.setdefault(int(local), g0 + int(i))
+                for i, local in zip(sel.tolist(), resp["labels"]):
+                    glabels[i] = (self._map_label(sid, int(local))
+                                  if int(local) >= 0 else -1)
+        out = {"ok": True, "acked": acked, "novel": novel,
+               "generation": max(gens.values()),
+               "labels": glabels.tolist(),
+               "rows": (g0 + np.arange(k, dtype=np.int64)).tolist(),
+               "shards": {str(s): g for s, g in sorted(gens.items())}}
+        if replayed:
+            out["replayed"] = True
+        return out
+
+    def query(self, vectors: np.ndarray) -> dict:
+        """Broadcast membership: `known` comes from the digest owner,
+        the label is the min routed global id across every shard that
+        proposes one (direct cross-shard agreement; transitive merges
+        across three or more shards settle at the daily batch
+        recluster)."""
+        vectors = np.ascontiguousarray(vectors, np.uint32)
+        n = int(vectors.shape[0])
+        owner = digest_range_ids(row_digests(vectors), self.n_shards)
+        msg_payload = encode_vectors(vectors)
+        resps: dict[int, dict] = {}
+        for sid in sorted(self.transports):
+            resps[sid] = self._forward(sid, {"op": "query", **msg_payload})
+        known = np.zeros(n, bool)
+        out = np.full(n, -1, np.int64)
+        gens = {sid: int(r.get("generation", 0))
+                for sid, r in resps.items()}
+        with self._lock:
+            shared_access(self, "gmap", write=False)
+            for i in range(n):
+                known[i] = bool(resps[int(owner[i])]["known"][i])
+                best = None
+                foreign = None
+                for sid, resp in resps.items():
+                    local = int(resp["labels"][i])
+                    if local < 0:
+                        continue
+                    g = self._map_label(sid, local)
+                    if g >= 0:
+                        best = g if best is None else min(best, g)
+                    else:
+                        foreign = g if foreign is None else min(foreign, g)
+                if best is not None:
+                    out[i] = best
+                elif foreign is not None:
+                    out[i] = foreign
+        return {"labels": out, "known": known,
+                "generation": max(gens.values()),
+                "shard_generations": gens}
+
+    def ping(self) -> dict:
+        resps = {sid: self._forward(sid, {"op": "ping"})
+                 for sid in sorted(self.transports)}
+        return {"ok": True, "op": "ping",
+                "rows": sum(int(r.get("rows", 0)) for r in resps.values()),
+                "generation": max(int(r.get("generation", 0))
+                                  for r in resps.values()),
+                "shards": self.n_shards}
+
+    def quiesce(self, timeout: float | None = None) -> dict:
+        resps = {sid: self._forward(sid, {"op": "quiesce"},
+                                    timeout_s=timeout)
+                 for sid in sorted(self.transports)}
+        return {"ok": True,
+                "generation": max(int(r.get("generation", 0))
+                                  for r in resps.values()),
+                "shards": {str(s): int(r.get("generation", 0))
+                           for s, r in sorted(resps.items())}}
+
+    def status(self) -> dict:
+        shard_status: dict[str, dict] = {}
+        for sid in sorted(self.transports):
+            try:
+                shard_status[str(sid)] = self._forward(
+                    sid, {"op": "status"})
+            except (ConnectionError, OSError, RuntimeError) as e:
+                shard_status[str(sid)] = {"ok": False,
+                                          "error": f"{type(e).__name__}: {e}"}
+        lost = self.monitor.poll() if self.monitor is not None else []
+        with self._lock:
+            shared_access(self, "gmap", write=False)
+            mapped = sum(len(m) for m in self._gmap.values())
+            stats = {"router_rows": self._next_row,
+                     "router_requests": self._seq,
+                     "router_replayed_acks": self._replayed,
+                     "router_mapped_rows": mapped}
+        obs_metrics.gauge("serve_router_rows").set(stats["router_rows"])
+        return {"ok": all(s.get("ok", False)
+                          for s in shard_status.values()),
+                "topology": "sharded",
+                "shards": self.n_shards,
+                "shards_lost": [int(p) for p in lost],
+                **stats,
+                **self.lat_forward.summary(),
+                "shard_status": shard_status}
+
+
+class RouterServer(socketserver.ThreadingTCPServer):
+    """The router's JSON-over-TCP face: same framing, same verbs, same
+    error envelope as `ServeServer` — a `ServeClient` cannot tell the
+    difference (the point: clients and the CLI work unchanged over the
+    sharded topology)."""
+
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, router: ShardRouter,
+                 host: str = "127.0.0.1", port: int = 0) -> None:
+        super().__init__((host, port), _Handler)
+        self.router = router
+        self._shutdown_requested = threading.Event()
+
+    @property
+    def port(self) -> int:
+        return int(self.server_address[1])
+
+    def dispatch(self, msg: dict) -> dict:
+        op = str(msg.get("op", ""))
+        ctx = msg.pop("trace", None)
+        try:
+            with continue_trace(ctx):
+                with span(f"serve.router.{op}"):
+                    resp = self._dispatch_op(op, msg)
+        except IngestRejected as e:
+            resp = {"ok": False, "error": "backpressure",
+                    "retry_after_s": round(e.retry_after_s, 3),
+                    "depth": e.depth}
+        except Exception as e:
+            reraise_if_fault(e)
+            log.error("router: %s request failed (%s: %s)", op,
+                      type(e).__name__, e)
+            resp = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+        if ctx and isinstance(ctx, dict) and ctx.get("t"):
+            resp.setdefault("trace", str(ctx["t"]))
+        return resp
+
+    def _dispatch_op(self, op: str, msg: dict) -> dict:
+        if op == "ping":
+            return self.router.ping()
+        if op == "status":
+            return self.router.status()
+        if op == "query":
+            res = self.router.query(decode_vectors(msg))
+            return {"ok": True,
+                    "labels": res["labels"].astype(int).tolist(),
+                    "known": res["known"].astype(bool).tolist(),
+                    "generation": int(res["generation"])}
+        if op == "ingest":
+            rid = msg.get("request_id")
+            return self.router.ingest(
+                decode_vectors(msg),
+                timeout=request_budget_s("ingest") or None,
+                request_id=str(rid) if rid else None)
+        if op == "quiesce":
+            return self.router.quiesce(
+                timeout=request_budget_s("ingest") or None)
+        if op == "metrics":
+            return {"ok": True, "prometheus": prometheus_text(),
+                    "metrics": flat_metrics()}
+        if op == "trace":
+            n = msg.get("n")
+            return {"ok": True,
+                    "spans": recent_spans(int(n) if n else None),
+                    "spans_recorded": spans_recorded()}
+        if op == "shutdown":
+            self._shutdown_requested.set()
+            threading.Thread(target=self.shutdown, daemon=True).start()
+            return {"ok": True, "op": "shutdown"}
+        return {"ok": False, "error": f"unknown op {op!r}"}
+
+    def serve_until_shutdown(self, port_file: str | None = None) -> None:
+        if port_file:
+            from ..utils.atomic import atomic_write
+
+            with atomic_write(port_file) as f:
+                f.write(str(self.port))
+        log.info("router: listening on %s:%d (%d shard(s))",
+                 self.server_address[0], self.port, self.router.n_shards)
+        self.serve_forever(poll_interval=0.1)
+
+
+__all__ = ["LocalTransport", "RouterServer", "ShardRouter", "TcpTransport"]

@@ -165,9 +165,11 @@ class ServeServer(socketserver.ThreadingTCPServer):
                     "generation": int(res["generation"])}
         if op == "ingest":
             vectors = decode_vectors(msg)
+            rid = msg.get("request_id")
             return self._guarded(
                 "ingest", lambda: self.daemon.ingest(
-                    vectors, timeout=request_budget_s("ingest") or None))
+                    vectors, timeout=request_budget_s("ingest") or None,
+                    request_id=str(rid) if rid else None))
         if op == "quiesce":
             return self._guarded(
                 "ingest", lambda: self.daemon.quiesce(

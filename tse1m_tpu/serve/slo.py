@@ -45,14 +45,22 @@ class SloPolicy:
         default_factory=lambda: request_budget_s("query"))
     ingest_budget_s: float = field(
         default_factory=lambda: request_budget_s("ingest"))
+    # LSM delta-run consolidation bound for the live index (None =
+    # leave TSE1M_LIVE_DELTA_RUNS / the built-in default alone).  The
+    # pre-split measurement round tunes this when the lock-wait fat
+    # tail is ``serve.index.swap``: fewer runs = cheaper probes per
+    # query but more consolidation stalls on the ingest thread.
+    live_delta_runs: int | None = None
 
     @classmethod
     def from_env(cls) -> "SloPolicy":
+        runs = os.environ.get("TSE1M_LIVE_DELTA_RUNS")
         return cls(
             max_backlog_batches=int(
                 os.environ.get("TSE1M_SERVE_MAX_BACKLOG", 64)),
             query_p99_target_ms=float(
-                os.environ.get("TSE1M_SERVE_P99_TARGET_MS", 50.0)))
+                os.environ.get("TSE1M_SERVE_P99_TARGET_MS", 50.0)),
+            live_delta_runs=int(runs) if runs else None)
 
 
 class AdmissionController:

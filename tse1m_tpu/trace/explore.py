@@ -23,6 +23,20 @@ real critical sections — no mocks, no test-only branches:
 - ``store-evict`` — the same with a byte cap so appends evict LRU
   shards; probe coverage must equal a committed (possibly evicted)
   shard view, never a mix.
+- ``router`` — the sharded serve plane's fan-out router
+  (`serve.router.ShardRouter`) over two shard daemons, with a writer
+  ingesting through the router, a second writer replaying one batch
+  under the SAME idempotent request id, and a querier broadcasting.
+  Invariants: the replayed slice is never double-absorbed (total index
+  rows across shards equal the unique submission count), the two acks
+  for one request id carry identical labels, exact duplicates always
+  share their original's label, per-shard generations never regress
+  and a routed (>= 0) label, once observed, never changes.
+- ``replica`` — shard-streaming replication (`serve.replicate`): an
+  evicting source writer races a replication streamer and a replica's
+  ``refresh()``/rebuild.  Invariants: replica probe coverage is always
+  exactly a committed source manifest view (never a torn mix), and the
+  adopted generation never decreases.
 
 :func:`explore` drives N seeded PCT schedules plus a bounded exhaustive
 enumeration of decision prefixes; every failure raises
@@ -212,10 +226,238 @@ def _store_scenario(tmp: str, evict: bool, reader_cls=None):
     return bodies, validate
 
 
+# -- scenario: router ---------------------------------------------------------
+
+
+def _router_scenario(tmp: str):
+    import numpy as np
+
+    from ..cluster import ClusterParams
+    from ..cluster.store import digest_range_ids, row_digests
+    from ..serve.daemon import ServeDaemon
+    from ..serve.router import ShardRouter
+    from ..serve.server import decode_vectors
+    from . import sync as tsync
+
+    params = ClusterParams(n_hashes=_POLICY["n_hashes"], n_bands=4,
+                           seed=_POLICY["seed"], use_pallas="never")
+    # Craft a corpus that populates BOTH digest ranges and carries exact
+    # duplicates (only exact dups co-shard): rejection-sample unique
+    # rows until each range owns five, then append dups of rows 0/1.
+    rng = np.random.default_rng(7)
+    picked: list = []
+    want = {0: 5, 1: 5}
+    while want[0] or want[1]:
+        row = rng.integers(0, 2**32, size=(1, 16),
+                           dtype=np.int64).astype(np.uint32)
+        rid = int(digest_range_ids(row_digests(row), 2)[0])
+        if want[rid]:
+            want[rid] -= 1
+            picked.append(row[0])
+    items = np.stack(picked + [picked[0], picked[1]])  # 12 rows, 10 unique
+
+    daemons = {sid: ServeDaemon(os.path.join(tmp, f"range_{sid:04d}"),
+                                params=params, signer="host")
+               for sid in (0, 1)}
+
+    def direct(daemon, ing_lock):
+        # In production one ingest-loop thread serializes a shard's
+        # absorbs behind the TCP queue; the traced lock models exactly
+        # that, while queries stay lock-free (snapshot reads).
+        def call(msg: dict, timeout_s=None) -> dict:
+            if msg.get("op") == "ingest":
+                rid = msg.get("request_id")
+                with ing_lock:
+                    return daemon._ingest_batch(
+                        decode_vectors(msg),
+                        request_id=str(rid) if rid else None)
+            res = daemon.query(decode_vectors(msg))
+            return {"ok": True,
+                    "labels": res["labels"].astype(int).tolist(),
+                    "known": res["known"].astype(bool).tolist(),
+                    "generation": int(res["generation"])}
+        return call
+
+    router = ShardRouter({
+        sid: direct(d, tsync.Lock(f"shard{sid}.ingest"))
+        for sid, d in daemons.items()})
+    acks: list = []
+    fix0_acks: list = []
+    query_obs: list = []
+
+    def writer() -> None:
+        r = router.ingest(items[0:4], request_id="fix0")
+        fix0_acks.append(r)
+        acks.append(r)
+        acks.append(router.ingest(items[4:8]))
+        acks.append(router.ingest(items[8:12]))
+
+    def replayer() -> None:
+        # Same content, SAME request id: whichever of the two "fix0"
+        # submissions runs second must replay the per-shard journal
+        # acks, not absorb a second copy.
+        r = router.ingest(items[0:4], request_id="fix0")
+        fix0_acks.append(r)
+
+    def querier() -> None:
+        for _ in range(3):
+            resp = router.query(items)
+            query_obs.append((np.asarray(resp["labels"]).copy(),
+                              np.asarray(resp["known"]).copy(),
+                              dict(resp["shard_generations"])))
+
+    def validate() -> None:
+        for a in acks + fix0_acks:
+            if int(a["acked"]) != 4 or len(a["labels"]) != 4:
+                raise AssertionError(f"short ack: {a}")
+        l0, l1 = fix0_acks[0]["labels"], fix0_acks[1]["labels"]
+        if l0 != l1:
+            raise AssertionError(
+                "the two acks for request id fix0 disagree: "
+                f"{l0} != {l1} (replay answered from a different view)")
+        total = sum(d._index.n_rows for d in daemons.values())
+        if total != 12:
+            raise AssertionError(
+                f"double-absorb: shards hold {total} index rows for 12 "
+                "submitted rows (the replayed slice re-absorbed)")
+        prev_known = prev_labels = None
+        last_gens: dict = {}
+        for labels, known, gens in query_obs:
+            for sid, g in gens.items():
+                if g < last_gens.get(sid, 0):
+                    raise AssertionError(
+                        f"shard {sid} generation regressed: {g} after "
+                        f"{last_gens.get(sid)}")
+                last_gens[sid] = g
+            for j, orig in ((10, 0), (11, 1)):
+                if known[j] != known[orig] or labels[j] != labels[orig]:
+                    raise AssertionError(
+                        f"exact duplicate {j} of row {orig} diverged: "
+                        f"known {known[j]}/{known[orig]}, labels "
+                        f"{labels[j]}/{labels[orig]}")
+            if prev_known is not None:
+                for i in range(12):
+                    if prev_known[i] and not known[i]:
+                        raise AssertionError(
+                            f"membership regressed for row {i}")
+                    if prev_labels[i] >= 0 and labels[i] != prev_labels[i]:
+                        raise AssertionError(
+                            f"routed label for row {i} changed: "
+                            f"{prev_labels[i]} -> {labels[i]}")
+            prev_known, prev_labels = known, labels
+        final = router.query(items)
+        fl = np.asarray(final["labels"])
+        if not np.asarray(final["known"]).all():
+            raise AssertionError("post-run rows missing from membership")
+        if (fl < 0).any() or len(set(fl[:10].tolist())) != 10:
+            raise AssertionError(
+                f"post-run global labels malformed: {fl.tolist()}")
+        if fl[10] != fl[0] or fl[11] != fl[1]:
+            raise AssertionError(
+                f"post-run duplicate labels diverged: {fl.tolist()}")
+
+    bodies = {"w": writer, "rp": replayer, "q": querier}
+    return bodies, validate
+
+
+# -- scenario: replica --------------------------------------------------------
+
+
+def _replica_scenario(tmp: str):
+    import numpy as np
+
+    from ..cluster import ClusterParams
+    from ..cluster.store import SignatureStore
+    from ..serve.replicate import ServeReplica, stream_shards
+
+    params = ClusterParams(n_hashes=_POLICY["n_hashes"], n_bands=4,
+                           seed=_POLICY["seed"], use_pallas="never")
+    rng = np.random.default_rng(11)
+    n_batches, rows = 4, 3
+    digests = rng.integers(1, 2**63, size=(n_batches * rows, 2),
+                           dtype=np.uint64)
+    sigs = rng.integers(0, 2**32, size=(n_batches * rows,
+                                        _POLICY["n_hashes"]),
+                        dtype=np.uint64).astype(np.uint32)
+    max_bytes = 2 * rows * _POLICY["n_hashes"] * 4 + 1  # keep 2 live shards
+    src = os.path.join(tmp, "src")
+    dst = os.path.join(tmp, "replica")
+    writer_store = SignatureStore(src, _POLICY, max_bytes=max_bytes)
+    # Bootstrap: one committed batch + one pull BEFORE the explored
+    # window, so the replica adopts the writer's policy from a streamed
+    # manifest (the production ctor path).
+    writer_store.append(digests[:rows], sigs[:rows])
+    stream_shards(src, dst)
+    replica = ServeReplica(dst, params=params)
+    batch_of = np.repeat(np.arange(n_batches), rows)
+    # Every source manifest state, in commit order (append + each
+    # single-victim eviction step) — any of them is a view the streamer
+    # may copy and the replica may adopt.
+    committed: list = []
+    shard_sets: list = []
+    live: set = set()
+    for b in range(n_batches):
+        live = live | {b}
+        shard_sets.append(set(live))
+        while len(live) > 2:
+            live = live - {min(live)}
+            shard_sets.append(set(live))
+    for s in shard_sets:
+        committed.append(frozenset(
+            i for i in range(n_batches * rows) if int(batch_of[i]) in s))
+    probe_obs: list = []
+    gen_obs: list = []
+
+    def writer() -> None:
+        for b in range(1, n_batches):
+            blk = slice(b * rows, (b + 1) * rows)
+            writer_store.append(digests[blk], sigs[blk])
+
+    def streamer() -> None:
+        for _ in range(3):
+            try:
+                stream_shards(src, dst)
+            except OSError:
+                # All bounded retries raced the writer's eviction: the
+                # pull gives up for this interval (the production
+                # puller's behaviour); the replica stays on its last
+                # adopted generation, which the invariant tolerates.
+                pass
+
+    def refresher() -> None:
+        for _ in range(4):
+            replica.refresh()
+            gen_obs.append(int(replica._generation_adopted))
+            hit, _, _ = replica.store.bulk_probe(digests)
+            probe_obs.append(frozenset(
+                int(i) for i in np.flatnonzero(hit)))
+
+    def validate() -> None:
+        valid = set(committed)
+        for view in probe_obs:
+            if view not in valid:
+                raise AssertionError(
+                    "replica adopted a store view the writer never "
+                    f"committed (torn stream): rows {sorted(view)}; "
+                    f"committed views: {[sorted(v) for v in valid]}")
+        last = -1
+        for g in gen_obs:
+            if g < last:
+                raise AssertionError(
+                    f"replica adopted generation regressed: {g} after "
+                    f"{last}")
+            last = g
+
+    bodies = {"w": writer, "s": streamer, "rr": refresher}
+    return bodies, validate
+
+
 SCENARIOS = {
     "serve": lambda tmp: _serve_scenario(tmp),
     "store": lambda tmp: _store_scenario(tmp, evict=False),
     "store-evict": lambda tmp: _store_scenario(tmp, evict=True),
+    "router": lambda tmp: _router_scenario(tmp),
+    "replica": lambda tmp: _replica_scenario(tmp),
 }
 
 # Env forced during a scenario run: a tiny LSM delta threshold makes
