@@ -1,0 +1,13 @@
+"""BAD: the module declares spec bindings that do not hold — one
+named spec does not exist, and a fault seat here is absent from every
+spec it binds to."""
+
+SPEC_MODELS = ("toy", "ghost")
+
+
+def fault_point(site, path=None):  # stand-in for resilience.faults
+    pass
+
+
+def save(path):
+    fault_point("io.unmodeled", path=path)

@@ -1,0 +1,28 @@
+"""BAD: dead spec actions of every class — a fault seat no
+fault_point declares, a verb no surface dispatches, a call target
+that does not exist, an unknown seat kind, and a seat that is not a
+string literal."""
+
+SPEC_NAME = "toy"
+
+SEAT = "fault:io.write"
+
+
+class Action:  # stand-in for tse1m_tpu.spec.dsl.Action
+    def __init__(self, name, guard, effect, seat="model:env",
+                 fair=False):
+        pass
+
+
+def build():
+    return (
+        Action("dead_fault", lambda s: True, lambda s: s,
+               seat="fault:io.missing"),
+        Action("dead_verb", lambda s: True, lambda s: s,
+               seat="verb:evict"),
+        Action("dead_call", lambda s: True, lambda s: s,
+               seat="call:no_such_fn"),
+        Action("bad_kind", lambda s: True, lambda s: s,
+               seat="oops:x"),
+        Action("dyn_seat", lambda s: True, lambda s: s, seat=SEAT),
+    )

@@ -1,0 +1,19 @@
+"""GOOD: the module binds itself to the `toy` spec and its one fault
+seat is claimed by a spec action."""
+
+SPEC_MODELS = ("toy",)
+
+
+def fault_point(site, path=None):  # stand-in for resilience.faults
+    pass
+
+
+def do_write(path):
+    fault_point("io.write", path=path)
+
+
+class ServeServer:
+    def _dispatch_op(self, op, msg):
+        if op == "ping":
+            return {"ok": True}
+        return {"ok": False}

@@ -1,0 +1,23 @@
+"""GOOD: every spec action seat resolves to real code — the fault
+seat exists, the verb is dispatched, the call target is defined, and
+model: seats are exempt by design."""
+
+SPEC_NAME = "toy"
+
+
+class Action:  # stand-in for tse1m_tpu.spec.dsl.Action
+    def __init__(self, name, guard, effect, seat="model:env",
+                 fair=False):
+        pass
+
+
+def build():
+    return (
+        Action("write", lambda s: True, lambda s: s,
+               seat="fault:io.write", fair=True),
+        Action("ping", lambda s: True, lambda s: s, seat="verb:ping"),
+        Action("flush", lambda s: True, lambda s: s,
+               seat="call:do_write"),
+        Action("crash", lambda s: True, lambda s: s,
+               seat="model:crash"),
+    )

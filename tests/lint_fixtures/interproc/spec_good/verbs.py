@@ -1,0 +1,3 @@
+"""Fixture verb alphabet matching the one dispatch surface here."""
+
+SERVER_VERBS = ("ping",)

@@ -1,0 +1,10 @@
+"""BAD: the client lost its `query` method — the surface no longer
+covers CLIENT_VERBS."""
+
+
+class ServeClient:
+    def request(self, op, **kw):
+        return {"op": op, **kw}
+
+    def ping(self):
+        return self.request("ping")

@@ -1,0 +1,19 @@
+"""BAD: the in-process forwarder speaks a verb (`status`) the
+FORWARD_VERBS alphabet never declared."""
+
+
+class RouterServer:
+    def _dispatch_op(self, op, msg):
+        if op == "ping":
+            return {"ok": True}
+        return {"ok": False}
+
+
+class LocalTransport:
+    def __call__(self, msg):
+        op = str(msg.get("op", ""))
+        if op == "ping":
+            return {"ok": True}
+        if op == "status":
+            return {"ok": True, "rows": 0}
+        return {"ok": False}

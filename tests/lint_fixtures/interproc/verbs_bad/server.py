@@ -1,0 +1,11 @@
+"""BAD: the server dispatch table drifted both ways — it handles an
+undeclared verb (`evict`) and dropped a declared one (`query`)."""
+
+
+class ServeServer:
+    def _dispatch_op(self, op, msg):
+        if op == "ping":
+            return {"ok": True}
+        if op == "evict":
+            return {"ok": True, "evicted": 1}
+        return {"ok": False}

@@ -1,0 +1,6 @@
+"""Fixture spec verb alphabets the surfaces here drift from."""
+
+SERVER_VERBS = ("ping", "query")
+ROUTER_VERBS = ("ping",)
+CLIENT_VERBS = ("ping", "query")
+FORWARD_VERBS = ("ping",)

@@ -1,0 +1,13 @@
+"""GOOD: the client's request-issuing methods cover CLIENT_VERBS
+exactly (the transport helper issues no verb literal itself)."""
+
+
+class ServeClient:
+    def request(self, op, **kw):
+        return {"op": op, **kw}
+
+    def ping(self):
+        return self.request("ping")
+
+    def query(self, vectors):
+        return self.request("query", vectors=vectors)

@@ -1,0 +1,17 @@
+"""GOOD: router dispatch and in-process forwarding agree with their
+alphabets."""
+
+
+class RouterServer:
+    def _dispatch_op(self, op, msg):
+        if op == "ping":
+            return {"ok": True}
+        return {"ok": False}
+
+
+class LocalTransport:
+    def __call__(self, msg):
+        op = str(msg.get("op", ""))
+        if op == "ping":
+            return {"ok": True}
+        return {"ok": False}

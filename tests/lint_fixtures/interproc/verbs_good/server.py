@@ -1,0 +1,10 @@
+"""GOOD: the server dispatch table matches SERVER_VERBS exactly."""
+
+
+class ServeServer:
+    def _dispatch_op(self, op, msg):
+        if op == "ping":
+            return {"ok": True}
+        if op == "query":
+            return {"ok": True, "labels": []}
+        return {"ok": False}

@@ -1,0 +1,6 @@
+"""Fixture spec verb alphabets — all four surfaces agree exactly."""
+
+SERVER_VERBS = ("ping", "query")
+ROUTER_VERBS = ("ping",)
+CLIENT_VERBS = ("ping", "query")
+FORWARD_VERBS = ("ping",)

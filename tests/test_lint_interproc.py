@@ -50,6 +50,8 @@ def run_fixture(subdir: str, rule: str | None = None):
     ("fault-seat-drift", "seats_bad", "seats_good"),
     ("snapshot-publish", "snapshot_bad", "snapshot_good"),
     ("atomic-swap", "swap_bad", "swap_good"),
+    ("spec-conformance", "spec_bad", "spec_good"),
+    ("verb-dispatch-drift", "verbs_bad", "verbs_good"),
 ])
 def test_pass_bad_fires_good_silent(rule, bad, good):
     assert run_fixture(bad, rule), f"{rule} missed {bad}"
@@ -135,6 +137,38 @@ def test_fault_seat_drift_classes():
     assert dead.path.endswith("seats_bad/ci_fault_matrix.py")
 
 
+def test_spec_conformance_finding_classes():
+    """Every seeded conformance hole: dead fault/verb/call seats, an
+    unknown seat kind, a non-literal seat, an unknown SPEC_MODELS
+    binding, and an unmodeled production fault seat with its witness."""
+    found = run_fixture("spec_bad", "spec-conformance")
+    msgs = " | ".join(f.message for f in found)
+    assert "io.missing" in msgs            # dead fault seat
+    assert "verb `evict`" in msgs          # dead verb
+    assert "no_such_fn" in msgs            # dead call target
+    assert "unknown seat kind" in msgs
+    assert "string literal" in msgs        # non-const seat kwarg
+    assert "ghost" in msgs                 # SPEC_MODELS names no spec
+    assert "io.unmodeled" in msgs          # fault seat absent from spec
+    unmodeled = [f for f in found if "io.unmodeled" in f.message][0]
+    assert unmodeled.path.endswith("spec_bad/code.py")
+    assert any("fault_point" in w for w in unmodeled.witness)
+
+
+def test_verb_dispatch_drift_finding_classes():
+    """Both drift directions, on three surfaces: the server handles an
+    undeclared verb AND dropped a declared one, the client lost a
+    method, and the forwarder speaks past its alphabet."""
+    found = run_fixture("verbs_bad", "verb-dispatch-drift")
+    msgs = " | ".join(f.message for f in found)
+    assert "handles undeclared evict" in msgs
+    assert "missing query" in msgs
+    assert "LocalTransport" in msgs and "status" in msgs
+    server = [f for f in found if "ServeServer" in f.message][0]
+    assert server.path.endswith("verbs_bad/server.py")
+    assert any("SERVER_VERBS" in w for w in server.witness)
+
+
 # -- --why witness chains through the CLI ------------------------------------
 
 def test_why_prints_witness_chain(capsys):
@@ -159,6 +193,8 @@ def test_why_prints_witness_chain(capsys):
     ("fault-seat-drift", "seats_bad", "fault_point"),
     ("snapshot-publish", "snapshot_bad", "item-writes"),
     ("atomic-swap", "swap_bad", "aliases"),
+    ("spec-conformance", "spec_bad", "fault_point"),
+    ("verb-dispatch-drift", "verbs_bad", "SERVER_VERBS"),
 ])
 def test_why_works_for_every_pass(capsys, rule, subdir, expect):
     """Acceptance: each seeded bad fixture is detected AND its --why
@@ -300,6 +336,36 @@ def test_real_tree_publication_discipline_clean():
     assert "_index" in slots.get("tse1m_tpu.serve.daemon.ServeDaemon",
                                  set())
     for pass_fn in (snapshot_publish_pass, atomic_swap_pass):
+        findings = pass_fn(graph)
+        assert findings == [], [(f.location(), f.message)
+                                for f in findings]
+
+
+def test_real_tree_spec_conformance_clean():
+    """The acceptance gate for graftspec's static layer: the real tree
+    passes spec-conformance and verb-dispatch-drift with ZERO findings
+    and zero baseline entries — and the passes do see all three spec
+    modules, all four dispatch surfaces and the serve fault seats, so
+    the silence is not a no-op."""
+    from tse1m_tpu.lint.engine import default_targets, repo_root
+    from tse1m_tpu.lint.interproc import (_dispatch_verbs,
+                                          _production_sites,
+                                          _spec_modules,
+                                          spec_conformance_pass,
+                                          verb_dispatch_drift_pass)
+
+    root = repo_root()
+    graph = build_graph(default_targets(root), root=root, use_cache=False)
+    assert set(_spec_modules(graph)) == {"ingest_ack", "lease",
+                                         "replica"}
+    surfaces = _dispatch_verbs(graph)
+    for const in ("SERVER_VERBS", "ROUTER_VERBS", "CLIENT_VERBS",
+                  "FORWARD_VERBS"):
+        assert surfaces[const], f"no {const} dispatch surface resolved"
+    sites, _ = _production_sites(graph)
+    assert {"serve.ingest.commit", "serve.router.forward",
+            "serve.replica.stream"} <= set(sites)
+    for pass_fn in (spec_conformance_pass, verb_dispatch_drift_pass):
         findings = pass_fn(graph)
         assert findings == [], [(f.location(), f.message)
                                 for f in findings]

@@ -246,6 +246,30 @@ def test_bench_cli_diff_and_keys(capsys):
     assert "serve_profiled_p99_ms" in out
 
 
+@pytest.mark.parametrize("make", [
+    lambda p: p,                                        # missing
+    lambda p: (open(p, "w").close(), p)[1],             # truncated/empty
+    lambda p: (open(p, "w").write('{"broken'), p)[1],   # corrupt JSON
+])
+def test_bench_cli_bad_inputs_one_line_error(tmp_path, capsys, make):
+    """diff/gate on a missing, truncated or corrupt file must print ONE
+    actionable line (the path + the `bench baseline` remint hint) on
+    stderr and exit nonzero — never a traceback."""
+    bad = make(str(tmp_path / "bad.json"))
+    good = os.path.join(REPO, "BENCH_r09.json")
+    assert bench_main(["diff", bad, good]) == 2
+    assert bench_main(["diff", good, bad]) == 2
+    assert bench_main(["gate", bad, "--baseline", good]) == 2
+    assert bench_main(["gate", good, "--baseline", bad]) == 2
+    err = capsys.readouterr().err
+    lines = [ln for ln in err.splitlines() if ln.strip()]
+    assert len(lines) == 4
+    for ln in lines:
+        assert ln.startswith("bench: cannot read ")
+        assert bad in ln
+        assert "tse1m_tpu.bench baseline" in ln
+
+
 def test_committed_smoke_baseline_is_loadable():
     runs = load_runs(os.path.join(REPO, "BENCH_baseline_smoke.json"))
     assert runs

@@ -168,8 +168,8 @@ def test_cli_all_runs_survivors_and_reports_failures(tmp_path, stub_rqs,
         assert os.path.exists(os.path.join(out, short + ".ran")), short
     payload = _read(os.path.join(out, "run_manifest.json"))
     by_name = {s["name"]: s for s in payload["steps"]}
-    assert set(by_name) == {"graftlint", "rq1", "rq2a", "rq2b", "rq3",
-                            "rq4a", "rq4b"}
+    assert set(by_name) == {"graftlint", "graftspec", "rq1", "rq2a",
+                            "rq2b", "rq3", "rq4a", "rq4b"}
     # the correctness step records its structured summary per run
     lint = by_name["graftlint"]
     assert lint["status"] == "ok"
@@ -182,6 +182,15 @@ def test_cli_all_runs_survivors_and_reports_failures(tmp_path, stub_rqs,
     assert lint["result"]["graph_functions"] > 100
     assert lint["result"]["wall_s"] > 0
     assert "by_rule_total" in lint["result"]
+    # graftspec: every committed spec model-checked clean, every mutant
+    # caught with a replayed counterexample — recorded per run.
+    spec = by_name["graftspec"]
+    assert spec["status"] == "ok"
+    checked = {s["spec"]: s for s in spec["result"]["specs"]}
+    assert set(checked) == {"lease", "ingest_ack", "replica"}
+    assert all(s["ok"] and s["complete"] for s in checked.values())
+    assert all(m["caught"] and m["replayed"]
+               for m in spec["result"]["mutants"].values())
     assert by_name["rq3"]["status"] == "failed"
     assert "permanent rq fault" in by_name["rq3"]["error"]
     assert "permanent rq fault" in by_name["rq3"]["traceback"]

@@ -109,6 +109,78 @@ def test_router_partition_parity_vs_single_daemon(tmp_path):
         _stop_shards(daemons)
 
 
+def test_router_query_empty_batch(tmp_path):
+    """A zero-row query batch min-merges to zero-row answers — no
+    divide-by-shard, no index into an empty response."""
+    daemons = _start_shards(tmp_path)
+    try:
+        router = ShardRouter({sid: LocalTransport(d)
+                              for sid, d in daemons.items()})
+        assert router.ingest(_unique_vectors(8, seed=61))["ok"]
+        router.quiesce()
+        q = router.query(np.empty((0, 16), np.uint32))
+        assert q["labels"].shape == (0,)
+        assert q["known"].shape == (0,)
+        assert q["generation"] >= 1
+    finally:
+        _stop_shards(daemons)
+
+
+def test_router_query_all_foreign_rows(tmp_path):
+    """A FRESH router (empty global map — the failover shape: a
+    replacement router restarting over live shards) still answers
+    membership: every row known, every label the stable synthetic
+    foreign id below -1, and the induced partition equals the original
+    router's partition canonically."""
+    base = _unique_vectors(20, seed=67)
+    items = np.concatenate([base, base[[1, 4, 1]]])  # planted exact dups
+    daemons = _start_shards(tmp_path)
+    try:
+        transports = {sid: LocalTransport(d)
+                      for sid, d in daemons.items()}
+        original = ShardRouter(transports)
+        assert original.ingest(items)["ok"]
+        original.quiesce()
+        routed = original.query(items)
+        fresh = ShardRouter(transports)  # no gmap: every label foreign
+        q = fresh.query(items)
+        assert bool(q["known"].all())
+        assert bool((q["labels"] < -1).all()), \
+            "foreign labels must be synthetic ids below -1"
+        assert _canon(q["labels"]) == _canon(routed["labels"]), \
+            "foreign min-merge partition diverged from the routed one"
+    finally:
+        _stop_shards(daemons)
+
+
+def test_router_single_shard_topology_matches_unsharded_daemon(tmp_path):
+    """N=1 is not a special case: a one-shard router is elementwise
+    identical to talking to the daemon directly — same partition, same
+    membership, same row accounting."""
+    base = _unique_vectors(24, seed=71)
+    items = np.concatenate([base, base[[2, 9]]])
+    single = ServeDaemon(str(tmp_path / "single"), params=PARAMS).start()
+    shard = ServeDaemon(str(tmp_path / "range_0000"), params=PARAMS,
+                        state_commit_every=1).start()
+    try:
+        router = ShardRouter({0: LocalTransport(shard)})
+        for lo in range(0, len(items), 10):
+            s = single.ingest(items[lo:lo + 10])
+            r = router.ingest(items[lo:lo + 10])
+            assert s["ok"] and r["ok"] and r["acked"] == s["acked"]
+        single.quiesce()
+        router.quiesce()
+        qs = single.query(items)
+        qr = router.query(items)
+        assert bool(qs["known"].all()) and bool(qr["known"].all())
+        assert _canon(qr["labels"]) == _canon(qs["labels"])
+        assert int(shard._index.n_rows) == int(single._index.n_rows)
+        assert router.status()["shards"] == 1
+    finally:
+        single.stop(commit=False)
+        shard.stop(commit=False)
+
+
 def test_router_forward_drop_replays_ack_idempotently(tmp_path):
     """The lost-ack window: the shard committed and answered, the drop
     eats the answer before the router passes it up.  The retried SAME

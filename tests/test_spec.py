@@ -1,0 +1,289 @@
+"""graftspec (tse1m_tpu/spec): the executable-spec DSL, the
+explicit-state model checker, and the committed protocol specs.
+
+The load-bearing claims:
+
+- the DSL rejects malformed specs at construction (schedule-unsafe
+  action names, unknown seat kinds, duplicate actions, unfreezable
+  state);
+- the checker finds invariant violations with a shortest (BFS) trace,
+  liveness violations both as goal-false terminal states and as fair
+  lassos — and does NOT flag behaviors weak fairness permits;
+- symmetry reduction quotients interchangeable process ids without
+  losing violations;
+- every counterexample exports as a ``v1:fix:...`` graftrace schedule
+  string that parses back and REPLAYS through the machine to the
+  violating state;
+- the three committed specs (lease, ingest_ack, replica) pass their
+  invariants + liveness exhaustively, and every committed mutant is
+  caught with a replayed counterexample.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tse1m_tpu.spec import (MUTANT_BUILDERS, SPEC_BUILDERS, build_spec,
+                            check_all, mutant_selftest)
+from tse1m_tpu.spec.dsl import (Action, Invariant, Liveness, Spec,
+                                SpecError, freeze, state_key, tupset, upd)
+from tse1m_tpu.spec.mc import check, replay
+from tse1m_tpu.trace.sched import Schedule
+
+# -- DSL ---------------------------------------------------------------------
+
+def test_action_names_must_be_schedule_safe():
+    for bad in ("a,b", "a:b", "a b", "a\nb"):
+        with pytest.raises(SpecError, match="schedule-safe"):
+            Action(bad, guard=lambda s: True, effect=dict)
+
+
+def test_action_rejects_unknown_seat_kind():
+    with pytest.raises(SpecError, match="seat"):
+        Action("ok", guard=lambda s: True, effect=dict, seat="oops:x")
+    for good in ("fault:a.b", "verb:ingest", "call:fn", "model:crash"):
+        Action("ok", guard=lambda s: True, effect=dict, seat=good)
+
+
+def test_spec_rejects_duplicate_action_names():
+    a = Action("step", guard=lambda s: True, effect=dict)
+    with pytest.raises(SpecError, match="duplicate"):
+        Spec("toy", init={"x": 0}, actions=(a, a))
+
+
+def test_spec_action_lookup():
+    a = Action("step", guard=lambda s: True, effect=dict)
+    spec = Spec("toy", init={"x": 0}, actions=(a,))
+    assert spec.action("step") is a
+    with pytest.raises(SpecError, match="no action"):
+        spec.action("nope")
+
+
+def test_freeze_and_state_key():
+    assert freeze([1, [2, 3]]) == (1, (2, 3))
+    assert freeze({"b": 2, "a": {1, 3, 2}}) == (("a", (1, 2, 3)),
+                                                ("b", 2))
+    assert state_key({"x": [1, 2]}) == state_key({"x": (1, 2)})
+    with pytest.raises(SpecError, match="non-freezable"):
+        state_key({"x": bytearray(b"nope")})
+
+
+def test_upd_and_tupset_are_pure():
+    s = {"x": 1, "t": (0, 0)}
+    s2 = upd(s, x=2, t=tupset(s["t"], 1, 9))
+    assert s == {"x": 1, "t": (0, 0)}  # input untouched
+    assert s2 == {"x": 2, "t": (0, 9)}
+
+
+# -- toy machines for the checker --------------------------------------------
+
+def _counter(bound: int = 3, bad_at: int | None = None) -> Spec:
+    """x counts 0..bound; optionally an invariant that breaks at
+    ``bad_at`` (shortest trace = bad_at increments)."""
+    invs = ()
+    if bad_at is not None:
+        invs = (Invariant("below-bad", lambda s: s["x"] != bad_at),)
+    return Spec(
+        "counter", init={"x": 0},
+        actions=(Action("inc", guard=lambda s: s["x"] < bound,
+                        effect=lambda s: upd(s, x=s["x"] + 1)),),
+        invariants=invs,
+        liveness=(Liveness("saturates", lambda s: s["x"] == bound),))
+
+
+def _pingpong(finish_guard) -> Spec:
+    """at hops 0<->1 forever unless finish fires; goal is done."""
+    return Spec(
+        "pingpong", init={"at": 0, "done": False},
+        actions=(
+            Action("hop", fair=True,
+                   guard=lambda s: not s["done"],
+                   effect=lambda s: upd(s, at=1 - s["at"])),
+            Action("finish", fair=True, guard=finish_guard,
+                   effect=lambda s: upd(s, done=True)),
+        ),
+        liveness=(Liveness("eventually-done", lambda s: s["done"]),))
+
+
+def test_invariant_violation_shortest_bfs_trace():
+    r = check(_counter(bound=5, bad_at=3))
+    assert not r.ok and r.violation.kind == "invariant"
+    assert r.violation.prop == "below-bad"
+    assert r.violation.trace == ("inc", "inc", "inc")  # BFS: shortest
+    assert r.violation.state["x"] == 3
+    # DFS finds it too (trace need not be shortest, must replay).
+    rd = check(_counter(bound=5, bad_at=3), mode="dfs")
+    assert not rd.ok
+    assert replay(_counter(bound=5, bad_at=3),
+                  rd.violation.trace)[-1]["x"] == 3
+
+
+def test_clean_counter_passes_and_counts_states():
+    r = check(_counter(bound=3))
+    assert r.ok and r.complete
+    assert r.states == 4 and r.transitions == 3 and r.depth == 3
+
+
+def test_liveness_terminal_violation():
+    # Counter whose goal is never reached at its terminal state.
+    spec = Spec("stuck", init={"x": 0},
+                actions=(Action("inc", guard=lambda s: s["x"] < 1,
+                                effect=lambda s: upd(s, x=s["x"] + 1)),),
+                liveness=(Liveness("reaches-two",
+                                   lambda s: s["x"] == 2),))
+    r = check(spec)
+    assert not r.ok
+    assert r.violation.kind == "liveness" and not r.violation.cycle
+    assert r.violation.state["x"] == 1  # the terminal witness
+
+
+def test_liveness_fair_lasso_detected():
+    """Weak fairness does NOT save this machine: on the hop-hop cycle
+    ``finish`` is disabled at at==1, so the lasso starves nothing that
+    is CONTINUOUSLY enabled — a genuine violation, with the cycle in
+    the counterexample."""
+    r = check(_pingpong(lambda s: s["at"] == 0 and not s["done"]))
+    assert not r.ok and r.violation.kind == "liveness"
+    assert r.violation.cycle  # a lasso, not a terminal state
+    assert set(r.violation.cycle) == {"hop"}
+    # The exported schedule replays: trace to the cycle entry, then
+    # one full cycle, all enabled in order.
+    replay(_pingpong(lambda s: s["at"] == 0 and not s["done"]),
+           r.violation.schedule_str)
+
+
+def test_liveness_weak_fairness_excludes_always_enabled_action():
+    """With ``finish`` enabled at EVERY goal-false state, any lasso
+    that never takes it starves a continuously-enabled fair action —
+    weak fairness excludes it, and the spec passes."""
+    r = check(_pingpong(lambda s: not s["done"]))
+    assert r.ok, r.violation and r.violation.describe()
+
+
+def test_max_states_bound_reports_incomplete():
+    r = check(_counter(bound=100), max_states=10)
+    assert not r.complete and not r.ok and r.violation is None
+    assert r.states == 10
+
+
+def test_unknown_mode_raises():
+    with pytest.raises(SpecError, match="mode"):
+        check(_counter(), mode="random")
+
+
+# -- symmetry reduction ------------------------------------------------------
+
+def _two_flags(symmetric: bool) -> Spec:
+    """Two interchangeable processes each raise a flag once."""
+    def _sym(s, perm):
+        return upd(s, flags=tuple(s["flags"][perm[i]]
+                                  for i in range(2)))
+
+    return Spec(
+        "flags", init={"flags": (0, 0)},
+        actions=tuple(
+            Action(f"raise_p{p}",
+                   guard=lambda s, p=p: s["flags"][p] == 0,
+                   effect=lambda s, p=p: upd(
+                       s, flags=tupset(s["flags"], p, 1)))
+            for p in range(2)),
+        symmetry=_sym if symmetric else None,
+        n_symmetric=2 if symmetric else 0)
+
+
+def test_symmetry_reduction_quotients_states():
+    assert check(_two_flags(symmetric=False)).states == 4
+    assert check(_two_flags(symmetric=True)).states == 3  # (1,0)~(0,1)
+
+
+def test_symmetry_preserves_violations_modulo_renaming():
+    spec = _two_flags(symmetric=True)
+    spec = Spec(spec.name, spec.init, spec.actions,
+                invariants=(Invariant("never-both",
+                                      lambda s: sum(s["flags"]) < 2),),
+                symmetry=spec.symmetry, n_symmetric=spec.n_symmetric)
+    r = check(spec)
+    assert not r.ok and len(r.violation.trace) == 2
+    # The trace is valid modulo renaming — replay goes through the
+    # same canonicalization, so it must run.
+    states = replay(spec, r.violation.schedule_str)
+    assert sum(states[-1]["flags"]) == 2
+
+
+# -- counterexamples as graftrace schedules ----------------------------------
+
+def test_schedule_string_parses_and_replays():
+    r = check(_counter(bound=5, bad_at=2))
+    s = r.violation.schedule_str
+    assert s.startswith("v1:fix:")
+    assert Schedule.from_string(s).choices == ("inc", "inc")
+    states = replay(_counter(bound=5, bad_at=2), s)
+    assert states[-1]["x"] == 2
+
+
+def test_replay_rejects_disabled_action():
+    with pytest.raises(SpecError, match="diverged"):
+        replay(_counter(bound=1), ["inc", "inc"])  # second is disabled
+    with pytest.raises(SpecError, match="no action"):
+        replay(_counter(bound=1), ["nope"])
+
+
+# -- the committed protocol specs --------------------------------------------
+
+def test_real_specs_pass_exhaustively():
+    results = check_all()
+    assert {r.spec for r in results} == {"lease", "ingest_ack",
+                                         "replica"}
+    for r in results:
+        assert r.ok and r.complete, \
+            f"{r.spec}: {r.violation and r.violation.describe()}"
+        assert 0 < r.states < 10_000  # bounded by design
+    # DFS covers the identical state space.
+    for r, rd in zip(results, check_all(mode="dfs")):
+        assert (r.states, r.transitions) == (rd.states, rd.transitions)
+
+
+def test_real_specs_declare_code_seats():
+    """Every non-model action seat names the code it claims to model —
+    the shape the spec-conformance lint pass enforces against the tree
+    (tests/test_lint_interproc.py proves the tree side)."""
+    for name, builder in SPEC_BUILDERS.items():
+        spec = builder()
+        kinds = {a.seat.split(":", 1)[0] for a in spec.actions}
+        assert kinds & {"fault", "verb", "call"}, \
+            f"{name} models no code at all"
+        assert any(a.fair for a in spec.actions), \
+            f"{name} has no fair action — liveness would be vacuous"
+
+
+def test_mutant_selftest_catches_every_committed_bug():
+    records = mutant_selftest()
+    assert set(records) == set(MUTANT_BUILDERS) == {
+        "ack-before-journal", "fence-after-append", "manifest-first"}
+    for name, rec in records.items():
+        assert rec["caught"] and rec["replayed"], (name, rec)
+        assert Schedule.from_string(rec["schedule"]).choices
+    # Each mutant trips the property guarding its bug class.
+    assert records["ack-before-journal"]["prop"] == "durable-once"
+    assert records["fence-after-append"]["prop"] == "fence-before-append"
+    assert records["manifest-first"]["prop"] == "manifest-within-files"
+
+
+def test_build_spec_names_knowns_on_typo():
+    with pytest.raises(SpecError, match="lease"):
+        build_spec("leese")
+    with pytest.raises(SpecError, match="unknown spec"):
+        check_all(["leese"])
+
+
+# -- the CLI -----------------------------------------------------------------
+
+def test_cli_spec_exit_codes(capsys):
+    from tse1m_tpu.cli import main
+
+    assert main(["spec", "check"]) == 0
+    assert main(["spec", "mutants"]) == 0
+    assert main(["spec", "trace", "fence-after-append"]) == 1
+    out = capsys.readouterr().out
+    assert "lease" in out and "replay: v1:fix:" in out
+    assert main(["spec", "check", "nosuch"]) == 2

@@ -30,22 +30,46 @@ def _load_one(path: str) -> dict:
     streams logs above its final JSON) or the whole file."""
     with open(path) as f:
         text = f.read().strip()
+    if not text:
+        raise ValueError("file is empty")
     try:
         return json.loads(text.splitlines()[-1])
     except json.JSONDecodeError:
         return json.loads(text)
 
 
+def _fail_input(path: str, err: Exception) -> int:
+    """A missing/truncated/corrupt input is an operator mistake, not a
+    traceback: say which file, why, and how to mint a fresh baseline."""
+    reason = str(err) or type(err).__name__
+    print(f"bench: cannot read {path}: {reason} — check the path, or "
+          f"regenerate with `python -m tse1m_tpu.bench baseline "
+          f"<out.json> <run.json>...`", file=sys.stderr)
+    return 2
+
+
 def _cmd_diff(args) -> int:
-    a, b = _load_one(args.round_a), _load_one(args.round_b)
+    rounds = []
+    for path in (args.round_a, args.round_b):
+        try:
+            rounds.append(_load_one(path))
+        except (OSError, ValueError) as e:  # JSONDecodeError is a ValueError
+            return _fail_input(path, e)
+    a, b = rounds
     print(regress.diff(a, b, name_a=args.round_a, name_b=args.round_b,
                        show_all=args.all))
     return 0
 
 
 def _cmd_gate(args) -> int:
-    current = _load_one(args.current)
-    baseline = regress.load_runs(args.baseline)
+    try:
+        current = _load_one(args.current)
+    except (OSError, ValueError) as e:
+        return _fail_input(args.current, e)
+    try:
+        baseline = regress.load_runs(args.baseline)
+    except (OSError, ValueError) as e:
+        return _fail_input(args.baseline, e)
     report = regress.gate(current, baseline)
     if args.json:
         print(json.dumps(report, indent=2))

@@ -184,6 +184,10 @@ def _cmd_rq(args) -> int:
         # non-baselined finding fails THIS step (nonzero exit, full
         # summary in the record) while the RQs still run to completion.
         runner.run("graftlint", _lint_step)
+        # graftspec: model-check the committed protocol specs and run
+        # the mutant self-test, same ledger discipline — a violated
+        # invariant or a mutant the checker misses fails this step.
+        runner.run("graftspec", _spec_step)
     for name in wanted:
         mod_name, fn_name = specs[name]
         try:
@@ -219,6 +223,90 @@ def _lint_step() -> dict:
     summary = run_repo_lint()  # raises LintError on non-baselined findings
     summary["runtime"] = runtime
     return summary
+
+
+def _spec_step() -> dict:
+    """The ``cli all`` graftspec step: exhaustively model-check every
+    committed protocol spec and run the mutant self-test.  The summary
+    (per-spec state counts + per-mutant catch records) lands in the
+    manifest; a violated spec or an uncaught mutant fails the step."""
+    from .spec import SpecError, check_all, mutant_selftest
+
+    results = check_all()
+    summary = {"specs": [r.summary() for r in results],
+               "mutants": mutant_selftest()}
+    bad = [r for r in results if not r.ok]
+    if bad:
+        raise SpecError("; ".join(
+            f"{r.spec}: {r.violation.describe()}" for r in bad))
+    return summary
+
+
+def _cmd_spec(args) -> int:
+    """graftspec commands (`tse1m spec {check,trace,mutants}`).
+
+    ``check`` explores each spec's bounded state space and exits
+    nonzero on any invariant or liveness violation; ``trace`` prints a
+    violation's full counterexample plus its replayable graftrace
+    schedule string (works on mutants too, which is how you LOOK at a
+    protocol bug); ``mutants`` runs the committed protocol-bug mutants
+    and verifies each produces a violation whose counterexample replays
+    through the machine."""
+    import json
+
+    from .spec import SpecError, build_spec, check, mutant_selftest
+
+    if args.action == "mutants":
+        try:
+            records = mutant_selftest(mode=args.mode)
+        except SpecError as e:
+            log.error("%s", e)
+            return 1
+        for name, rec in records.items():
+            print(f"{name:24s} spec={rec['spec']:12s} caught "
+                  f"{rec['kind']}:{rec['prop']} in {rec['states']} "
+                  f"states, replayed: {rec['schedule']}")
+        return 0
+
+    names = list(args.names) or (["lease", "ingest_ack", "replica"]
+                                 if args.action == "check" else [])
+    if not names:
+        raise SystemExit("spec trace needs a spec or mutant name")
+    kwargs = {} if args.max_states is None \
+        else {"max_states": args.max_states}
+    results = []
+    for name in names:
+        try:
+            spec = build_spec(name)
+        except SpecError as e:
+            log.error("%s", e)
+            return 2
+        results.append((name, check(spec, mode=args.mode, **kwargs)))
+    bad = [(n, r) for n, r in results if not r.ok]
+    if args.action == "trace":
+        for name, r in results:
+            if r.violation is None:
+                print(f"{name}: no violation in {r.states} states "
+                      f"(scope {r.scope})")
+            else:
+                print(f"{name}:")
+                print(r.violation.describe())
+                print(f"replay: {r.violation.schedule_str}")
+        return 1 if bad else 0
+    if args.json:
+        print(json.dumps([dict(r.summary(), requested=n)
+                          for n, r in results]))
+    else:
+        for name, r in results:
+            status = ("ok" if r.ok else
+                      f"VIOLATION {r.violation.kind}:{r.violation.prop}")
+            print(f"{name:12s} {status}  states={r.states} "
+                  f"transitions={r.transitions} depth={r.depth} "
+                  f"wall={r.wall_s * 1000:.1f}ms")
+        for name, r in bad:
+            print(r.violation.describe())
+            print(f"replay: {r.violation.schedule_str}")
+    return 1 if bad else 0
 
 
 def _cmd_lint(args) -> int:
@@ -1061,6 +1149,22 @@ def main(argv=None) -> int:
     p.add_argument("--graph", action="store_true",
                    help="print the import/call-graph summary")
     p.set_defaults(fn=_cmd_lint)
+
+    p = sub.add_parser("spec",
+                       help="graftspec: model-check the executable "
+                            "protocol specs (README 'Protocol specs & "
+                            "model checking')")
+    p.add_argument("action", choices=("check", "trace", "mutants"))
+    p.add_argument("names", nargs="*",
+                   help="spec (or mutant) names; check defaults to all "
+                        "three committed specs")
+    p.add_argument("--mode", choices=("bfs", "dfs"), default="bfs",
+                   help="exploration order (BFS counterexamples are "
+                        "shortest)")
+    p.add_argument("--max-states", type=int, default=None,
+                   help="state-count safety valve (default 200000)")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=_cmd_spec)
 
     p = sub.add_parser("scrub",
                        help="walk a signature store: verify CRC frames, "

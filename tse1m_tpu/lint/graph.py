@@ -46,7 +46,7 @@ import os
 from dataclasses import dataclass, field
 
 CACHE_BASENAME = ".graftlint_cache.json"
-_CACHE_VERSION = 3  # bump when the FileFacts shape changes
+_CACHE_VERSION = 4  # bump when the FileFacts shape changes
 
 _SQL_EXEC_ATTRS = ("execute", "executemany", "executescript")
 _SQL_TOKENS = ("SELECT", "INSERT", "UPDATE", "DELETE", "CREATE", "DROP",
@@ -186,7 +186,8 @@ class _FactsVisitor:
                 "raises": [], "broad_handlers": [], "lock_sites": [],
                 "var_types": {}, "returns_call": None,
                 "param_defaults": {}, "param_annotations": {},
-                "attr_writes": [], "var_aliases": {}, "_env": env}
+                "attr_writes": [], "var_aliases": {}, "str_eqs": {},
+                "_env": env}
 
     def _fn(self) -> dict:
         return self._fn_stack[-1] if self._fn_stack else self._module_fn
@@ -410,6 +411,27 @@ class _FactsVisitor:
                 src = fn["var_types"].get(node.value.id)
                 if src:
                     fn["var_types"][t.id] = src
+        self._generic(node)
+
+    def _v_Compare(self, node: ast.Compare) -> None:
+        # `name == "literal"` (either side): the dispatch-table fact
+        # the verb-dispatch-drift pass reads off `_dispatch_op`-style
+        # functions.  Chained comparisons stay opaque on purpose.
+        if len(node.ops) == 1 and isinstance(node.ops[0], ast.Eq):
+            left, right = node.left, node.comparators[0]
+            name = value = None
+            if isinstance(left, ast.Name) \
+                    and isinstance(right, ast.Constant) \
+                    and isinstance(right.value, str):
+                name, value = left.id, right.value
+            elif isinstance(right, ast.Name) \
+                    and isinstance(left, ast.Constant) \
+                    and isinstance(left.value, str):
+                name, value = right.id, left.value
+            if name is not None:
+                eqs = self._fn()["str_eqs"].setdefault(name, [])
+                if value not in eqs:
+                    eqs.append(value)
         self._generic(node)
 
     def _v_Return(self, node: ast.Return) -> None:

@@ -1,6 +1,6 @@
 """graftlint's interprocedural passes: the pod-protocol verifier.
 
-Four whole-program analyses over the :mod:`graph` ProjectGraph, each a
+Whole-program analyses over the :mod:`graph` ProjectGraph, each a
 fixed-point dataflow over the resolved call graph.  Every finding
 carries a **witness chain** — the call path that proves it — surfaced
 by ``python -m tse1m_tpu.lint --why RULE:path:line``.
@@ -35,6 +35,11 @@ by ``python -m tse1m_tpu.lint --why RULE:path:line``.
   ``PRODUCTION_SEATS`` inventory in ``tests/ci_fault_matrix.py`` must
   agree — a new seat without a matrix entry, a dead matrix entry, or an
   unknown fault kind fails lint.
+- **spec-conformance** / **verb-dispatch-drift** (graftspec's static
+  layer, see the section comment above their passes): every protocol
+  spec action maps to a declared code seat and vice versa, and the
+  four serve dispatch surfaces agree exactly with the spec's verb
+  alphabets.
 
 Dynamic calls (``fn()`` on a bare callable parameter) stay opaque: the
 passes never guess, so a finding here is a real protocol hole, not a
@@ -714,6 +719,248 @@ def fault_seat_drift_pass(graph: ProjectGraph,
     return findings
 
 
+# -- graftspec conformance: the specs are load-bearing -----------------------
+#
+# A protocol spec (tse1m_tpu/spec/*.py, marked by a module-level
+# ``SPEC_NAME`` constant) declares one ``seat`` per action:
+# ``fault:<site>`` / ``verb:<op>`` / ``call:<leaf>`` / ``model:<tag>``.
+# ``spec-conformance`` holds both directions over the FileFacts graph:
+# every non-model seat must resolve to real code (a production
+# fault_point, a dispatch verb, a named function), and every fault
+# seat in a module that binds itself to specs via ``SPEC_MODELS``
+# must be claimed by one of them — dead spec actions and unmodeled
+# fault seats both fail lint.  ``verb-dispatch-drift`` is the verb
+# alphabet's exact-agreement check across all four serve surfaces.
+
+_VERB_SURFACES = (
+    # (alphabet constant, class leaf, method, how verbs are read)
+    ("SERVER_VERBS", "ServeServer", "_dispatch_op", "str_eqs"),
+    ("ROUTER_VERBS", "RouterServer", "_dispatch_op", "str_eqs"),
+    ("FORWARD_VERBS", "LocalTransport", "__call__", "str_eqs"),
+    ("CLIENT_VERBS", "ServeClient", None, "request"),
+)
+
+
+def _spec_modules(graph: ProjectGraph) -> dict:
+    """spec name -> (path, facts) for every module declaring a
+    ``SPEC_NAME`` string constant."""
+    out: dict = {}
+    for path, facts in sorted(graph.facts.items()):
+        name = facts["constants"].get("SPEC_NAME")
+        if isinstance(name, str):
+            out.setdefault(name, (path, facts))
+    return out
+
+
+def _spec_actions(facts: dict) -> list:
+    """(qual, call, action_name or None, seat) for every ``Action(...)``
+    construction in one spec module's facts."""
+    out = []
+    for fn in facts["functions"]:
+        for call in fn["calls"]:
+            if call["callee"].rsplit(".", 1)[-1] != "Action":
+                continue
+            args = call.get("args", [])
+            name = None
+            if args and args[0].get("kind") == "const":
+                name = args[0].get("value")
+            seat_fact = call.get("kwargs", {}).get("seat")
+            out.append((fn["qual"], call, name, seat_fact))
+    return out
+
+
+def _dispatch_verbs(graph: ProjectGraph):
+    """surface alphabet-constant name -> list of (qual, fn, verbs)."""
+    surfaces: dict = {name: [] for name, _c, _m, _h in _VERB_SURFACES}
+    for const, cls, meth, how in _VERB_SURFACES:
+        for qual, fn in sorted(graph.functions.items()):
+            if how == "str_eqs":
+                if fn.get("cls") != cls or fn["name"] != meth:
+                    continue
+                verbs = set(fn.get("str_eqs", {}).get("op", []))
+                surfaces[const].append((qual, fn, verbs))
+            else:  # ServeClient: const first arg of self.request(...)
+                if fn.get("cls") != cls:
+                    continue
+                verbs = set()
+                for call in fn["calls"]:
+                    if call["callee"] != "self.request":
+                        continue
+                    args = call.get("args", [])
+                    if args and args[0].get("kind") == "const" \
+                            and isinstance(args[0].get("value"), str):
+                        verbs.add(args[0]["value"])
+                if verbs:
+                    surfaces[const].append((qual, fn, verbs))
+    # A client's verbs live one per method: merge them per class.
+    merged = []
+    client = surfaces["CLIENT_VERBS"]
+    if client:
+        anchor = min(client, key=lambda t: t[1]["line"])
+        allverbs = set().union(*(v for _q, _f, v in client))
+        merged.append((anchor[0], anchor[1], allverbs))
+    surfaces["CLIENT_VERBS"] = merged
+    return surfaces
+
+
+def _verbs_alphabets(graph: ProjectGraph):
+    """(path, constants) of the spec verb-alphabet module, or None."""
+    for path, facts in sorted(graph.facts.items()):
+        if isinstance(facts["constants"].get("SERVER_VERBS"), list):
+            return path, facts["constants"]
+    return None
+
+
+def verb_dispatch_drift_pass(graph: ProjectGraph) -> list:
+    findings: list[Finding] = []
+    surfaces = _dispatch_verbs(graph)
+    if not any(surfaces.values()):
+        return findings  # fixture set without serve surfaces
+    alphabets = _verbs_alphabets(graph)
+    if alphabets is None:
+        qual, fn, _v = next(s for lst in surfaces.values()
+                            for s in lst)
+        return [_finding(
+            graph, "verb-dispatch-drift", qual, fn["line"], 0,
+            "serve dispatch surfaces exist but no spec verb alphabet "
+            "module (SERVER_VERBS/...) is in the linted set — the "
+            "verb protocol has no machine-checked source of truth")]
+    alpha_path, consts = alphabets
+    for const, _cls, _meth, _how in _VERB_SURFACES:
+        alphabet = consts.get(const)
+        for qual, fn, verbs in surfaces[const]:
+            if not isinstance(alphabet, list):
+                findings.append(_finding(
+                    graph, "verb-dispatch-drift", qual, fn["line"], 0,
+                    f"dispatch surface `{_cls_leaf(qual)}` has no "
+                    f"`{const}` alphabet in {alpha_path}"))
+                continue
+            missing = sorted(set(alphabet) - verbs)
+            extra = sorted(verbs - set(alphabet))
+            if not missing and not extra:
+                continue
+            drift = []
+            if missing:
+                drift.append("missing " + ", ".join(missing))
+            if extra:
+                drift.append("handles undeclared "
+                             + ", ".join(extra))
+            findings.append(_finding(
+                graph, "verb-dispatch-drift", qual, fn["line"], 0,
+                f"`{_cls_leaf(qual)}` drifted from the spec verb "
+                f"alphabet `{const}`: {'; '.join(drift)} — change "
+                f"{alpha_path} and every surface together",
+                witness=[f"{graph.site(qual)} handles: "
+                         + (", ".join(sorted(verbs)) or "<none>"),
+                         f"{alpha_path} {const}: "
+                         + ", ".join(alphabet)]))
+    return findings
+
+
+def spec_conformance_pass(graph: ProjectGraph) -> list:
+    findings: list[Finding] = []
+    specs = _spec_modules(graph)
+    if not specs:
+        return findings  # no spec modules in the linted set
+    sites, _seat_findings = _production_sites(graph)
+    surfaces = _dispatch_verbs(graph)
+    dispatch_verbs = set()
+    for lst in surfaces.values():
+        for _q, _f, verbs in lst:
+            dispatch_verbs |= verbs
+    code_leaves = {_leaf(q) for q in graph.functions}
+    claimed: dict[str, set] = {}  # spec name -> fault sites it models
+
+    def _label(name, call):
+        return f"action {name!r}" if name else \
+            f"action at col {call['col']}"
+
+    for spec_name, (_path, facts) in sorted(specs.items()):
+        claimed[spec_name] = set()
+        for qual, call, name, seat_fact in _spec_actions(facts):
+            if seat_fact is None:
+                continue  # defaulted seat (model:env)
+            if seat_fact.get("kind") != "const" \
+                    or not isinstance(seat_fact.get("value"), str):
+                findings.append(_finding(
+                    graph, "spec-conformance", qual, call["line"],
+                    call["col"],
+                    f"spec `{spec_name}` {_label(name, call)}: seat "
+                    "must be a string literal — conformance needs "
+                    "statically enumerable seats"))
+                continue
+            seat = seat_fact["value"]
+            kind, _sep, ref = seat.partition(":")
+            if kind == "model":
+                continue
+            if kind == "fault":
+                claimed[spec_name].add(ref)
+                if ref not in sites:
+                    findings.append(_finding(
+                        graph, "spec-conformance", qual, call["line"],
+                        call["col"],
+                        f"dead spec action: `{spec_name}` "
+                        f"{_label(name, call)} claims fault seat "
+                        f"`{ref}` but no production fault_point "
+                        "declares it"))
+            elif kind == "verb":
+                if ref not in dispatch_verbs:
+                    findings.append(_finding(
+                        graph, "spec-conformance", qual, call["line"],
+                        call["col"],
+                        f"dead spec action: `{spec_name}` "
+                        f"{_label(name, call)} models verb `{ref}` "
+                        "but no dispatch surface handles it"))
+            elif kind == "call":
+                if ref not in code_leaves:
+                    findings.append(_finding(
+                        graph, "spec-conformance", qual, call["line"],
+                        call["col"],
+                        f"dead spec action: `{spec_name}` "
+                        f"{_label(name, call)} references "
+                        f"`{ref}` but no such function exists"))
+            else:  # unknown kind (the DSL would reject it at runtime)
+                findings.append(_finding(
+                    graph, "spec-conformance", qual, call["line"],
+                    call["col"],
+                    f"spec `{spec_name}` {_label(name, call)} has "
+                    f"unknown seat kind `{kind}:` (want fault:/verb:/"
+                    "call:/model:)"))
+
+    # Reverse direction: modules that bind themselves to specs must
+    # have every fault seat claimed by one of them.
+    for path, facts in sorted(graph.facts.items()):
+        models = facts["constants"].get("SPEC_MODELS")
+        if not isinstance(models, list):
+            continue
+        mod_claimed: set = set()
+        anchor = facts["functions"][0]
+        for m in models:
+            if m not in specs:
+                findings.append(_finding(
+                    graph, "spec-conformance", anchor["qual"], 1, 0,
+                    f"{path} declares SPEC_MODELS spec `{m}` but no "
+                    "module carries SPEC_NAME = "
+                    f"{m!r}"))
+                continue
+            mod_claimed |= claimed.get(m, set())
+        for fn in facts["functions"]:
+            for call in fn["calls"]:
+                site = call.get("fault_site")
+                if site is None or site in mod_claimed:
+                    continue
+                findings.append(_finding(
+                    graph, "spec-conformance", fn["qual"],
+                    call["line"], call["col"],
+                    f"fault seat `{site}` is absent from every spec "
+                    f"this module declares ({', '.join(models)}) — "
+                    "model the failure or drop the SPEC_MODELS "
+                    "binding",
+                    witness=[f"{graph.site(fn['qual'], call)} "
+                             f"fault_point(\"{site}\")"]))
+    return findings
+
+
 # -- snapshot-publish / atomic-swap (graftrace's static layer) ---------------
 #
 # The serve/store planes' lock-free reads are safe only under a
@@ -1109,11 +1356,18 @@ PROJECT_PASSES = {
     "atomic-swap": (("atomic-swap",),
                     lambda graph, matrix_path=None:
                     atomic_swap_pass(graph)),
+    "spec-conformance": (("spec-conformance",),
+                         lambda graph, matrix_path=None:
+                         spec_conformance_pass(graph)),
+    "verb-dispatch-drift": (("verb-dispatch-drift",),
+                            lambda graph, matrix_path=None:
+                            verb_dispatch_drift_pass(graph)),
 }
 
 PROJECT_RULES = ("sql-interp", "retry-bypass", "lease-fence",
                  "lock-order", "fault-seat-drift", "snapshot-publish",
-                 "atomic-swap")
+                 "atomic-swap", "spec-conformance",
+                 "verb-dispatch-drift")
 
 
 def run_project_passes(graph: ProjectGraph,
@@ -1135,4 +1389,5 @@ def run_project_passes(graph: ProjectGraph,
 __all__ = ["MATRIX_DEFAULT", "PROJECT_PASSES", "PROJECT_RULES",
            "atomic_swap_pass", "fault_seat_drift_pass",
            "lease_fence_pass", "lock_order_pass", "run_project_passes",
-           "snapshot_publish_pass", "taint_pass"]
+           "snapshot_publish_pass", "spec_conformance_pass",
+           "taint_pass", "verb_dispatch_drift_pass"]

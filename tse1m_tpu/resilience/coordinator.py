@@ -69,6 +69,11 @@ from .watchdog import deadline_clock
 
 log = get_logger("resilience.coordinator")
 
+# graftspec binding: the epoch-lease protocol this module implements
+# is modeled by tse1m_tpu/spec/lease.py; the lint conformance pass
+# holds the two together.
+SPEC_MODELS = ("lease",)
+
 _HB_PREFIX = "hb_"
 _XCH_PREFIX = "xch_"
 

@@ -64,6 +64,10 @@ from .slo import AdmissionController, SloPolicy, SloTracker
 
 log = get_logger("serve.daemon")
 
+# graftspec binding: the lint conformance pass holds every fault seat
+# in this module to an action of these protocol specs (tse1m_tpu/spec/).
+SPEC_MODELS = ("ingest_ack", "lease")
+
 _RECOVER_CHUNK = 65536
 _CONTROL_COMMIT = "commit_state"
 

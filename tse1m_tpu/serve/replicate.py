@@ -52,6 +52,9 @@ from ..utils.logging import get_logger
 
 log = get_logger("serve.replicate")
 
+# graftspec binding: fault seats here must be modeled by these specs.
+SPEC_MODELS = ("replica",)
+
 _MANIFEST = "store_manifest.json"
 _STATE = "state.json"
 _RECOVER_CHUNK = 65536

@@ -60,6 +60,9 @@ from .server import (_Handler, decode_vectors, encode_vectors, read_msg,
 
 log = get_logger("serve.router")
 
+# graftspec binding: fault seats here must be modeled by these specs.
+SPEC_MODELS = ("ingest_ack",)
+
 _CONNECT_TIMEOUT_S = 5.0
 
 # Synthetic label space for cluster representatives the router never

@@ -120,6 +120,20 @@ class Schedule:
         return max(ready, key=lambda n: self._prio[n])
 
 
+def fixed_schedule_string(names) -> str:
+    """Export a decision-name sequence as a replayable ``v1:fix:...``
+    schedule string — the hook graftspec's model checker uses so a
+    spec-level counterexample round-trips through the SAME format the
+    explorer and ScheduleError replay lines speak.  Names must be
+    schedule-safe (no separator characters)."""
+    names = tuple(names)
+    for n in names:
+        if not n or any(ch in n for ch in ",:\n "):
+            raise ValueError(f"decision name {n!r} is not "
+                             "schedule-safe (no ',', ':' or whitespace)")
+    return Schedule.fixed(names).to_string()
+
+
 class _TState:
     __slots__ = ("name", "status", "blocked_on", "thread")
 
@@ -312,4 +326,5 @@ class DeterministicScheduler:
             self._cv.wait(_WAIT_SLICE_S)
 
 
-__all__ = ["DeterministicScheduler", "Schedule", "ScheduleError"]
+__all__ = ["DeterministicScheduler", "Schedule", "ScheduleError",
+           "fixed_schedule_string"]
