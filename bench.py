@@ -348,6 +348,18 @@ def main() -> int:
     p.add_argument("--serve-shards", type=int,
                    default=int(os.environ.get("BENCH_SERVE_SHARDS", 2)),
                    help="shard-daemon count for --serve-sharded")
+    p.add_argument("--topk", action="store_true",
+                   default=os.environ.get("BENCH_TOPK", "")
+                   not in ("", "0"),
+                   help="batched scoring round (cluster/kernels/score.py "
+                        "+ the topk serve verb): device/host top-k rank "
+                        "parity across schemes x quant bits, a "
+                        "sanitizer-clean bulk scan over the populated "
+                        "store asserted elementwise against the exact "
+                        "host oracle (topk_recall must be 1.0), and a "
+                        "candidate-path serve probe — emits "
+                        "bulk_score_rows_s / topk_recall / topk_p99_ms "
+                        "(also BENCH_TOPK=1)")
     p.add_argument("--scheme", default=os.environ.get("BENCH_SCHEME",
                                                       "kminhash"),
                    choices=("kminhash", "cminhash", "weighted"),
@@ -1162,6 +1174,141 @@ def main() -> int:
                     pass
             _shutil.rmtree(root, ignore_errors=True)
 
+    def bench_topk() -> dict:
+        """Batched scoring round (the topk-verb contract): the scoring
+        plane's three claims, each asserted — not sampled.
+
+        1. Rank parity: ``topk_agreement`` (device path) equals the
+           numpy oracle ELEMENTWISE across every scheme x quant-bits
+           combination — counts and rows, ties included.
+        2. Exact recall: a streamed ``bulk_topk_store`` scan over the
+           store the timed round populated equals ``score_topk_host``
+           over the concatenated shards (recall exactly 1.0, reported
+           from the actual set overlap, not assumed).  Under --sanitize
+           the timed scan runs inside ``sanitized(0)``: one warm pass,
+           then zero compiles and only the scorer's explicit wire-layer
+           transfers.
+        3. Serve-verb latency: 100 single-vector candidate-mode
+           ``topk`` probes against the live daemon — the interactive
+           path's p99 joins the gated keys next to serve_p99_ms."""
+        import contextlib
+        import shutil
+        import tempfile
+
+        import numpy as np
+
+        from dataclasses import replace
+
+        from tse1m_tpu.cluster.encode import quantize_ids
+        from tse1m_tpu.cluster.kernels.score import (bulk_topk_store,
+                                                     score_topk_host,
+                                                     topk_agreement)
+        from tse1m_tpu.cluster.schemes import (make_params,
+                                               scheme_host_signatures)
+        from tse1m_tpu.serve import ServeDaemon, SloPolicy
+
+        # 1) device/host rank parity across schemes x quant bits: the
+        # determinism contract (-count, ascending row) must survive
+        # every signature family and every degraded wire width.
+        combos = [(sc, qb)
+                  for sc in ("kminhash", "cminhash", "weighted")
+                  for qb in (0, 10, 8)]
+        rng = np.random.default_rng(args.seed)
+        for scheme, qbits in combos:
+            rows = rng.integers(0, 2**32, size=(96, 12), dtype=np.uint32)
+            if qbits:
+                rows = quantize_ids(rows, qbits)
+            sigs = scheme_host_signatures(
+                rows, make_params(scheme, 16, seed=args.seed))
+            q = sigs[:8]  # self-hits force known full-agreement ranks
+            ref = score_topk_host(q, sigs, 8)
+            got = topk_agreement(q, sigs, 8, use_pallas=params.use_pallas)
+            if not (np.array_equal(got[0], ref[0])
+                    and np.array_equal(got[1], ref[1])):
+                raise AssertionError(
+                    f"device/host top-k rank divergence "
+                    f"({scheme}, quant 2^{qbits or 32})")
+        parity = f"elementwise:{len(combos)}/{len(combos)}"
+
+        # 2) + 3) need a populated store and a live daemon: the same
+        # BATCH-path populate the serving round uses.
+        store_dir = tempfile.mkdtemp(prefix="tse1m_topk_")
+        n_store = min(args.n, 8192)
+        corpus = items[:n_store]
+        cluster_sessions(corpus, replace(params, sig_store=store_dir,
+                                         prefilter="off"))
+        daemon = ServeDaemon(store_dir, params=params,
+                             slo=SloPolicy.from_env()).start()
+        try:
+            store = daemon.reader
+            store.refresh()
+            nq = min(64, n_store)
+            probe = np.random.default_rng(args.seed + 1).integers(
+                0, n_store, size=nq)
+            q_sigs = daemon._sign_novel(corpus[probe])
+            k = 10
+            # Warm pass compiles the chunk scorer for this (query pad,
+            # k, chunk) shape; the timed pass must then be clean.
+            warm = bulk_topk_store(store, q_sigs, k,
+                                   use_pallas=params.use_pallas)
+            ctx = contextlib.nullcontext()
+            if args.sanitize:
+                from tse1m_tpu.lint.runtime import sanitized
+
+                ctx = sanitized(0)
+            t0 = time.perf_counter()
+            with ctx:
+                counts, rows_g = bulk_topk_store(store, q_sigs, k,
+                                                 use_pallas=params.use_pallas)
+            scan_wall = time.perf_counter() - t0
+            if not (np.array_equal(counts, warm[0])
+                    and np.array_equal(rows_g, warm[1])):
+                raise AssertionError("bulk scan is not deterministic "
+                                     "across repeat passes")
+            # Exact-recall oracle: every committed signature row, in
+            # scan order (sorted shard id), scored on the host.
+            all_sigs = np.concatenate(
+                [np.asarray(store._sig_mmap(int(e["id"])))
+                 for e in sorted(store.shards,
+                                 key=lambda e: int(e["id"]))])
+            ref_counts, ref_rows = score_topk_host(q_sigs, all_sigs, k)
+            if not (np.array_equal(counts, ref_counts)
+                    and np.array_equal(rows_g, ref_rows)):
+                raise AssertionError(
+                    "bulk store scan diverged from the host oracle — "
+                    "the scan path broke exact recall")
+            want = int((ref_rows >= 0).sum())
+            hit = sum(
+                len(set(g[g >= 0].tolist()) & set(r[r >= 0].tolist()))
+                for g, r in zip(rows_g, ref_rows))
+            recall = hit / max(want, 1)
+            if recall != 1.0:
+                raise AssertionError(f"topk_recall {recall} != 1.0")
+
+            # 3) candidate-path serve probe: single-vector topk against
+            # the live index, daemon-side histogram after a warm reset.
+            daemon.topk(corpus[:1], k=k, mode="candidates")
+            daemon.lat_topk.reset_window()
+            for i in np.random.default_rng(args.seed + 2).integers(
+                    0, n_store, size=100):
+                daemon.topk(corpus[int(i):int(i) + 1], k=k,
+                            mode="candidates")
+            tstats = daemon.lat_topk.snapshot()
+        finally:
+            daemon.stop(commit=False)
+            shutil.rmtree(store_dir, ignore_errors=True)
+        return {
+            "topk_parity": parity,
+            "bulk_score_rows_s": round(
+                n_store * nq / max(scan_wall, 1e-9), 1),
+            "topk_recall": recall,
+            "topk_p99_ms": tstats["p99_ms"],
+            "topk_scan_rows": int(n_store),
+            "topk_scan_queries": int(nq),
+            "topk_candidate_probes": int(tstats["count"]),
+            "topk_sanitized": bool(args.sanitize),
+        }
+
     def bench_schemes() -> dict:
         """Scheme-comparison round (the BENCH_r09 contract): every member
         of the kernel family over the same planted corpus — signature
@@ -1317,6 +1464,10 @@ def main() -> int:
     if args.serve_sharded:
         sharded_stats = bench_serve_sharded()
 
+    topk_stats = {}
+    if args.topk:
+        topk_stats = bench_topk()
+
     trace_stats = {}
     if args.traced:
         # Bounded deterministic-schedule sweep over the serve/store
@@ -1395,6 +1546,7 @@ def main() -> int:
     result.update(warm_stats)
     result.update(serve_stats)
     result.update(sharded_stats)
+    result.update(topk_stats)
     result.update(trace_stats)
     result.update(scheme_stats)
     result["scheme"] = params.scheme

@@ -1,0 +1,8 @@
+"""No raw transfers: out-of-plane code feeds the blessed wire layer
+(here the scoring plane's streaming entry point) instead of opening its
+own host<->device link."""
+from tse1m_tpu.cluster.kernels.score import bulk_topk_store
+
+
+def rank(store, query_sigs, k):
+    return bulk_topk_store(store, query_sigs, k)

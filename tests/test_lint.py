@@ -94,6 +94,10 @@ def _rule_findings(rule: str, filename: str, relpath: str | None = None):
      "tse1m_tpu/serve/router.py"),
     ("serve-write-plane", "bad_replica_adopt.py", "good_replica_adopt.py",
      "tse1m_tpu/serve/replicate.py"),
+    # Batched scoring plane: an out-of-plane device_put still fires;
+    # the good fixture routes through the blessed scorer entry point.
+    ("wire-layer", "bad_wire_layer.py", "good_wire_layer.py",
+     "tse1m_tpu/serve/daemon.py"),
 ])
 def test_rule_bad_fires_good_silent(rule, bad, good, spoof):
     assert _rule_findings(rule, bad, spoof), f"{rule} missed {bad}"
@@ -122,6 +126,16 @@ def test_wire_layer_admits_wire_v3_seats():
         assert not _rule_findings("wire-layer", "bad_wire_layer.py", seat)
     assert _rule_findings("wire-layer", "bad_wire_layer.py",
                           "tse1m_tpu/cluster/kernels/rans.py")
+
+
+def test_wire_layer_admits_scoring_plane_seat():
+    # The batched scorer's double-buffered chunk staging IS the topk
+    # scan's transfer path — a blessed seat; the OTHER kernels/ modules
+    # stay transfer-free and keep firing.
+    assert not _rule_findings("wire-layer", "bad_wire_layer.py",
+                              "tse1m_tpu/cluster/kernels/score.py")
+    assert _rule_findings("wire-layer", "bad_wire_layer.py",
+                          "tse1m_tpu/cluster/kernels/minhash_topk.py")
 
 
 def test_scheme_parity_kernel_modules_exempt():

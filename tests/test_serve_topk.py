@@ -1,0 +1,207 @@
+"""The ``topk`` serve verb end to end (daemon, TCP server/client,
+2-shard router fan-out, read replica).
+
+The load-bearing claims:
+
+- scan mode is EXACT: its top-k equals the host oracle over the
+  daemon's store rows (recall 1.0), and the candidate path's hits are
+  always a subset scored identically;
+- the router's merged scan answer is elementwise-equal (ids AND
+  scores) to a single unsharded daemon over the same rows, given
+  planted strict score separation at the k boundary (agreement-count
+  ties at the boundary are row-order dependent per shard — the
+  documented caveat);
+- a replica answers ``topk`` read-only over its streamed copy;
+- the wire contract holds over real TCP (hex digest ids, -1/"" pads,
+  np-typed scores/labels client-side), and ``status`` splits latency
+  per verb instead of one blended histogram.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tse1m_tpu.cluster import ClusterParams
+from tse1m_tpu.cluster.kernels.score import score_topk_host
+from tse1m_tpu.serve import (LocalTransport, ServeClient, ServeDaemon,
+                             ServeError, ServeReplica, ServeServer,
+                             ShardRouter, stream_shards)
+
+PARAMS = ClusterParams(n_hashes=32, n_bands=4, use_pallas="never")
+
+
+def _planted(n_family: int = 12, n_filler: int = 40, seed: int = 5,
+             width: int = 16):
+    """(vectors, queries): a corruption ladder around one base vector —
+    row i of the family disagrees with the base on exactly i positions,
+    so agreement counts are strictly separated (router merge parity
+    needs no boundary ties) — plus content-distinct filler."""
+    rng = np.random.default_rng(seed)
+    base = rng.integers(0, 2**32, size=(1, width), dtype=np.int64
+                        ).astype(np.uint32)
+    fam = np.repeat(base, n_family, axis=0)
+    for i in range(n_family):
+        fam[i, :i] = rng.integers(1, 2**32, size=i,
+                                  dtype=np.int64).astype(np.uint32)
+    filler = rng.integers(0, 2**32, size=(n_filler, width),
+                          dtype=np.int64).astype(np.uint32)
+    return np.concatenate([fam, filler]), base
+
+
+def _store_sigs(daemon: ServeDaemon) -> np.ndarray:
+    """Every committed signature row in scan order (sorted shard id)."""
+    store = daemon.reader
+    store.refresh()
+    return np.concatenate(
+        [np.asarray(store._sig_mmap(int(e["id"])))
+         for e in sorted(store.shards, key=lambda e: int(e["id"]))])
+
+
+# -- daemon verb -------------------------------------------------------------
+
+def test_daemon_scan_matches_host_oracle(tmp_path):
+    vecs, q = _planted()
+    d = ServeDaemon(str(tmp_path / "s"), params=PARAMS,
+                    state_commit_every=1, signer="host").start()
+    try:
+        d.ingest(vecs, timeout=60)
+        res = d.topk(q, k=5, mode="scan")
+        # exact-recall contract: scores equal the host oracle's over
+        # every committed store row
+        ref_counts, _ = score_topk_host(
+            d._sign_novel(q), _store_sigs(d), 5)
+        assert res["scores"] == ref_counts.tolist()
+        assert res["scores"][0][0] == PARAMS.n_hashes  # self-hit
+        assert all(len(r) == 5 for r in res["ids"])
+        assert all(len(i) == 32 for i in res["ids"][0])  # hex digests
+        # candidate mode scores any hit it finds identically (a subset
+        # of the scan's universe — here the self-hit at full agreement)
+        cand = d.topk(q, k=5, mode="candidates")
+        assert cand["scores"][0][0] == PARAMS.n_hashes
+        assert cand["ids"][0][0] == res["ids"][0][0]
+        with pytest.raises(ValueError):
+            d.topk(q, k=3, mode="nope")
+    finally:
+        d.stop(commit=False)
+
+
+def test_daemon_topk_edges(tmp_path):
+    vecs, q = _planted(n_family=3, n_filler=5)
+    d = ServeDaemon(str(tmp_path / "s"), params=PARAMS,
+                    state_commit_every=1, signer="host").start()
+    try:
+        d.ingest(vecs, timeout=60)
+        empty = d.topk(np.zeros((0, 16), np.uint32), k=4, mode="scan")
+        assert empty["scores"] == [] and empty["ids"] == []
+        k0 = d.topk(q, k=0, mode="scan")
+        assert k0["scores"] == [[]]
+        # k past the row count pads with ("", -1, -1)
+        big = d.topk(q, k=20, mode="scan")
+        n = vecs.shape[0]
+        assert big["scores"][0][n:] == [-1] * (20 - n)
+        assert big["ids"][0][n:] == [""] * (20 - n)
+        assert big["labels"][0][n:] == [-1] * (20 - n)
+    finally:
+        d.stop(commit=False)
+
+
+# -- router fan-out parity ---------------------------------------------------
+
+def test_router_topk_parity_vs_single_daemon(tmp_path):
+    # Two independent corruption ladders: both probes see strictly
+    # separated top-6 scores (ties only start past each family's size).
+    fam_a, base_a = _planted(seed=5)
+    fam_b, base_b = _planted(seed=6)
+    vecs = np.concatenate([fam_a, fam_b])
+    q = np.concatenate([base_a, base_b])
+    single = ServeDaemon(str(tmp_path / "single"), params=PARAMS,
+                         state_commit_every=1, signer="host").start()
+    shards = {sid: ServeDaemon(str(tmp_path / f"range_{sid:04d}"),
+                               params=PARAMS, state_commit_every=1,
+                               signer="host").start() for sid in (0, 1)}
+    try:
+        single.ingest(vecs, timeout=60)
+        router = ShardRouter({s: LocalTransport(d)
+                              for s, d in shards.items()})
+        router.ingest(vecs, timeout=60)
+        ref = single.topk(q, k=6, mode="scan")
+        got = router.topk(q, k=6, mode="scan")
+        # elementwise: same digests in the same order with same scores
+        assert got["ids"] == ref["ids"]
+        assert got["scores"] == ref["scores"]
+        assert set(got["shard_generations"]) == {0, 1}
+        # candidate mode fans out the same way (self-hit from the
+        # owning shard ranks first at full agreement)
+        cand = router.topk(base_a, k=3, mode="candidates")
+        assert cand["scores"][0][0] == PARAMS.n_hashes
+        assert cand["ids"][0][0] == ref["ids"][0][0]
+    finally:
+        single.stop(commit=False)
+        for d in shards.values():
+            d.stop(commit=False)
+
+
+# -- replica -----------------------------------------------------------------
+
+def test_replica_answers_topk_read_only(tmp_path):
+    vecs, q = _planted()
+    src = str(tmp_path / "writer")
+    dst = str(tmp_path / "replica")
+    d = ServeDaemon(src, params=PARAMS, state_commit_every=1,
+                    signer="host").start()
+    try:
+        d.ingest(vecs, timeout=60)
+        d.quiesce(timeout=60)
+        ref = d.topk(q, k=4, mode="scan")
+    finally:
+        d.stop()
+    stream_shards(src, dst)
+    rep = ServeReplica(dst, params=PARAMS)
+    for mode in ("scan", "candidates"):
+        res = rep.topk(q, k=4, mode=mode)
+        assert res["scores"][0][0] == PARAMS.n_hashes
+    assert rep.topk(q, k=4, mode="scan")["ids"] == ref["ids"]
+    st = rep.status()
+    assert st["read_only"] is True
+    assert st["latency_by_verb"]["topk"]["count"] >= 3
+    with pytest.raises(RuntimeError):
+        rep.ingest(q)
+
+
+# -- TCP wire contract + per-verb latency ------------------------------------
+
+def test_topk_over_tcp_and_per_verb_status(tmp_path):
+    import threading
+
+    vecs, q = _planted()
+    d = ServeDaemon(str(tmp_path / "s"), params=PARAMS,
+                    state_commit_every=1, signer="host").start()
+    server = ServeServer(d)
+    t = threading.Thread(target=server.serve_forever,
+                         kwargs={"poll_interval": 0.05}, daemon=True)
+    t.start()
+    try:
+        with ServeClient(port=server.port) as client:
+            client.ingest(vecs)
+            client.query(q)
+            res = client.topk(q, k=3, mode="scan")
+            assert isinstance(res["scores"], np.ndarray)
+            assert isinstance(res["labels"], np.ndarray)
+            assert res["scores"].shape == (1, 3)
+            assert res["scores"][0, 0] == PARAMS.n_hashes
+            assert len(res["ids"][0][0]) == 32
+            assert res["generation"] >= 1
+            with pytest.raises(ServeError):
+                client.topk(q, k=3, mode="bogus")
+            st = client.status()
+            lbv = st["latency_by_verb"]
+            assert lbv["topk"]["count"] == 1
+            assert lbv["query"]["count"] == 1
+            assert lbv["ingest"]["count"] >= 1
+            # the flat summary keys ride along for the bench schema
+            assert st["serve_topk_count"] == 1
+    finally:
+        server.shutdown()
+        server.server_close()
+        d.stop(commit=False)

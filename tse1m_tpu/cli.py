@@ -900,6 +900,14 @@ def _cmd_serve(args) -> int:
         rec = runner.run("serve_status", status_step)
         if rec.result is not None:
             print(json.dumps(rec.result))
+            # Per-verb latency at a glance (query vs topk vs ingest) —
+            # one blended histogram hides a slow verb behind a fast one.
+            for verb, snap in sorted(
+                    (rec.result.get("latency_by_verb") or {}).items()):
+                log.info("serve %s: n=%d p50=%.2fms p99=%.2fms",
+                         verb, int(snap.get("count", 0)),
+                         float(snap.get("p50_ms", 0.0)),
+                         float(snap.get("p99_ms", 0.0)))
         return 0 if rec.status == "ok" else 1
 
     store = args.sig_store or cfg.sig_store
@@ -1064,12 +1072,16 @@ def _cmd_serve_client(args) -> int:
     import numpy as np
 
     with _serve_client(args) as client:
-        if args.op in ("query", "ingest"):
+        if args.op in ("query", "topk", "ingest"):
             if not args.npy:
                 raise SystemExit(f"{args.op} needs --npy <vectors.npy>")
             vectors = np.load(args.npy)
-            resp = (client.query(vectors) if args.op == "query"
-                    else client.ingest(vectors))
+            if args.op == "query":
+                resp = client.query(vectors)
+            elif args.op == "topk":
+                resp = client.topk(vectors, k=args.k, mode=args.mode)
+            else:
+                resp = client.ingest(vectors)
             resp = {k: (v.tolist() if isinstance(v, np.ndarray) else v)
                     for k, v in resp.items()}
         elif args.op == "slowlog":
@@ -1080,6 +1092,76 @@ def _cmd_serve_client(args) -> int:
             resp = getattr(client, args.op)()
     print(json.dumps(resp))
     return 0 if resp.get("ok", False) else 1
+
+
+def _cmd_backfill(args) -> int:
+    """Bulk re-label via the exact scoring plane (`tse1m backfill`).
+
+    For every query vector in ``--npy``, device-scans EVERY committed
+    store row by exact signature agreement (`cluster.kernels.score
+    .bulk_topk_store` — the recall-1.0 path, no band-candidate loss)
+    and reports the k nearest stored sessions as (digest, agreement,
+    label) triples — the re-label/backfill primitive: assign each
+    unlabeled session its nearest cluster without waiting for the daily
+    batch recluster.
+
+    Two targets: ``--sig-store DIR`` scans a store directory in-process
+    (read-only — safe next to a live writer), or ``--port``/
+    ``--port-file`` drives a running daemon/router over TCP via the
+    ``topk`` verb in scan mode."""
+    import json
+    import time
+
+    import numpy as np
+
+    vectors = np.load(args.npy)
+    n = int(vectors.shape[0])
+    out = {"scores": [], "ids": [], "labels": []}
+    t0 = time.monotonic()
+    rows_scored = 0
+    if args.sig_store:
+        from .cluster import ClusterParams
+        from .serve import ServeReplica
+
+        target = ServeReplica(args.sig_store,
+                              params=ClusterParams(seed=args.seed))
+        store_rows = int(target.store.n_rows)
+
+        def ask(batch):
+            return target.topk(batch, k=args.k, mode="scan")
+    else:
+        client = _serve_client(args)
+        store_rows = int(client.status().get("store_rows", 0))
+
+        def ask(batch):
+            return client.topk(batch, k=args.k, mode="scan",
+                               timeout_s=args.timeout)
+    for lo in range(0, n, args.batch):
+        resp = ask(np.ascontiguousarray(vectors[lo:lo + args.batch],
+                                        np.uint32))
+        out["scores"].extend(np.asarray(resp["scores"]).tolist())
+        out["labels"].extend(np.asarray(resp["labels"]).tolist())
+        out["ids"].extend(resp["ids"])
+        rows_scored += store_rows * int(
+            min(args.batch, n - lo))
+    wall = time.monotonic() - t0
+    if args.out:
+        from .utils.atomic import atomic_write
+
+        with atomic_write(args.out) as f:
+            json.dump(out, f)
+    summary = {"ok": True, "queries": n, "k": int(args.k),
+               "store_rows": store_rows,
+               "pairs_scored": rows_scored,
+               "wall_s": round(wall, 3),
+               "pairs_scored_s": round(rows_scored / wall, 1)
+               if wall > 0 else 0.0}
+    if args.out:
+        summary["out"] = args.out
+    else:
+        summary["results"] = out
+    print(json.dumps(summary))
+    return 0
 
 
 def main(argv=None) -> int:
@@ -1232,21 +1314,55 @@ def main(argv=None) -> int:
     p = sub.add_parser("serve-client",
                        help="one client request against a running serve "
                             "daemon")
-    p.add_argument("op", choices=("ping", "status", "query", "ingest",
-                                  "metrics", "trace", "slowlog", "profile",
-                                  "quiesce", "shutdown"))
+    p.add_argument("op", choices=("ping", "status", "query", "topk",
+                                  "ingest", "metrics", "trace", "slowlog",
+                                  "profile", "quiesce", "shutdown"))
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--port-file", default=None)
     p.add_argument("--npy", default=None,
                    help="[K, S] uint32 .npy of coverage vectors "
-                        "(query/ingest)")
+                        "(query/topk/ingest)")
+    p.add_argument("--k", type=int, default=10,
+                   help="topk: neighbours per query vector")
+    p.add_argument("--mode", default="candidates",
+                   choices=("candidates", "scan"),
+                   help="topk: band-candidate probe (interactive) or "
+                        "exact full-store device scan (recall 1.0)")
     p.add_argument("--limit", type=int, default=None,
                    help="slowlog: at most N most-recent captures")
     p.add_argument("--dump", action="store_true",
                    help="profile: also write profile_NNN.json daemon-side "
                         "and return its path")
     p.set_defaults(fn=_cmd_serve_client)
+
+    p = sub.add_parser("backfill",
+                       help="bulk re-label: exact top-k device scan of a "
+                            "signature store for every query vector "
+                            "(README 'Top-k search & bulk scoring')")
+    p.add_argument("--npy", required=True,
+                   help="[K, S] uint32 .npy of coverage vectors to "
+                        "re-label")
+    p.add_argument("--sig-store", default=None,
+                   help="scan this store directory in-process "
+                        "(read-only); otherwise --port/--port-file "
+                        "drives a running daemon's topk verb")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--port-file", default=None)
+    p.add_argument("--k", type=int, default=1,
+                   help="nearest stored sessions per query (default 1: "
+                        "the re-label assignment)")
+    p.add_argument("--batch", type=int, default=256,
+                   help="query vectors per scan pass")
+    p.add_argument("--timeout", type=float, default=None,
+                   help="TCP mode: per-batch budget override (scan "
+                        "requests default to the ingest-class budget)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--out", default=None,
+                   help="write full (scores, ids, labels) JSON here "
+                        "(atomic); default prints them inline")
+    p.set_defaults(fn=_cmd_backfill)
 
     p = sub.add_parser("serve-router",
                        help="stateless fan-out router over digest-range "

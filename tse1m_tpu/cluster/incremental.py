@@ -523,6 +523,50 @@ class LiveClusterIndex:
         np.minimum.at(acc, q[ok], hub_lab)
         return np.where(acc == sentinel, np.int64(-1), acc)
 
+    def topk(self, sigs: np.ndarray, keys: np.ndarray, gather_sigs,
+             k: int) -> tuple[np.ndarray, np.ndarray]:
+        """Per-query top-k index rows by exact signature agreement over
+        the band-candidate set (the serve ``topk`` verb's low-latency
+        host path): probe every band's bucket for hub rows, gather their
+        stored signatures, rank by (-agreement count, ascending index
+        row).  Returns (counts [Q, k] int32, rows [Q, k] int32), both
+        ``-1``-padded past the candidate count.
+
+        Candidates are bucket REPRESENTATIVES (one hub per distinct
+        band key), so recall is bounded by the hub structure — the
+        exact-recall surface is the full store scan
+        (`cluster.kernels.score.bulk_topk_store`)."""
+        nq = int(sigs.shape[0])
+        k = int(k)
+        counts_out = np.full((nq, k), -1, np.int32)
+        rows_out = np.full((nq, k), -1, np.int32)
+        if nq == 0 or k == 0:
+            return counts_out, rows_out
+        q, hub = self.candidate_hubs(keys)
+        if q.size == 0:
+            return counts_out, rows_out
+        # One hub can hit a query in several bands: dedupe the pairs so
+        # a row is ranked once per query.
+        pair = q * np.int64(self.n_rows + 1) + hub
+        sel = np.unique(pair, return_index=True)[1]
+        q, hub = q[sel], hub[sel]
+        uniq, inv = np.unique(hub, return_inverse=True)
+        hub_sigs = gather_sigs(uniq)
+        if hub_sigs is None:          # store raced (eviction): all miss
+            return counts_out, rows_out
+        agree = (sigs[q] == hub_sigs[inv]).sum(axis=1).astype(np.int32)
+        # (-agreement, ascending row) within each query — the scorer
+        # kernels' selection order exactly.
+        order = np.lexsort((hub, -agree, q))
+        qs, ag, hb = q[order], agree[order], hub[order]
+        first = np.flatnonzero(np.r_[True, qs[1:] != qs[:-1]])
+        runs = np.diff(np.r_[first, qs.size])
+        rank = np.arange(qs.size) - np.repeat(first, runs)
+        keep = rank < k
+        counts_out[qs[keep], rank[keep]] = ag[keep]
+        rows_out[qs[keep], rank[keep]] = hb[keep].astype(np.int32)
+        return counts_out, rows_out
+
 
 def _empty_digest_struct() -> np.ndarray:
     return np.empty(0, np.dtype([("a", "<u8"), ("b", "<u8")]))

@@ -4,19 +4,32 @@ The fused MinHash/band-key kernels live in ``cluster/minhash_pallas.py``
 (re-exported here so callers can treat this package as the kernel
 namespace); ``rans.py`` adds the wire-v3 entropy decoders — a jnp
 ``fori_loop`` reference and a pallas variant — fused into the pipeline's
-packed-unpack path.  Kernels never open their own transfers: every
-device_put stays in the blessed wire layer (cluster/encode.py,
-cluster/entropy.py, cluster/prefilter.py, cluster/pipeline.py — the
-graftlint ``wire-layer`` rule).
+packed-unpack path; ``score.py`` is the batched scoring plane — exact
+top-k signature agreement with the same three-implementation parity
+contract, streaming the mmap'd store through the device.
+
+Transfer discipline: the encode/decode kernels never open their own
+transfers (every device_put stays in the blessed wire layer —
+cluster/encode.py, cluster/entropy.py, cluster/prefilter.py,
+cluster/pipeline.py; the graftlint ``wire-layer`` rule).  ``score.py``
+is the ONE kernel module with its own wire-layer seat: its streaming
+store scan IS a transfer plane (double-buffered h2d chunk staging), so
+it stages explicitly instead of routing through the pipeline.
 """
 
 from ..minhash_pallas import (minhash_and_keys, minhash_and_keys_packed,
                               minhash_and_keys_pallas)
 from .rans import decode_lane_device
+from .score import (bulk_topk_store, score_topk_host, store_scan_locator,
+                    topk_agreement)
 
 __all__ = [
     "minhash_and_keys",
     "minhash_and_keys_packed",
     "minhash_and_keys_pallas",
     "decode_lane_device",
+    "score_topk_host",
+    "topk_agreement",
+    "bulk_topk_store",
+    "store_scan_locator",
 ]

@@ -875,6 +875,21 @@ class SignatureStore:
             out[sel[order]] = self._sig_mmap(int(sid))[rows[order]]
         return out
 
+    def load_digests(self, shard: np.ndarray, row: np.ndarray) -> np.ndarray:
+        """Gather [K, 2] uint64 digests by (shard, row) pairs — the key
+        files are the authoritative row identity, so the serve ``topk``
+        verb answers in digests, not store rows.  Same per-shard sorted
+        gather as `load_signatures` so the mmap reads pages
+        sequentially."""
+        k = int(shard.shape[0])
+        out = np.empty((k, 2), np.uint64)
+        for sid in np.unique(shard):
+            sel = np.flatnonzero(shard == sid)
+            rows = row[sel]
+            order = np.argsort(rows, kind="stable")
+            out[sel[order]] = self._key_mmap(int(sid))[rows[order]]
+        return out
+
     # -- append -------------------------------------------------------------
 
     def journal_record(self, request_id: str, entry: dict) -> None:

@@ -410,10 +410,14 @@ def host_in_jit(src: FileSource) -> list[Finding]:
 # be bypassed.  Wire v3 admits the entropy codec and the host prefilter
 # as the only new seats (their frames/masks ARE wire format; today both
 # stay host-side and route their puts through pipeline.py, but the
-# format modules are part of the plane they define).
+# format modules are part of the plane they define).  The batched
+# scoring plane (kernels/score.py) is the one kernel module with its own
+# seat: its double-buffered chunk staging IS the topk scan's transfer
+# path (the other kernels/ modules stay transfer-free and keep firing).
 _WIRE_LAYER = ("tse1m_tpu/cluster/encode.py", "tse1m_tpu/cluster/pipeline.py",
                "tse1m_tpu/cluster/entropy.py",
-               "tse1m_tpu/cluster/prefilter.py")
+               "tse1m_tpu/cluster/prefilter.py",
+               "tse1m_tpu/cluster/kernels/score.py")
 
 
 def wire_layer(src: FileSource) -> list[Finding]:

@@ -180,6 +180,20 @@ BENCH_SCHEMA: dict = {
                                    tol=0.0, abs_slack=0.0,
                                    desc="acked rows lost across a shard "
                                         "writer failover (must be 0)"),
+    # batched scoring plane (this PR)
+    "bulk_score_rows_s": _k(("serve",), "higher", gate=True, tol=0.75,
+                            desc="store row-visits/s in the sanitized "
+                                 "bulk top-k scan"),
+    "topk_p99_ms": _k(("serve",), "lower", gate=True, tol=1.0,
+                      abs_slack=1.0,
+                      desc="candidate-path topk verb p99"),
+    "topk_recall": _k(("serve",), "higher", gate=True, tol=0.0,
+                      abs_slack=0.0,
+                      desc="scan top-k vs the exact host oracle "
+                           "(must stay 1.0)"),
+    "topk_parity": _k(("serve",),
+                      desc="device/host rank parity sweep over "
+                           "schemes x quant bits"),
 }
 
 

@@ -128,6 +128,23 @@ class ServeClient:
         resp["known"] = np.asarray(resp["known"], bool)
         return resp
 
+    def topk(self, vectors: np.ndarray, k: int = 10,
+             mode: str = "candidates",
+             timeout_s: float | None = None) -> dict:
+        """The k nearest stored sessions per vector, by exact signature
+        agreement.  ``scores``/``labels`` come back as [Q, k] int arrays
+        (-1 padded); ``ids`` stays a [Q][k] list of digest hex strings
+        ("" padding).  ``mode="scan"`` is the exact full-store path —
+        budgeted as an ingest-class (bulk) request, not a query."""
+        cls = "query" if mode == "candidates" else "ingest"
+        resp = self.request(
+            "topk",
+            timeout_s=timeout_s or request_budget_s(cls) or None,
+            k=int(k), mode=str(mode), **encode_vectors(vectors))
+        resp["scores"] = np.asarray(resp["scores"], np.int64)
+        resp["labels"] = np.asarray(resp["labels"], np.int64)
+        return resp
+
     def ingest(self, vectors: np.ndarray,
                timeout_s: float | None = None,
                request_id: str | None = None) -> dict:

@@ -72,6 +72,110 @@ _RECOVER_CHUNK = 65536
 _CONTROL_COMMIT = "commit_state"
 
 
+def _labels_by_locator(index, loc: np.ndarray,
+                       ok: np.ndarray) -> np.ndarray:
+    """Reverse-map (shard, row) store locators to index labels: the
+    scan path ranks STORE rows, which may include rows appended but not
+    yet absorbed into the published snapshot — those answer label -1
+    (novel), never a stale label."""
+    labels = np.full(loc.shape[0], -1, np.int64)
+    sel = np.flatnonzero(ok)
+    if sel.size == 0 or int(index.n_rows) == 0:
+        return labels
+    big = np.int64(2**31)
+    ikey = (index.locator[:, 0].astype(np.int64) * big
+            + index.locator[:, 1].astype(np.int64))
+    order = np.argsort(ikey, kind="stable")
+    skey = ikey[order]
+    q = loc[sel, 0].astype(np.int64) * big + loc[sel, 1].astype(np.int64)
+    pos = np.searchsorted(skey, q)
+    inb = pos < skey.shape[0]
+    hit = np.zeros(q.shape[0], bool)
+    hit[inb] = skey[pos[inb]] == q[inb]
+    labels[sel[hit]] = index.labels[order[pos[hit]]].astype(np.int64)
+    return labels
+
+
+def _topk_answer(srv, index, store, gather, vectors: np.ndarray,
+                 k: int, mode: str) -> dict:
+    """Shared ``topk`` verb body (daemon and read replica): host
+    signatures, then either the band-candidate probe
+    (`LiveClusterIndex.topk`, low-latency, recall bounded by the hub
+    structure) or the exact device scan of every committed store row
+    (`cluster.kernels.score.bulk_topk_store`, recall 1.0).
+
+    Wire contract: per query exactly ``k`` slots, hits sorted by
+    (-agreement count, digest hex ascending), padded with
+    ``("", -1, -1)``.  The digest tiebreak makes the order
+    shard-count invariant — the router merges shard answers under the
+    same key and gets the unsharded daemon's answer elementwise."""
+    from ..cluster.kernels.score import bulk_topk_store, store_scan_locator
+
+    if mode not in ("candidates", "scan"):
+        raise ValueError(f"unknown topk mode {mode!r}; expected "
+                         "'candidates' or 'scan'")
+    k = int(k)
+    vectors = np.ascontiguousarray(vectors, np.uint32)
+    nq = int(vectors.shape[0])
+    base = {"ok": True, "generation": int(index.generation),
+            "mode": mode, "k": k}
+    if nq == 0 or k == 0:
+        empty = [[] for _ in range(nq)]
+        return {**base, "scores": [list(e) for e in empty],
+                "ids": [list(e) for e in empty], "labels": empty}
+    rows_in = vectors
+    if srv.qbits:
+        rows_in = quantize_ids(rows_in, srv.qbits)
+    sigs = scheme_host_signatures(rows_in, srv._hp)
+    if mode == "scan":
+        counts, srows = bulk_topk_store(
+            store, sigs, k, use_pallas=srv.params.use_pallas)
+        flat = srows.ravel().astype(np.int64)
+        ok = flat >= 0
+        loc = np.full((flat.shape[0], 2), -1, np.int32)
+        if ok.any():
+            loc[ok] = store_scan_locator(store, flat[ok])
+        labels = _labels_by_locator(index, loc, ok)
+    else:
+        keys = host_band_keys(sigs, srv.params.n_bands)
+        counts, irows = index.topk(sigs, keys, gather, k)
+        flat = irows.ravel().astype(np.int64)
+        ok = flat >= 0
+        loc = np.full((flat.shape[0], 2), -1, np.int32)
+        labels = np.full(flat.shape[0], -1, np.int64)
+        if ok.any():
+            loc[ok] = index.locator[flat[ok]]
+            labels[ok] = index.labels[flat[ok]].astype(np.int64)
+    counts = np.ascontiguousarray(counts, np.int32).reshape(-1).copy()
+    ids = np.full(flat.shape[0], "", object)
+    sel = np.flatnonzero(ok)
+    if sel.size:
+        try:
+            dg = store.load_digests(loc[sel, 0], loc[sel, 1])
+        except (OSError, ValueError) as e:
+            # An evicted/compacted shard raced the gather: hits degrade
+            # to misses (the query path's contract), never a wrong id.
+            log.warning("serve: topk digest gather degraded (%s); "
+                        "dropping %d hits", e, sel.size)
+            counts[sel] = -1
+            labels[sel] = -1
+        else:
+            ids[sel] = ["%016x%016x" % (int(a), int(b)) for a, b in dg]
+    counts = counts.reshape(nq, k)
+    labels = labels.reshape(nq, k)
+    ids = ids.reshape(nq, k)
+    out_s, out_i, out_l = [], [], []
+    for qi in range(nq):
+        c, hx, lb = counts[qi], ids[qi], labels[qi]
+        valid = sorted(np.flatnonzero(c >= 0).tolist(),
+                       key=lambda j: (-int(c[j]), hx[j]))
+        pad = k - len(valid)
+        out_s.append([int(c[j]) for j in valid] + [-1] * pad)
+        out_i.append([str(hx[j]) for j in valid] + [""] * pad)
+        out_l.append([int(lb[j]) for j in valid] + [-1] * pad)
+    return {**base, "scores": out_s, "ids": out_i, "labels": out_l}
+
+
 class IngestRejected(RuntimeError):
     """Admission control refused the batch (backpressure)."""
 
@@ -191,6 +295,7 @@ class ServeDaemon:
         self.admission = AdmissionController(self.slo)
         self.tracker = SloTracker(self.slo)
         self.lat_query = LatencyRecorder("serve_query")
+        self.lat_topk = LatencyRecorder("serve_topk")
         self.lat_ingest = LatencyRecorder("serve_ingest")
         self.last_scrub: dict = {
             "store_scrub_shards": len(self.store.shards),
@@ -608,6 +713,35 @@ class ServeDaemon:
         return {"labels": out, "known": hit,
                 "generation": index.generation}
 
+    def topk(self, vectors: np.ndarray, k: int = 10,
+             mode: str = "candidates") -> dict:
+        """The k nearest stored sessions per [K, S] coverage vector, by
+        exact signature agreement.  ``mode="candidates"`` probes the
+        snapshot's band tables (low latency; recall bounded by the hub
+        structure), ``mode="scan"`` device-scans every committed store
+        row (exact, recall 1.0 — the backfill/re-label path).  Answers
+        in content digests + cluster labels; see `_topk_answer` for the
+        wire contract."""
+        t0 = deadline_clock()
+        vectors = np.ascontiguousarray(vectors, np.uint32)
+        shared_access(self, "_index", write=False, atomic=True)
+        index = self._index  # ONE snapshot reference for the whole call
+        res = _topk_answer(self, index, self.reader,
+                           lambda u: self._gather_reader_sigs(index, u),
+                           vectors, k, mode)
+        wall = deadline_clock() - t0
+        self.lat_topk.add(wall)
+        if mode == "candidates" and (wall * 1e3
+                                     > self.slo.query_p99_target_ms):
+            # Scan mode is a bulk job — only the interactive candidate
+            # path is held to the query SLO budget.
+            profiling.capture_slow_request(
+                "topk", wall, self.slo.query_p99_target_ms, t0=t0,
+                absorb=self._inflight if self._busy else None,
+                rows=int(vectors.shape[0]),
+                generation=int(index.generation))
+        return res
+
     # -- control -------------------------------------------------------------
 
     def quiesce(self, timeout: float | None = None) -> dict:
@@ -649,7 +783,15 @@ class ServeDaemon:
             **self.admission.stats(),
             **self.tracker.stats(),
             **self.lat_query.summary(),
+            **self.lat_topk.summary(),
             **self.lat_ingest.summary(),
+            # Per-verb breakdown (query vs topk vs ingest): one blended
+            # histogram hides a slow verb behind a fast one.
+            "latency_by_verb": {
+                "query": self.lat_query.snapshot(),
+                "topk": self.lat_topk.snapshot(),
+                "ingest": self.lat_ingest.snapshot(),
+            },
         }
 
 

@@ -168,7 +168,7 @@ class ServeReplica:
     """Read-only query plane over a streamed store copy.
 
     Duck-typed to the verbs `ServeServer` dispatches — ``query``,
-    ``status``, ``ping`` state via ``_index`` — so a replica serves the
+    ``topk``, ``status``, ``ping`` state via ``_index`` — so a replica serves the
     same TCP protocol as a writer daemon; the write-plane verbs
     (``ingest``/``quiesce``) refuse with a structured error.  The index
     is rebuilt from the streamed LSH state + store rows at each
@@ -185,16 +185,23 @@ class ServeReplica:
         self.directory = directory
         policy = self._resolve_policy(directory)
         self.qbits = int(policy["quant_bits"])
-        scheme = str(policy.get("scheme", self.params.scheme))
-        if scheme != self.params.scheme:
+        # The streamed store's policy WINS wholesale (scheme, hash
+        # count, seed): a replica must answer in the signature universe
+        # the writer's cached rows were computed under.
+        adopt = {"scheme": str(policy.get("scheme", self.params.scheme)),
+                 "n_hashes": int(policy.get("n_hashes",
+                                            self.params.n_hashes)),
+                 "seed": int(policy.get("seed", self.params.seed))}
+        if any(getattr(self.params, f) != v for f, v in adopt.items()):
             from dataclasses import replace
 
-            self.params = replace(self.params, scheme=scheme)
+            self.params = replace(self.params, **adopt)
         self.store = SignatureStore(directory, policy, read_only=True)
         self._hp = make_params(self.params.scheme, self.params.n_hashes,
                                self.params.seed)
         self.read_only = True
         self.lat_query = LatencyRecorder("serve_replica_query")
+        self.lat_topk = LatencyRecorder("serve_replica_topk")
         self._index = LiveClusterIndex.empty(self.params.n_bands)
         self._generation_adopted = -1
         self._rebuild()
@@ -306,6 +313,23 @@ class ServeReplica:
         return {"labels": out, "known": hit,
                 "generation": index.generation}
 
+    def topk(self, vectors: np.ndarray, k: int = 10,
+             mode: str = "candidates") -> dict:
+        """Same contract as `ServeDaemon.topk` (read plane: both the
+        candidate probe and the exact scan are reads over the adopted
+        snapshot + streamed store copy)."""
+        from .daemon import _topk_answer
+
+        t0 = deadline_clock()
+        vectors = np.ascontiguousarray(vectors, np.uint32)
+        shared_access(self, "_index", write=False, atomic=True)
+        index = self._index
+        res = _topk_answer(self, index, self.store,
+                           lambda u: self._gather(index, u),
+                           vectors, k, mode)
+        self.lat_topk.add(deadline_clock() - t0)
+        return res
+
     # -- write-plane verbs refuse --------------------------------------------
 
     def ingest(self, items, timeout=None, request_id=None) -> dict:
@@ -324,7 +348,12 @@ class ServeReplica:
                 "store_generation": int(self.store.generation),
                 "store_rows": int(self.store.n_rows),
                 "generation_adopted": int(self._generation_adopted),
-                **self.lat_query.summary()}
+                **self.lat_query.summary(),
+                **self.lat_topk.summary(),
+                "latency_by_verb": {
+                    "query": self.lat_query.snapshot(),
+                    "topk": self.lat_topk.snapshot(),
+                }}
 
 
 class ReplicationPuller:

@@ -137,6 +137,10 @@ class LocalTransport:
                     "labels": res["labels"].astype(int).tolist(),
                     "known": res["known"].astype(bool).tolist(),
                     "generation": int(res["generation"])}
+        if op == "topk":
+            return self.daemon.topk(
+                decode_vectors(msg), k=int(msg.get("k", 10)),
+                mode=str(msg.get("mode", "candidates")))
         if op == "ping":
             idx = self.daemon._index
             return {"ok": True, "op": "ping",
@@ -328,6 +332,55 @@ class ShardRouter:
                 "generation": max(gens.values()),
                 "shard_generations": gens}
 
+    def topk(self, vectors: np.ndarray, k: int = 10,
+             mode: str = "candidates") -> dict:
+        """Broadcast top-k: every shard ranks its own rows, the router
+        merges the per-shard answers under the shards' own wire order
+        (-agreement count, digest hex ascending) and keeps the global
+        k.  Digests co-shard exactly (no row lives in two ranges), so
+        in scan mode the merged list is elementwise what ONE unsharded
+        daemon over the union of the rows answers; candidate mode
+        inherits each shard's hub recall.  Shard-local labels map to
+        routed global ids the same way ``query`` maps them."""
+        vectors = np.ascontiguousarray(vectors, np.uint32)
+        n = int(vectors.shape[0])
+        k = int(k)
+        payload = encode_vectors(vectors)
+        resps: dict[int, dict] = {}
+        for sid in sorted(self.transports):
+            resps[sid] = self._forward(
+                sid, {"op": "topk", "k": k, "mode": str(mode), **payload})
+        gens = {sid: int(r.get("generation", 0))
+                for sid, r in resps.items()}
+        out_s = np.full((n, k), -1, np.int64)
+        out_l = np.full((n, k), -1, np.int64)
+        out_i = [[""] * k for _ in range(n)]
+        with self._lock:
+            shared_access(self, "gmap", write=False)
+            for i in range(n):
+                cand = []
+                for sid, resp in resps.items():
+                    sc = resp["scores"][i]
+                    ids = resp["ids"][i]
+                    lb = resp["labels"][i]
+                    for j in range(len(sc)):
+                        if int(sc[j]) < 0:
+                            continue
+                        lab = int(lb[j])
+                        cand.append((int(sc[j]), str(ids[j]),
+                                     self._map_label(sid, lab)
+                                     if lab >= 0 else -1))
+                cand.sort(key=lambda t: (-t[0], t[1]))
+                for t, (sc, hx, g) in enumerate(cand[:k]):
+                    out_s[i, t] = sc
+                    out_i[i][t] = hx
+                    out_l[i, t] = g
+        return {"ok": True, "k": k, "mode": str(mode),
+                "generation": max(gens.values()),
+                "shard_generations": gens,
+                "scores": out_s.tolist(), "ids": out_i,
+                "labels": out_l.tolist()}
+
     def ping(self) -> dict:
         resps = {sid: self._forward(sid, {"op": "ping"})
                  for sid in sorted(self.transports)}
@@ -425,6 +478,10 @@ class RouterServer(socketserver.ThreadingTCPServer):
                     "labels": res["labels"].astype(int).tolist(),
                     "known": res["known"].astype(bool).tolist(),
                     "generation": int(res["generation"])}
+        if op == "topk":
+            return self.router.topk(
+                decode_vectors(msg), k=int(msg.get("k", 10)),
+                mode=str(msg.get("mode", "candidates")))
         if op == "ingest":
             rid = msg.get("request_id")
             return self.router.ingest(

@@ -163,6 +163,12 @@ class ServeServer(socketserver.ThreadingTCPServer):
                     "labels": res["labels"].astype(int).tolist(),
                     "known": res["known"].astype(bool).tolist(),
                     "generation": int(res["generation"])}
+        if op == "topk":
+            vectors = decode_vectors(msg)
+            return self.daemon.topk(vectors,
+                                    k=int(msg.get("k", 10)),
+                                    mode=str(msg.get("mode",
+                                                     "candidates")))
         if op == "ingest":
             vectors = decode_vectors(msg)
             rid = msg.get("request_id")

@@ -18,18 +18,21 @@ from __future__ import annotations
 # Every verb the single-daemon front end answers (and the client can
 # issue — the two surfaces are intentionally identical).
 SERVER_VERBS = ("ingest", "metrics", "ping", "profile", "query",
-                "quiesce", "shutdown", "slowlog", "status", "trace")
+                "quiesce", "shutdown", "slowlog", "status", "topk",
+                "trace")
 
 CLIENT_VERBS = ("ingest", "metrics", "ping", "profile", "query",
-                "quiesce", "shutdown", "slowlog", "status", "trace")
+                "quiesce", "shutdown", "slowlog", "status", "topk",
+                "trace")
 
 # The router front end: no slowlog/profile (those are per-daemon
 # diagnostics; the router aggregates metrics/trace instead).
 ROUTER_VERBS = ("ingest", "metrics", "ping", "query", "quiesce",
-                "shutdown", "status", "trace")
+                "shutdown", "status", "topk", "trace")
 
 # What the router forwards to shard daemons in-process.
-FORWARD_VERBS = ("ingest", "ping", "query", "quiesce", "status")
+FORWARD_VERBS = ("ingest", "ping", "query", "quiesce", "status",
+                 "topk")
 
 __all__ = ["CLIENT_VERBS", "FORWARD_VERBS", "ROUTER_VERBS",
            "SERVER_VERBS"]
